@@ -1,0 +1,71 @@
+"""Relationship-string template grammar.
+
+Parses `type:id#relation@subjecttype:subjectid(#subjectrelation)` template
+strings, where any field may be a `{{ expr }}` template.  Mirrors the
+reference grammar exactly (reference: pkg/rules/rules.go:1053-1076, the
+`relRegex` non-greedy grammar and its named groups).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+class RelParseError(ValueError):
+    pass
+
+
+# Same non-greedy structure as the reference regex (rules.go:1053-1055).
+_REL_RE = re.compile(
+    r"^(?P<resourceType>(.*?)):(?P<resourceID>.*?)#(?P<resourceRel>.*?)"
+    r"@(?P<subjectType>(.*?)):(?P<subjectID>.*?)(#(?P<subjectRel>.*?))?$"
+)
+
+
+@dataclass
+class UncompiledRelExpr:
+    """A relationship template whose fields are still uncompiled strings."""
+    resource_type: str = ""
+    resource_id: str = ""
+    resource_relation: str = ""
+    subject_type: str = ""
+    subject_id: str = ""
+    subject_relation: str = ""
+
+
+@dataclass
+class ResolvedRel:
+    """A relationship after all template expressions have been evaluated."""
+    resource_type: str = ""
+    resource_id: str = ""
+    resource_relation: str = ""
+    subject_type: str = ""
+    subject_id: str = ""
+    subject_relation: str = ""
+
+    def rel_string(self) -> str:
+        s = (f"{self.resource_type}:{self.resource_id}"
+             f"#{self.resource_relation}"
+             f"@{self.subject_type}:{self.subject_id}")
+        if self.subject_relation:
+            s += f"#{self.subject_relation}"
+        return s
+
+    def key(self) -> tuple:
+        return (self.resource_type, self.resource_id, self.resource_relation,
+                self.subject_type, self.subject_id, self.subject_relation)
+
+
+def parse_rel_string(tpl: str) -> UncompiledRelExpr:
+    m = _REL_RE.match(tpl)
+    if m is None:
+        raise RelParseError(f"invalid template: `{tpl}`")
+    return UncompiledRelExpr(
+        resource_type=m.group("resourceType"),
+        resource_id=m.group("resourceID"),
+        resource_relation=m.group("resourceRel"),
+        subject_type=m.group("subjectType"),
+        subject_id=m.group("subjectID"),
+        subject_relation=m.group("subjectRel") or "",
+    )

@@ -1,0 +1,208 @@
+"""Template expression language tests.
+
+Covers the expression shapes exercised by the reference's Bloblang corpus
+(pkg/rules/rules_test.go, tupleset_test.go, env_test.go)."""
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.rules import blang
+from spicedb_kubeapi_proxy_tpu.rules.engine import default_environment
+
+ENV = default_environment()
+
+
+def q(expr, data=None):
+    return ENV.parse(expr).query(data if data is not None else {})
+
+
+class TestLiterals:
+    def test_string(self):
+        assert q('"hello"') == "hello"
+
+    def test_single_quoted(self):
+        assert q("'hello'") == "hello"
+
+    def test_numbers(self):
+        assert q("42") == 42
+        assert q("4.5") == 4.5
+
+    def test_bool_null(self):
+        assert q("true") is True
+        assert q("false") is False
+        assert q("null") is None
+
+    def test_array(self):
+        assert q('[1, "a", true]') == [1, "a", True]
+
+    def test_object(self):
+        assert q('{"a": 1, "b": "x"}') == {"a": 1, "b": "x"}
+
+    def test_escapes(self):
+        assert q(r'"a\"b\n"') == 'a"b\n'
+
+
+class TestFieldAccess:
+    DATA = {"user": {"name": "alice", "groups": ["dev", "ops"]},
+            "resourceId": "default/pod1"}
+
+    def test_this_field(self):
+        assert q("this.user.name", self.DATA) == "alice"
+
+    def test_bare_ident_is_this_field(self):
+        assert q("user.name", self.DATA) == "alice"
+        assert q("resourceId", self.DATA) == "default/pod1"
+
+    def test_missing_field_is_null(self):
+        assert q("this.nope", self.DATA) is None
+        assert q("this.nope.deeper", self.DATA) is None
+
+    def test_index(self):
+        assert q("user.groups[0]", self.DATA) == "dev"
+        assert q('this["resourceId"]', self.DATA) == "default/pod1"
+
+    def test_index_out_of_bounds_errors(self):
+        with pytest.raises(blang.BlangEvalError):
+            q("user.groups[5]", self.DATA)
+
+
+class TestOperators:
+    def test_concat(self):
+        assert q('"a" + "b"') == "ab"
+
+    def test_concat_non_string_errors(self):
+        with pytest.raises(blang.BlangEvalError):
+            q('"a" + 1')
+
+    def test_arith(self):
+        assert q("1 + 2 * 3") == 7
+        assert q("(1 + 2) * 3") == 9
+        assert q("7 % 3") == 1
+
+    def test_compare(self):
+        assert q("1 < 2") is True
+        assert q('"a" != "b"') is True
+        assert q("2 == 2.0") is True
+
+    def test_logic(self):
+        assert q("true && false") is False
+        assert q("true || false") is True
+        assert q("!false") is True
+
+    def test_catch_pipe_on_null(self):
+        assert q("this.missing | []", {"a": 1}) == []
+
+    def test_catch_pipe_on_error(self):
+        assert q('this.num.map_each(this) | "fallback"', {"num": 5}) == "fallback"
+
+    def test_catch_pipe_passthrough(self):
+        assert q("this.a | 9", {"a": 1}) == 1
+
+    def test_catch_method(self):
+        assert q('this.num.map_each(this).catch("fb")', {"num": 5}) == "fb"
+
+
+class TestLambdasAndMethods:
+    DATA = {
+        "namespacedName": "default/dep1",
+        "name": "dep1",
+        "user": {"name": "alice"},
+        "object": {
+            "spec": {
+                "template": {"spec": {"containers": [
+                    {"name": "app"}, {"name": "proxy-sidecar"}]}},
+                "ports": [{"name": "http", "port": 80}, {"port": 8080}],
+            },
+        },
+    }
+
+    def test_map_each_with_capture(self):
+        # The canonical tupleSet shape from the reference corpus.
+        expr = ('this.namespacedName.(nsName -> this.object.spec.template.spec'
+                '.containers.map_each("deployment:" + nsName +'
+                ' "#has-container@container:" + this.name))')
+        assert q(expr, self.DATA) == [
+            "deployment:default/dep1#has-container@container:app",
+            "deployment:default/dep1#has-container@container:proxy-sidecar",
+        ]
+
+    def test_filter(self):
+        expr = ('this.object.spec.template.spec.containers'
+                '.filter(this.name != "proxy-sidecar").map_each(this.name)')
+        assert q(expr, self.DATA) == ["app"]
+
+    def test_if_else_and_string_conversion(self):
+        expr = ('this.object.spec.ports.map_each('
+                'if this.name != null { this.name } else { this.port.string() })')
+        assert q(expr, self.DATA) == ["http", "8080"]
+
+    def test_missing_list_with_fallback(self):
+        expr = ('(this.object.spec.template.spec.initContainers | [])'
+                '.map_each(this.name)')
+        assert q(expr, self.DATA) == []
+
+    def test_let_variables(self):
+        expr = ('let nsName = this.namespacedName\n'
+                'this.object.spec.template.spec.containers.map_each('
+                '"deployment:" + $nsName + "#c@container:" + this.name)')
+        assert q(expr, self.DATA) == [
+            "deployment:default/dep1#c@container:app",
+            "deployment:default/dep1#c@container:proxy-sidecar",
+        ]
+
+    def test_map_each_on_non_array_errors(self):
+        with pytest.raises(blang.BlangEvalError):
+            q("this.name.map_each(this)", self.DATA)
+
+    def test_nested_capture_sees_outer(self):
+        expr = ('this.name.(n -> this.user.name.(u -> n + ":" + u))')
+        assert q(expr, self.DATA) == "dep1:alice"
+
+
+class TestMethods:
+    def test_string_methods(self):
+        assert q('"AbC".uppercase()') == "ABC"
+        assert q('"AbC".lowercase()') == "abc"
+        assert q('" x ".trim()') == "x"
+        assert q('"abc".contains("b")') is True
+        assert q('"abc".has_prefix("ab")') is True
+        assert q('"abc".has_suffix("bc")') is True
+        assert q('"a/b/c".split("/")') == ["a", "b", "c"]
+        assert q('["a","b"].join("-")') == "a-b"
+
+    def test_conversions(self):
+        assert q('8080.string()') == "8080"
+        assert q('"12".number()') == 12
+        assert q('true.string()') == "true"
+        assert q('"abc".length()') == 3
+
+    def test_collections(self):
+        assert q('[3,1,2].sort()') == [1, 2, 3]
+        assert q('[1,1,2].unique()') == [1, 2]
+        assert q('{"b":1,"a":2}.keys()') == ["a", "b"]
+        assert q('[1,2,3].contains(2)') is True
+
+
+class TestFunctions:
+    def test_split_name(self):
+        assert q('split_name("ns/podname")') == "podname"
+        assert q('split_name("noslash")') == "noslash"
+
+    def test_split_namespace(self):
+        assert q('split_namespace("ns/podname")') == "ns"
+        assert q('split_namespace("noslash")') == ""
+
+    def test_split_on_resource_id(self):
+        data = {"resourceId": "default/pod1"}
+        assert q("split_name(resourceId)", data) == "pod1"
+        assert q("split_namespace(resourceId)", data) == "default"
+
+    def test_unknown_function(self):
+        with pytest.raises(blang.BlangEvalError):
+            q("nope(1)")
+
+
+class TestParseErrors:
+    @pytest.mark.parametrize("src", ["", "1 +", '"unterminated', "a..b", "((1)"])
+    def test_bad_input(self, src):
+        with pytest.raises(blang.BlangParseError):
+            ENV.parse(src)

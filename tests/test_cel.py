@@ -1,0 +1,103 @@
+"""CEL condition evaluator tests (shapes from reference rule `if` docs,
+pkg/config/proxyrule/rule.go:58-77 and rules_test.go)."""
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.rules import cel
+
+ACT = {
+    "request": {"verb": "get", "resource": "pods", "apiGroup": "",
+                "apiVersion": "v1", "name": "pod1", "namespace": "default"},
+    "user": {"name": "admin", "uid": "u1",
+             "groups": ["system:masters", "dev"], "extra": {}},
+    "name": "pod1",
+    "resourceNamespace": "default",
+    "namespacedName": "default/pod1",
+    "headers": {"Accept": ["application/json"]},
+}
+
+
+def run(src, act=None):
+    return cel.compile_condition(src).eval(act if act is not None else ACT)
+
+
+class TestConditions:
+    def test_verb_equality(self):
+        assert run("request.verb == 'get'") is True
+        assert run("request.verb == 'list'") is False
+
+    def test_user_name(self):
+        assert run("user.name == 'admin'") is True
+
+    def test_group_membership(self):
+        assert run("'system:masters' in user.groups") is True
+        assert run("'nope' in user.groups") is False
+
+    def test_namespace(self):
+        assert run("resourceNamespace == 'default'") is True
+
+    def test_compound(self):
+        assert run("request.resource == 'pods' && request.verb in ['get', 'list']") is True
+
+    def test_negation_and_or(self):
+        assert run("!(user.name == 'bob') || false") is True
+
+    def test_ternary(self):
+        assert run("user.name == 'admin' ? true : false") is True
+
+    def test_string_methods(self):
+        assert run("user.name.startsWith('ad')") is True
+        assert run("user.name.endsWith('min')") is True
+        assert run("namespacedName.contains('/')") is True
+        assert run("user.name.matches('^a.*n$')") is True
+
+    def test_size(self):
+        assert run("size(user.groups) == 2") is True
+        assert run("user.groups.size() == 2") is True
+
+    def test_has(self):
+        assert run("has(user.name)") is True
+        assert run("has(user.missing)") is False
+
+    def test_in_map(self):
+        assert run("'Accept' in headers") is True
+
+    def test_arithmetic_comparison(self):
+        assert run("1 + 2 * 3 == 7") is True
+        assert run("10 / 3 == 3") is True
+        assert run("-7 % 3 == -1") is True
+
+
+class TestCompileGate:
+    def test_non_boolean_rejected(self):
+        with pytest.raises(cel.CELCompileError, match="must return a boolean"):
+            cel.compile_condition("user.name")
+        with pytest.raises(cel.CELCompileError, match="must return a boolean"):
+            cel.compile_condition("name")
+        with pytest.raises(cel.CELCompileError, match="must return a boolean"):
+            cel.compile_condition("1 + 2")
+
+    def test_boolean_accepted(self):
+        cel.compile_condition("true")
+        cel.compile_condition("has(user.name)")
+        cel.compile_condition("size(user.groups) > 0")
+
+    def test_syntax_error(self):
+        with pytest.raises(cel.CELCompileError):
+            cel.compile_condition("request.verb ==")
+        with pytest.raises(cel.CELCompileError):
+            cel.compile_condition("(a && b")
+
+
+class TestEvalErrors:
+    def test_missing_attribute(self):
+        with pytest.raises(cel.CELEvalError):
+            run("missing == 'x'", {"user": {}})
+
+    def test_missing_key(self):
+        with pytest.raises(cel.CELEvalError):
+            run("user.nokey == 'x'")
+
+    def test_type_error_in_logic(self):
+        with pytest.raises(cel.CELEvalError):
+            cel.compile_condition("user.name && true").eval(ACT)

@@ -1,0 +1,478 @@
+"""Rules compiler/runtime tests: rel-string grammar, template resolution,
+matcher, tupleSets, prefilter validation (reference rules_test.go semantics)."""
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.config import proxyrule
+from spicedb_kubeapi_proxy_tpu.proxy.kube import RequestInfo, UserInfo, parse_request_info
+from spicedb_kubeapi_proxy_tpu.rules import engine
+from spicedb_kubeapi_proxy_tpu.rules.relstring import parse_rel_string, RelParseError
+
+
+class TestRelString:
+    def test_basic(self):
+        u = parse_rel_string("namespace:foo#creator@user:alice")
+        assert (u.resource_type, u.resource_id, u.resource_relation) == (
+            "namespace", "foo", "creator")
+        assert (u.subject_type, u.subject_id, u.subject_relation) == (
+            "user", "alice", "")
+
+    def test_subject_relation(self):
+        u = parse_rel_string("group:admins#member@group:devs#member")
+        assert u.subject_relation == "member"
+
+    def test_templated_fields(self):
+        u = parse_rel_string("namespace:{{name}}#creator@user:{{user.name}}")
+        assert u.resource_id == "{{name}}"
+        assert u.subject_id == "{{user.name}}"
+
+    def test_namespaced_id(self):
+        u = parse_rel_string("pod:default/pod1#view@user:bob")
+        assert u.resource_id == "default/pod1"
+
+    def test_dollar_id(self):
+        u = parse_rel_string("pod:$#view@user:{{user.name}}")
+        assert u.resource_id == "$"
+
+    def test_invalid(self):
+        with pytest.raises(RelParseError):
+            parse_rel_string("not-a-rel")
+
+
+def make_input(verb="create", resource="namespaces", name="foo",
+               namespace="", user_name="alice", groups=(), obj=None, body=b""):
+    req = RequestInfo(verb=verb, resource=resource, name=name,
+                      namespace=namespace, api_version="v1",
+                      is_resource_request=True)
+    user = UserInfo(name=user_name, groups=list(groups))
+    return engine.new_resolve_input(req, user, obj, body, {})
+
+
+class TestResolveInput:
+    def test_namespace_resource_clears_namespace(self):
+        inp = make_input(verb="get", resource="namespaces", name="ns1",
+                         namespace="ns1")
+        assert inp.namespace == ""
+        assert inp.namespaced_name == "ns1"
+
+    def test_namespaced_name(self):
+        inp = make_input(verb="get", resource="pods", name="p", namespace="ns")
+        assert inp.namespaced_name == "ns/p"
+
+    def test_object_overrides_request(self):
+        inp = make_input(verb="create", resource="pods", name="",
+                         namespace="", obj={"metadata": {"name": "p2",
+                                                         "namespace": "ns2"}})
+        assert inp.name == "p2"
+        assert inp.namespace == "ns2"
+
+    def test_body_extraction(self):
+        body = b'{"apiVersion":"v1","kind":"Pod","metadata":{"name":"p3","namespace":"ns3"},"spec":{"x":1}}'
+        req = parse_request_info("POST", "/api/v1/namespaces/ns3/pods")
+        inp = engine.resolve_input_from_request(req, UserInfo(name="u"), body, {})
+        assert inp.name == "p3"
+        assert inp.object["metadata"]["name"] == "p3"
+        assert inp.body == body
+
+    def test_bad_body_errors(self):
+        req = parse_request_info("POST", "/api/v1/namespaces/ns/pods")
+        with pytest.raises(engine.ResolveError):
+            engine.resolve_input_from_request(req, UserInfo(name="u"), b"{nope", {})
+
+
+class TestTemplateResolution:
+    def test_literal_and_expr_fields(self):
+        cfg = proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+match: [{apiVersion: v1, resource: namespaces, verbs: [create]}]
+check:
+- tpl: "namespace:{{name}}#creator@user:{{user.name}}"
+""")[0]
+        rule = engine.compile_rule(cfg)
+        inp = make_input(name="foo", user_name="alice")
+        rels = rule.checks[0].generate_relationships(inp)
+        assert len(rels) == 1
+        assert rels[0].rel_string() == "namespace:foo#creator@user:alice"
+
+    def test_structured_template(self):
+        cfg = proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check:
+- resource: {type: pod, id: "{{namespacedName}}", relation: view}
+  subject: {type: user, id: "{{user.name}}"}
+""")[0]
+        rule = engine.compile_rule(cfg)
+        inp = make_input(verb="get", resource="pods", name="p", namespace="ns",
+                         user_name="bob")
+        rels = rule.checks[0].generate_relationships(inp)
+        assert rels[0].rel_string() == "pod:ns/p#view@user:bob"
+
+    def test_subject_relation_template(self):
+        cfg = proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check:
+- tpl: "pod:{{name}}#view@group:devs#member"
+""")[0]
+        rule = engine.compile_rule(cfg)
+        rels = rule.checks[0].generate_relationships(make_input(verb="get", resource="pods", name="p"))
+        assert rels[0].subject_relation == "member"
+
+    def test_none_field_errors(self):
+        cfg = proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check:
+- tpl: "pod:{{this.missing}}#view@user:{{user.name}}"
+""")[0]
+        rule = engine.compile_rule(cfg)
+        with pytest.raises(engine.ResolveError, match="empty resource id"):
+            rule.checks[0].generate_relationships(make_input(verb="get", resource="pods"))
+
+
+class TestTupleSet:
+    def make_rule(self, tuple_set):
+        cfg = proxyrule.parse_doc({
+            "apiVersion": "authzed.com/v1alpha1", "kind": "ProxyRule",
+            "metadata": {"name": "r"},
+            "match": [{"apiVersion": "apps/v1", "resource": "deployments",
+                       "verbs": ["create"]}],
+            "update": {"creates": [{"tupleSet": tuple_set}]},
+        })
+        return engine.compile_rule(cfg)
+
+    DEPLOY_BODY = (b'{"apiVersion":"apps/v1","kind":"Deployment",'
+                   b'"metadata":{"name":"dep1","namespace":"default"},'
+                   b'"spec":{"template":{"spec":{"containers":'
+                   b'[{"name":"app"},{"name":"sidecar"}]}}}}')
+
+    def make_deploy_input(self):
+        req = parse_request_info("POST", "/apis/apps/v1/namespaces/default/deployments")
+        return engine.resolve_input_from_request(
+            req, UserInfo(name="alice"), self.DEPLOY_BODY, {})
+
+    def test_container_fanout(self):
+        rule = self.make_rule(
+            'this.namespacedName.(nsName -> this.object.spec.template.spec'
+            '.containers.map_each("deployment:" + nsName +'
+            ' "#has-container@container:" + this.name))')
+        rels = rule.update.creates[0].generate_relationships(self.make_deploy_input())
+        assert [r.rel_string() for r in rels] == [
+            "deployment:default/dep1#has-container@container:app",
+            "deployment:default/dep1#has-container@container:sidecar",
+        ]
+
+    def test_non_array_result_errors(self):
+        rule = self.make_rule('"single-string"')
+        with pytest.raises(engine.ResolveError, match="must return an array"):
+            rule.update.creates[0].generate_relationships(self.make_deploy_input())
+
+    def test_invalid_rel_in_array_errors(self):
+        rule = self.make_rule('["invalid-relationship-format"]')
+        with pytest.raises(engine.ResolveError, match="error parsing relationship"):
+            rule.update.creates[0].generate_relationships(self.make_deploy_input())
+
+    def test_tuple_set_rejected_in_prefilter(self):
+        with pytest.raises(engine.RuleCompileError, match="tupleSet is not allowed"):
+            engine.compile_rule(proxyrule.parse_doc({
+                "apiVersion": "authzed.com/v1alpha1", "kind": "ProxyRule",
+                "metadata": {"name": "r"},
+                "match": [{"apiVersion": "v1", "resource": "pods", "verbs": ["list"]}],
+                "prefilter": [{"fromObjectIDNameExpr": "{{resourceId}}",
+                               "lookupMatchingResources": {"tupleSet": '["x"]'}}],
+            }))
+
+
+class TestPreFilterValidation:
+    def test_dollar_required(self):
+        with pytest.raises(engine.RuleCompileError, match="must be set to"):
+            engine.compile_rule(proxyrule.parse_doc({
+                "apiVersion": "authzed.com/v1alpha1", "kind": "ProxyRule",
+                "metadata": {"name": "r"},
+                "match": [{"apiVersion": "v1", "resource": "pods", "verbs": ["list"]}],
+                "prefilter": [{"fromObjectIDNameExpr": "{{resourceId}}",
+                               "lookupMatchingResources": {
+                                   "tpl": "pod:fixed#view@user:{{user.name}}"}}],
+            }))
+
+    def test_dollar_passes(self):
+        rule = engine.compile_rule(proxyrule.parse_doc({
+            "apiVersion": "authzed.com/v1alpha1", "kind": "ProxyRule",
+            "metadata": {"name": "r"},
+            "match": [{"apiVersion": "v1", "resource": "pods", "verbs": ["list"]}],
+            "prefilter": [{"fromObjectIDNameExpr": "{{split_name(resourceId)}}",
+                           "fromObjectIDNamespaceExpr": "{{split_namespace(resourceId)}}",
+                           "lookupMatchingResources": {
+                               "tpl": "pod:$#view@user:{{user.name}}"}}],
+        }))
+        assert len(rule.pre_filter) == 1
+
+    def test_missing_lookup_errors(self):
+        with pytest.raises(engine.RuleCompileError, match="LookupMatchingResources"):
+            engine.compile_rule(proxyrule.parse_doc({
+                "apiVersion": "authzed.com/v1alpha1", "kind": "ProxyRule",
+                "metadata": {"name": "r"},
+                "match": [{"apiVersion": "v1", "resource": "pods", "verbs": ["list"]}],
+                "prefilter": [{"fromObjectIDNameExpr": "{{resourceId}}"}],
+            }))
+
+
+class TestPostCheckValidation:
+    def test_postcheck_with_write_verb_rejected(self):
+        with pytest.raises(engine.RuleCompileError, match="PostCheck"):
+            engine.compile_rule(proxyrule.parse_doc({
+                "apiVersion": "authzed.com/v1alpha1", "kind": "ProxyRule",
+                "metadata": {"name": "r"},
+                "match": [{"apiVersion": "v1", "resource": "pods", "verbs": ["create"]}],
+                "postcheck": [{"tpl": "pod:{{name}}#view@user:{{user.name}}"}],
+            }))
+
+    def test_postcheck_with_get_ok(self):
+        rule = engine.compile_rule(proxyrule.parse_doc({
+            "apiVersion": "authzed.com/v1alpha1", "kind": "ProxyRule",
+            "metadata": {"name": "r"},
+            "match": [{"apiVersion": "v1", "resource": "pods", "verbs": ["get"]}],
+            "postcheck": [{"tpl": "pod:{{name}}#view@user:{{user.name}}"}],
+        }))
+        assert len(rule.post_checks) == 1
+
+
+class TestMatcher:
+    RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check: [{tpl: "pod:{{namespacedName}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-deployments}
+match: [{apiVersion: apps/v1, resource: deployments, verbs: [list, watch]}]
+prefilter:
+- fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  lookupMatchingResources: {tpl: "deployment:$#view@user:{{user.name}}"}
+"""
+
+    def make_matcher(self):
+        return engine.MapMatcher(proxyrule.parse(self.RULES))
+
+    def test_match_core_group(self):
+        m = self.make_matcher()
+        info = RequestInfo(verb="get", api_group="", api_version="v1", resource="pods")
+        assert [r.name for r in m.match(info)] == ["get-pods"]
+
+    def test_match_named_group_and_multiple_verbs(self):
+        m = self.make_matcher()
+        for verb in ("list", "watch"):
+            info = RequestInfo(verb=verb, api_group="apps", api_version="v1",
+                               resource="deployments")
+            assert [r.name for r in m.match(info)] == ["list-deployments"]
+
+    def test_no_match(self):
+        m = self.make_matcher()
+        info = RequestInfo(verb="delete", api_group="", api_version="v1", resource="pods")
+        assert m.match(info) == []
+
+
+class TestCELFiltering:
+    def test_filter_rules(self):
+        cfgs = proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: admins-only}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+if: ["'system:masters' in user.groups"]
+check: [{tpl: "pod:{{name}}#view@user:{{user.name}}"}]
+""")
+        rule = engine.compile_rule(cfgs[0])
+        admin = make_input(verb="get", resource="pods", groups=["system:masters"])
+        pleb = make_input(verb="get", resource="pods", groups=["dev"])
+        assert engine.filter_rules_with_cel_conditions([rule], admin) == [rule]
+        assert engine.filter_rules_with_cel_conditions([rule], pleb) == []
+
+
+class TestRequestInfoParsing:
+    @pytest.mark.parametrize("method,url,expect", [
+        ("GET", "/api/v1/namespaces/ns/pods/p1",
+         dict(verb="get", resource="pods", namespace="ns", name="p1")),
+        ("GET", "/api/v1/namespaces/ns/pods",
+         dict(verb="list", resource="pods", namespace="ns", name="")),
+        ("GET", "/api/v1/namespaces/ns/pods?watch=true",
+         dict(verb="watch", resource="pods", namespace="ns")),
+        ("GET", "/api/v1/namespaces",
+         dict(verb="list", resource="namespaces")),
+        ("GET", "/api/v1/namespaces/ns1",
+         dict(verb="get", resource="namespaces", name="ns1", namespace="ns1")),
+        ("GET", "/api/v1/namespaces/ns1/status",
+         dict(verb="get", resource="namespaces", name="ns1", namespace="ns1",
+              subresource="status")),
+        ("GET", "/api/v1/namespaces/watch/pods",
+         dict(verb="list", resource="pods", namespace="watch")),
+        ("POST", "/api/v1/namespaces/ns/pods",
+         dict(verb="create", resource="pods", namespace="ns")),
+        ("DELETE", "/api/v1/namespaces/ns/pods/p1",
+         dict(verb="delete", resource="pods", name="p1")),
+        ("DELETE", "/api/v1/namespaces/ns/pods",
+         dict(verb="deletecollection", resource="pods")),
+        ("PUT", "/apis/apps/v1/namespaces/ns/deployments/d1",
+         dict(verb="update", resource="deployments", api_group="apps", name="d1")),
+        ("PATCH", "/apis/apps/v1/namespaces/ns/deployments/d1",
+         dict(verb="patch", resource="deployments")),
+        ("GET", "/api/v1/nodes/n1", dict(verb="get", resource="nodes", name="n1")),
+        ("GET", "/healthz", dict(verb="get", is_resource_request=False)),
+    ])
+    def test_parse(self, method, url, expect):
+        info = parse_request_info(method, url)
+        for k, v in expect.items():
+            assert getattr(info, k) == v, f"{k}: {getattr(info, k)!r} != {v!r}"
+
+    def test_label_selector(self):
+        info = parse_request_info("GET", "/api/v1/pods?labelSelector=app%3Dfoo")
+        assert info.label_selector == "app=foo"
+
+
+class TestProxyRuleParsing:
+    def test_reference_deploy_rules_parse(self):
+        # The full rule file shape shipped with the reference (deploy/rules.yaml).
+        cfgs = proxyrule.parse(DEPLOY_RULES)
+        assert len(cfgs) == 8
+        matcher = engine.MapMatcher(cfgs)
+        info = RequestInfo(verb="create", api_group="", api_version="v1",
+                           resource="namespaces")
+        assert [r.name for r in matcher.match(info)] == ["create-namespaces"]
+        assert matcher.match(info)[0].lock_mode == "Pessimistic"
+
+    def test_missing_match_rejected(self):
+        with pytest.raises(proxyrule.RuleValidationError):
+            proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+check: [{tpl: "a:b#c@d:e"}]
+""")
+
+    def test_bad_verb_rejected(self):
+        with pytest.raises(proxyrule.RuleValidationError):
+            proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+match: [{apiVersion: v1, resource: pods, verbs: [frobnicate]}]
+""")
+
+    def test_mutually_exclusive_template_fields(self):
+        with pytest.raises(proxyrule.RuleValidationError, match="mutually exclusive"):
+            proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check: [{tpl: "a:b#c@d:e", tupleSet: '["x"]'}]
+""")
+
+    def test_empty_template_rejected(self):
+        with pytest.raises(proxyrule.RuleValidationError, match="required"):
+            proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check: [{}]
+""")
+
+    def test_bad_lock_mode(self):
+        with pytest.raises(proxyrule.RuleValidationError, match="lock"):
+            proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: r}
+lock: Sloppy
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+""")
+
+
+DEPLOY_RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-namespaces}
+lock: Pessimistic
+match: [{apiVersion: v1, resource: namespaces, verbs: [create]}]
+update:
+  preconditionDoesNotExist:
+  - tpl: "namespace:{{name}}#cluster@cluster:cluster"
+  creates:
+  - tpl: "namespace:{{name}}#creator@user:{{user.name}}"
+  - tpl: "namespace:{{name}}#cluster@cluster:cluster"
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: delete-namespaces}
+lock: Pessimistic
+match: [{apiVersion: v1, resource: namespaces, verbs: [delete]}]
+update:
+  deletes:
+  - tpl: "namespace:{{name}}#creator@user:{{user.name}}"
+  - tpl: "namespace:{{name}}#cluster@cluster:cluster"
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [get]}]
+check: [{tpl: "namespace:{{name}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-watch-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [list, watch]}]
+prefilter:
+- fromObjectIDNameExpr: "{{resourceId}}"
+  lookupMatchingResources: {tpl: "namespace:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-pods}
+lock: Pessimistic
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+update:
+  preconditionDoesNotExist:
+  - tpl: "pod:{{name}}#namespace@namespace:{{namespace}}"
+  creates:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+  - tpl: "pod:{{name}}#namespace@namespace:{{namespace}}"
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: delete-pods}
+lock: Pessimistic
+match: [{apiVersion: v1, resource: pods, verbs: [delete]}]
+update:
+  deletes:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+  - tpl: "pod:{{name}}#namespace@namespace:{{namespace}}"
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check: [{tpl: "pod:{{namespacedName}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-watch-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list, watch]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+"""
