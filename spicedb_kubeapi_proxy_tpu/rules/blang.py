@@ -239,18 +239,30 @@ class _Parser:
     def __init__(self, toks: list[Tok]):
         self.toks = toks
         self.i = 0
+        # While parsing a `let` statement's right-hand side, a newline at
+        # bracket depth 0 terminates the expression instead of being skipped.
+        self.stop_at_nl = False
+        self.depth = 0
+
+    def _skips_nl(self) -> bool:
+        return not (self.stop_at_nl and self.depth == 0)
 
     def peek(self, skip_nl: bool = True) -> Tok:
         j = self.i
-        while skip_nl and self.toks[j].kind == "nl":
+        while skip_nl and self._skips_nl() and self.toks[j].kind == "nl":
             j += 1
         return self.toks[j]
 
     def next(self, skip_nl: bool = True) -> Tok:
-        while skip_nl and self.toks[self.i].kind == "nl":
+        while skip_nl and self._skips_nl() and self.toks[self.i].kind == "nl":
             self.i += 1
         t = self.toks[self.i]
         self.i += 1
+        if t.kind == "punct":
+            if t.val in ("(", "[", "{"):
+                self.depth += 1
+            elif t.val in (")", "]", "}"):
+                self.depth -= 1
         return t
 
     def expect(self, kind: str, val: Any = None) -> Tok:
@@ -278,7 +290,12 @@ class _Parser:
                 self.next()
                 name = self.expect("ident").val
                 self.expect("punct", "=")
-                lets.append((name, self.parse_expr()))
+                # the let RHS ends at the first newline outside brackets
+                self.stop_at_nl = True
+                try:
+                    lets.append((name, self.parse_expr()))
+                finally:
+                    self.stop_at_nl = False
             else:
                 break
         result = self.parse_expr()
@@ -694,13 +711,18 @@ class Executor:
 
     def _eval_Method(self, node: Method, s: _Scope) -> Any:
         name = node.name
-        if name == "catch":
+        if name in ("catch", "or"):
+            # lazily evaluated: the fallback applies when the base errors
+            # (catch/or) or resolves to null (or)
             if len(node.args) != 1:
-                raise BlangEvalError("catch expects 1 argument")
+                raise BlangEvalError(f"{name} expects 1 argument")
             try:
-                return self._eval(node.base, s)
+                base = self._eval(node.base, s)
             except BlangEvalError:
                 return self._eval(node.args[0], s)
+            if name == "or" and base is None:
+                return self._eval(node.args[0], s)
+            return base
         base = self._eval(node.base, s)
 
         if name in ("map_each", "filter"):
@@ -768,6 +790,8 @@ class Executor:
         if name == "split":
             if not isinstance(base, str) or not isinstance(args[0], str):
                 raise BlangEvalError("split expects string.split(string)")
+            if args[0] == "":
+                return list(base)  # empty separator splits into characters
             return base.split(args[0])
         if name == "join":
             if not isinstance(base, list):
@@ -809,9 +833,6 @@ class Executor:
             lo = args[0]
             hi = args[1] if len(args) == 2 else len(base)
             return base[lo:hi]
-        if name == "or":
-            # alias of the `|` pipe for non-operator style
-            return base if base is not None else args[0]
         if name == "exists":
             if isinstance(base, dict) and isinstance(args[0], str):
                 return args[0] in base
@@ -832,6 +853,5 @@ _METHOD_ARITY = {
     "uppercase": (0, 0), "lowercase": (0, 0), "trim": (0, 0),
     "keys": (0, 0), "values": (0, 0), "sort": (0, 0), "unique": (0, 0),
     "contains": (1, 1), "has_prefix": (1, 1), "has_suffix": (1, 1),
-    "split": (1, 1), "join": (0, 1), "slice": (1, 2), "or": (1, 1),
-    "exists": (1, 1),
+    "split": (1, 1), "join": (0, 1), "slice": (1, 2), "exists": (1, 1),
 }

@@ -319,7 +319,8 @@ class _Parser:
 
 # -- static type gate -------------------------------------------------------
 
-_BOOL_METHODS = {"startsWith", "endsWith", "contains", "matches", "exists", "all"}
+_BOOL_METHODS = {"startsWith", "endsWith", "contains", "matches",
+                 "exists", "all", "exists_one"}
 
 
 def _static_type(node: N, var_types: dict[str, str]) -> str:
@@ -561,6 +562,32 @@ def _cel_eq(a: Any, b: Any) -> bool:
 
 
 def _call(node: Call, act: dict[str, Any]) -> Any:
+    # comprehension macros bind their first argument as an iteration variable,
+    # so they are handled before eager argument evaluation
+    if node.base is not None and node.name in ("exists", "all", "exists_one"):
+        if len(node.args) != 2 or not isinstance(node.args[0], Ident):
+            raise CELEvalError(
+                f"{node.name}() expects (var, predicate) arguments")
+        var = node.args[0].name
+        base = _eval(node.base, act)
+        if not isinstance(base, (list, dict)):
+            raise CELEvalError(f"{node.name}() on {type(base).__name__}")
+        items = list(base) if isinstance(base, (list, dict)) else base
+        count = 0
+        for item in items:
+            v = _eval(node.args[1], {**act, var: item})
+            if not isinstance(v, bool):
+                raise CELEvalError(f"{node.name}() predicate must be boolean")
+            if v:
+                count += 1
+            elif node.name == "all":
+                return False
+        if node.name == "all":
+            return True
+        if node.name == "exists_one":
+            return count == 1
+        return count > 0
+
     args = [_eval(a, act) for a in node.args]
     if node.base is None:
         if node.name in ("size", "string", "int", "double") and len(args) != 1:

@@ -1,0 +1,233 @@
+"""Endpoint plugin boundary: `embedded://` | `grpc://` | `jax://`.
+
+Mirrors the reference's SpiceDB-endpoint dispatch on URL scheme
+(reference pkg/proxy/options.go:307-369): upper layers (authz middleware,
+dual-write engine) speak only this interface — the seven verbs the proxy
+consumes (SURVEY.md §5) — and never know which backend ran.
+
+- `embedded://`       host tuple store + recursive evaluator (the oracle);
+                      replaces the reference's in-process SpiceDB
+                      (pkg/spicedb/spicedb.go:18-71)
+- `jax://`            same store, but check/LookupResources execute as
+                      batched boolean-SpMV reachability kernels on TPU
+- `grpc://host:port`  remote SpiceDB (requires grpcio; optional)
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, Iterable, Optional
+from urllib.parse import urlsplit
+
+import yaml
+
+from . import schema as sch
+from .evaluator import Evaluator
+from .store import TupleStore, Watcher
+from .types import (
+    CheckRequest,
+    CheckResult,
+    ObjectRef,
+    Permissionship,
+    Precondition,
+    Relationship,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SubjectRef,
+    parse_relationship,
+)
+
+
+class PermissionsEndpoint:
+    """The endpoint contract (PermissionsService + WatchService subset)."""
+
+    async def check_permission(self, req: CheckRequest) -> CheckResult:
+        raise NotImplementedError
+
+    async def check_bulk_permissions(self, reqs: list) -> list:
+        raise NotImplementedError
+
+    async def lookup_resources(self, resource_type: str, permission: str,
+                               subject: SubjectRef) -> list:
+        raise NotImplementedError
+
+    async def read_relationships(self, flt: RelationshipFilter) -> list:
+        raise NotImplementedError
+
+    async def write_relationships(self, updates: Iterable[RelationshipUpdate],
+                                  preconditions: Iterable[Precondition] = ()) -> int:
+        raise NotImplementedError
+
+    async def delete_relationships(self, flt: RelationshipFilter,
+                                   preconditions: Iterable[Precondition] = ()) -> int:
+        raise NotImplementedError
+
+    def watch(self, object_types: Optional[Iterable[str]] = None) -> Watcher:
+        raise NotImplementedError
+
+    async def close(self) -> None:
+        pass
+
+
+@dataclass
+class Bootstrap:
+    schema_text: str = ""
+    relationships_text: str = ""
+
+    @classmethod
+    def from_mapping(cls, data: dict) -> "Bootstrap":
+        return cls(schema_text=data.get("schema", "") or "",
+                   relationships_text=data.get("relationships", "") or "")
+
+    @classmethod
+    def from_yaml(cls, content: str) -> "Bootstrap":
+        data = yaml.safe_load(content) or {}
+        if not isinstance(data, dict):
+            raise ValueError("bootstrap content must be a YAML mapping")
+        return cls.from_mapping(data)
+
+    @classmethod
+    def from_file(cls, path: str) -> "Bootstrap":
+        with open(path, "r", encoding="utf-8") as f:
+            return cls.from_yaml(f.read())
+
+    def relationships(self) -> list:
+        rels = []
+        for line in self.relationships_text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            rels.append(parse_relationship(line))
+        return rels
+
+
+# The default bootstrap schema applied when none is supplied: the proxy's own
+# workflow/lock/idempotency definitions plus the demo cluster/namespace/pod
+# types (behavioral equivalent of the reference's embedded bootstrap.yaml).
+DEFAULT_BOOTSTRAP_SCHEMA = """
+use expiration
+
+definition cluster {}
+definition user {}
+definition namespace {
+  relation cluster: cluster
+  relation creator: user
+  relation viewer: user
+
+  permission admin = creator
+  permission edit = creator
+  permission view = viewer + creator
+  permission no_one_at_all = nil
+}
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user
+  permission edit = creator
+  permission view = viewer + creator
+}
+definition testresource {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user
+  permission edit = creator
+  permission view = viewer + creator
+}
+definition lock {
+  relation workflow: workflow
+}
+
+definition workflow {
+  relation idempotency_key: activity with expiration
+}
+
+definition activity {}
+"""
+
+
+class EmbeddedEndpoint(PermissionsEndpoint):
+    """Host tuple store + recursive evaluator (`embedded://`)."""
+
+    def __init__(self, schema: sch.Schema, store: Optional[TupleStore] = None):
+        self.schema = schema
+        self.store = store if store is not None else TupleStore()
+        self.evaluator = Evaluator(schema, self.store)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_bootstrap(cls, bootstrap: Optional[Bootstrap] = None) -> "EmbeddedEndpoint":
+        if bootstrap is None or not bootstrap.schema_text:
+            schema_text = DEFAULT_BOOTSTRAP_SCHEMA
+            rel_text = bootstrap.relationships_text if bootstrap else ""
+        else:
+            schema_text = bootstrap.schema_text
+            rel_text = bootstrap.relationships_text
+        endpoint = cls(sch.parse_schema(schema_text))
+        bs = Bootstrap(schema_text=schema_text, relationships_text=rel_text)
+        rels = bs.relationships()
+        if rels:
+            from .types import UpdateOp
+            endpoint.store.write([RelationshipUpdate(UpdateOp.TOUCH, r)
+                                  for r in rels])
+        return endpoint
+
+    # -- verbs --------------------------------------------------------------
+
+    def _check_sync(self, req: CheckRequest) -> CheckResult:
+        allowed = self.evaluator.check(req.resource, req.permission, req.subject)
+        return CheckResult(
+            permissionship=(Permissionship.HAS_PERMISSION if allowed
+                            else Permissionship.NO_PERMISSION),
+            checked_at=self.store.revision,
+        )
+
+    async def check_permission(self, req: CheckRequest) -> CheckResult:
+        return self._check_sync(req)
+
+    async def check_bulk_permissions(self, reqs: list) -> list:
+        return [self._check_sync(r) for r in reqs]
+
+    async def lookup_resources(self, resource_type: str, permission: str,
+                               subject: SubjectRef) -> list:
+        return self.evaluator.lookup_resources(resource_type, permission, subject)
+
+    async def read_relationships(self, flt: RelationshipFilter) -> list:
+        return self.store.read(flt)
+
+    async def write_relationships(self, updates: Iterable[RelationshipUpdate],
+                                  preconditions: Iterable[Precondition] = ()) -> int:
+        return self.store.write(updates, preconditions)
+
+    async def delete_relationships(self, flt: RelationshipFilter,
+                                   preconditions: Iterable[Precondition] = ()) -> int:
+        rev, _ = self.store.delete_by_filter(flt, preconditions)
+        return rev
+
+    def watch(self, object_types: Optional[Iterable[str]] = None) -> Watcher:
+        return self.store.subscribe(object_types)
+
+
+class EndpointConfigError(ValueError):
+    pass
+
+
+def create_endpoint(url: str,
+                    bootstrap: Optional[Bootstrap] = None,
+                    **kwargs: Any) -> PermissionsEndpoint:
+    """Endpoint registry dispatching on URL scheme
+    (reference options.go:307-369)."""
+    split = urlsplit(url)
+    scheme = split.scheme
+    if scheme == "embedded":
+        return EmbeddedEndpoint.from_bootstrap(bootstrap)
+    if scheme == "jax":
+        from ..ops.jax_endpoint import JaxEndpoint  # lazy: pulls in jax
+        return JaxEndpoint.from_bootstrap(bootstrap, **kwargs)
+    if scheme in ("grpc", "grpcs", "http", "https"):
+        raise EndpointConfigError(
+            f"remote SpiceDB endpoint {url!r} requires grpcio + authzed client"
+            " bindings, which are not bundled in this environment; use"
+            " embedded:// or jax://")
+    raise EndpointConfigError(f"unsupported spicedb endpoint scheme {scheme!r}")

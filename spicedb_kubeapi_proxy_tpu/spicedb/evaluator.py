@@ -1,0 +1,178 @@
+"""Host-side recursive check/lookup evaluator — the reference oracle.
+
+Implements Zanzibar userset-rewrite evaluation over the tuple store: direct
+relations (incl. wildcard and userset subjects), permission expressions
+(union / intersection / exclusion / arrow), bounded by the same max dispatch
+depth the embedded reference server uses (50, reference
+pkg/spicedb/spicedb.go:34).
+
+This evaluator backs the `embedded://` endpoint and serves as the
+differential-testing oracle for the `jax://` device kernels
+(SURVEY.md §4 build translation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from . import schema as sch
+from .store import TupleStore
+from .types import (
+    MaxDepthExceededError,
+    ObjectRef,
+    SchemaError,
+    SubjectRef,
+    WILDCARD,
+)
+
+MAX_DEPTH = 50
+
+
+@dataclass
+class _Ctx:
+    """Per-query evaluation context.
+
+    `memo` holds only *clean* results; a result computed while assuming an
+    in-progress (cyclic) node was False is valid for the current root but not
+    cacheable, so frames whose subtree hit a still-in-progress node skip
+    memoization (`hits` tracks those assumption keys until their own frame
+    completes)."""
+    memo: dict = field(default_factory=dict)
+    stack: set = field(default_factory=set)
+    hits: set = field(default_factory=set)
+
+
+class Evaluator:
+    def __init__(self, schema: sch.Schema, store: TupleStore,
+                 max_depth: int = MAX_DEPTH):
+        self.schema = schema
+        self.store = store
+        self.max_depth = max_depth
+
+    # -- public API ---------------------------------------------------------
+
+    def check(self, resource: ObjectRef, permission: str,
+              subject: SubjectRef) -> bool:
+        """Does `subject` have `permission` on `resource`?"""
+        return self._check(resource, permission, subject, 0, _Ctx())
+
+    def lookup_resources(self, resource_type: str, permission: str,
+                         subject: SubjectRef) -> list:
+        """All object ids of `resource_type` on which `subject` has
+        `permission`.  Candidates are objects appearing as a resource in any
+        live tuple (an object with no tuples is unreachable)."""
+        self.schema.definition(resource_type)  # validate type exists
+        out = []
+        ctx = _Ctx()  # memo shared across candidates — same store snapshot
+        for rid in self.store.object_ids_of_type(resource_type):
+            if self._check(ObjectRef(resource_type, rid), permission, subject,
+                           0, ctx):
+                out.append(rid)
+        return out
+
+    def lookup_subjects(self, resource: ObjectRef, permission: str,
+                        subject_type: str) -> list:
+        """All subject ids of `subject_type` holding `permission` on
+        `resource` (expansion by candidate enumeration)."""
+        candidates = set()
+        for rel in self.store.read(None):
+            if rel.subject.type == subject_type and not rel.subject.relation:
+                candidates.add(rel.subject.id)
+        out = []
+        for sid in sorted(candidates):
+            if self._check(resource, permission, SubjectRef(subject_type, sid),
+                           0, _Ctx()):
+                out.append(sid)
+        return out
+
+    # -- evaluation ---------------------------------------------------------
+
+    def _check(self, resource: ObjectRef, name: str, subject: SubjectRef,
+               depth: int, ctx: _Ctx) -> bool:
+        if depth > self.max_depth:
+            raise MaxDepthExceededError(
+                f"max dispatch depth {self.max_depth} exceeded checking"
+                f" {resource}#{name}")
+        key = (resource.type, resource.id, name, subject)
+        if key in ctx.memo:
+            return ctx.memo[key]
+        if key in ctx.stack:
+            ctx.hits.add(key)
+            return False  # cycle: revisiting the same node adds nothing new
+        ctx.stack.add(key)
+        try:
+            d = self.schema.definition(resource.type)
+            if name in d.relations:
+                result = self._check_relation(resource, name, subject, depth, ctx)
+            elif name in d.permissions:
+                result = self._eval_expr(d, resource, d.permissions[name],
+                                         subject, depth, ctx)
+            else:
+                raise SchemaError(
+                    f"relation/permission `{name}` not found for {resource.type}")
+        finally:
+            ctx.stack.discard(key)
+            ctx.hits.discard(key)
+        if not (ctx.hits & ctx.stack):
+            ctx.memo[key] = result
+        return result
+
+    def _check_relation(self, resource: ObjectRef, relation: str,
+                        subject: SubjectRef, depth: int, ctx: _Ctx) -> bool:
+        found = False
+        for ts in self.store.subjects_for(resource, relation):
+            if not ts.relation:
+                # direct subject; wildcard matches any direct subject of type
+                if ts.id == WILDCARD:
+                    if ts.type == subject.type and not subject.relation:
+                        found = True
+                        break
+                    continue
+                if ts == subject:
+                    found = True
+                    break
+            else:
+                # userset subject: exact match, or expand recursively
+                if (ts.type == subject.type and ts.id == subject.id
+                        and ts.relation == subject.relation):
+                    found = True
+                    break
+                if self._check(ObjectRef(ts.type, ts.id), ts.relation,
+                               subject, depth + 1, ctx):
+                    found = True
+                    break
+        return found
+
+    def _eval_expr(self, d: sch.Definition, resource: ObjectRef, expr: sch.Expr,
+                   subject: SubjectRef, depth: int, ctx: _Ctx) -> bool:
+        if isinstance(expr, sch.Nil):
+            return False
+        if isinstance(expr, sch.RelRef):
+            return self._check(resource, expr.name, subject, depth + 1, ctx)
+        if isinstance(expr, sch.Arrow):
+            # walk subject objects of the left relation; wildcard and userset
+            # subjects are not traversed by arrows
+            for ts in self.store.subjects_for(resource, expr.left):
+                if ts.id == WILDCARD or ts.relation:
+                    continue
+                target_def = self.schema.definitions.get(ts.type)
+                if (target_def is None
+                        or not target_def.has_relation_or_permission(expr.target)):
+                    continue
+                if self._check(ObjectRef(ts.type, ts.id), expr.target, subject,
+                               depth + 1, ctx):
+                    return True
+            return False
+        if isinstance(expr, sch.Union):
+            return any(self._eval_expr(d, resource, c, subject, depth, ctx)
+                       for c in expr.children)
+        if isinstance(expr, sch.Intersection):
+            return all(self._eval_expr(d, resource, c, subject, depth, ctx)
+                       for c in expr.children)
+        if isinstance(expr, sch.Exclusion):
+            if not self._eval_expr(d, resource, expr.base, subject, depth, ctx):
+                return False
+            return not self._eval_expr(d, resource, expr.subtract, subject,
+                                       depth, ctx)
+        raise SchemaError(f"unknown expression node {expr!r}")

@@ -1,0 +1,302 @@
+"""In-memory relationship (tuple) store.
+
+The host-side source of truth replacing embedded SpiceDB's memory datastore
+(reference pkg/spicedb/spicedb.go:18-71): versioned writes with
+create/touch/delete semantics, filter deletes with `$`-wildcards,
+preconditions, relationship expiration (`use expiration` /
+`with expiration`, used by the dual-write engine's idempotency keys,
+reference activity.go:47-102), read filters, and watch subscriptions.
+
+The device CSR used by the jax:// backend is a cache rebuilt/delta-updated
+from this store (SURVEY.md §5 checkpoint/resume note).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable, Iterable, Optional
+
+from .types import (
+    AlreadyExistsError,
+    ObjectRef,
+    Precondition,
+    PreconditionFailedError,
+    PreconditionOp,
+    Relationship,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    WatchUpdate,
+)
+
+# Max mutations / preconditions per write call, mirroring the embedded
+# server's limits (reference spicedb.go:35-36).
+MAX_UPDATES_PER_WRITE = 1000
+MAX_PRECONDITIONS = 1000
+
+
+class WriteLimitExceededError(Exception):
+    pass
+
+
+class Watcher:
+    """A subscription to relationship updates; drained via poll()."""
+
+    def __init__(self, store: "TupleStore", object_types: Optional[set]):
+        self._store = store
+        self._object_types = object_types
+        self._events: list[WatchUpdate] = []
+        self._cond = threading.Condition()
+        self.closed = False
+
+    def _publish(self, update: WatchUpdate) -> None:
+        if self._object_types:
+            updates = tuple(u for u in update.updates
+                            if u.rel.resource.type in self._object_types)
+            if not updates:
+                return
+            update = WatchUpdate(updates=updates, revision=update.revision)
+        with self._cond:
+            self._events.append(update)
+            self._cond.notify_all()
+
+    def poll(self, timeout: Optional[float] = None) -> Optional[WatchUpdate]:
+        """Block until the next batch (or timeout/close); None on timeout."""
+        with self._cond:
+            if not self._events and not self.closed:
+                self._cond.wait(timeout)
+            if self._events:
+                return self._events.pop(0)
+            return None
+
+    def close(self) -> None:
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+        self._store._unsubscribe(self)
+
+
+@dataclass
+class _Entry:
+    rel: Relationship
+    revision: int
+
+
+class TupleStore:
+    """Thread-safe in-memory tuple store with monotonic revisions."""
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._lock = threading.RLock()
+        self._clock = clock
+        # (resource_type, relation) -> {resource_id -> {subject_key -> _Entry}}
+        self._by_relation: dict = {}
+        self._revision = 0
+        self._watchers: list[Watcher] = []
+        # delta listeners get every committed batch synchronously under the
+        # store lock — used by the jax:// backend for incremental CSR updates.
+        self._delta_listeners: list[Callable[[WatchUpdate], None]] = []
+
+    # -- revision -----------------------------------------------------------
+
+    @property
+    def revision(self) -> int:
+        with self._lock:
+            return self._revision
+
+    # -- reads --------------------------------------------------------------
+
+    def read(self, flt: Optional[RelationshipFilter] = None) -> list:
+        """All live (unexpired) relationships matching the filter."""
+        now = self._clock()
+        out = []
+        with self._lock:
+            for (rtype, relation), by_id in self._by_relation.items():
+                if flt is not None and flt.resource_type and rtype != flt.resource_type:
+                    continue
+                if flt is not None and flt.relation and relation != flt.relation:
+                    continue
+                for rid, subjects in by_id.items():
+                    if flt is not None and flt.resource_id and rid != flt.resource_id:
+                        continue
+                    for entry in subjects.values():
+                        if entry.rel.expired(now):
+                            continue
+                        if flt is None or flt.matches(entry.rel):
+                            out.append(entry.rel)
+        return out
+
+    def subjects_for(self, resource: ObjectRef, relation: str) -> list:
+        """Live subjects of (resource, relation) — evaluator hot path."""
+        now = self._clock()
+        with self._lock:
+            by_id = self._by_relation.get((resource.type, relation))
+            if not by_id:
+                return []
+            subjects = by_id.get(resource.id)
+            if not subjects:
+                return []
+            return [e.rel.subject for e in subjects.values()
+                    if not e.rel.expired(now)]
+
+    def resources_with_relation(self, resource_type: str, relation: str) -> list:
+        """Live resource ids having any tuple for (type, relation)."""
+        now = self._clock()
+        with self._lock:
+            by_id = self._by_relation.get((resource_type, relation))
+            if not by_id:
+                return []
+            return [rid for rid, subjects in by_id.items()
+                    if any(not e.rel.expired(now) for e in subjects.values())]
+
+    def object_ids_of_type(self, resource_type: str) -> list:
+        """All ids appearing as a resource of the given type (live tuples)."""
+        now = self._clock()
+        ids = set()
+        with self._lock:
+            for (rtype, _), by_id in self._by_relation.items():
+                if rtype != resource_type:
+                    continue
+                for rid, subjects in by_id.items():
+                    if any(not e.rel.expired(now) for e in subjects.values()):
+                        ids.add(rid)
+        return sorted(ids)
+
+    def has_exact(self, rel: Relationship) -> bool:
+        now = self._clock()
+        with self._lock:
+            by_id = self._by_relation.get((rel.resource.type, rel.relation), {})
+            entry = by_id.get(rel.resource.id, {}).get(rel.subject)
+            return entry is not None and not entry.rel.expired(now)
+
+    def count(self) -> int:
+        return len(self.read())
+
+    # -- writes -------------------------------------------------------------
+
+    def write(self, updates: Iterable[RelationshipUpdate],
+              preconditions: Iterable[Precondition] = ()) -> int:
+        """Atomically apply updates after checking preconditions; returns the
+        new revision (the zedtoken equivalent)."""
+        updates = list(updates)
+        preconditions = list(preconditions)
+        if len(updates) > MAX_UPDATES_PER_WRITE:
+            raise WriteLimitExceededError(
+                f"{len(updates)} updates exceeds limit {MAX_UPDATES_PER_WRITE}")
+        if len(preconditions) > MAX_PRECONDITIONS:
+            raise WriteLimitExceededError(
+                f"{len(preconditions)} preconditions exceeds limit {MAX_PRECONDITIONS}")
+        with self._lock:
+            self._check_preconditions(preconditions)
+            # validate CREATEs before mutating (atomicity)
+            now = self._clock()
+            for u in updates:
+                if u.op == UpdateOp.CREATE and self._live_entry(u.rel, now) is not None:
+                    raise AlreadyExistsError(
+                        f"relationship already exists: {u.rel.rel_string()}")
+            self._revision += 1
+            rev = self._revision
+            applied = []
+            for u in updates:
+                if u.op in (UpdateOp.CREATE, UpdateOp.TOUCH):
+                    self._put(u.rel, rev)
+                    applied.append(RelationshipUpdate(UpdateOp.TOUCH, u.rel))
+                elif u.op == UpdateOp.DELETE:
+                    if self._remove(u.rel):
+                        applied.append(RelationshipUpdate(UpdateOp.DELETE, u.rel))
+            if applied:
+                self._broadcast(WatchUpdate(updates=tuple(applied), revision=rev))
+            return rev
+
+    def delete_by_filter(self, flt: RelationshipFilter,
+                         preconditions: Iterable[Precondition] = ()) -> tuple:
+        """Delete all relationships matching the filter; returns
+        (revision, deleted relationships)."""
+        with self._lock:
+            self._check_preconditions(list(preconditions))
+            victims = self.read(flt)
+            if not victims:
+                return self._revision, []
+            self._revision += 1
+            rev = self._revision
+            applied = []
+            for rel in victims:
+                if self._remove(rel):
+                    applied.append(RelationshipUpdate(UpdateOp.DELETE, rel))
+            if applied:
+                self._broadcast(WatchUpdate(updates=tuple(applied), revision=rev))
+            return rev, victims
+
+    def delete_all(self) -> None:
+        """Test helper (mirrors the reference e2e DeleteAllTuples util)."""
+        with self._lock:
+            self._by_relation.clear()
+            self._revision += 1
+
+    # -- watch --------------------------------------------------------------
+
+    def subscribe(self, object_types: Optional[Iterable[str]] = None) -> Watcher:
+        w = Watcher(self, set(object_types) if object_types else None)
+        with self._lock:
+            self._watchers.append(w)
+        return w
+
+    def _unsubscribe(self, w: Watcher) -> None:
+        with self._lock:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+    def add_delta_listener(self, fn: Callable[[WatchUpdate], None]) -> None:
+        with self._lock:
+            self._delta_listeners.append(fn)
+
+    def remove_delta_listener(self, fn: Callable[[WatchUpdate], None]) -> None:
+        with self._lock:
+            if fn in self._delta_listeners:
+                self._delta_listeners.remove(fn)
+
+    # -- internals ----------------------------------------------------------
+
+    def _live_entry(self, rel: Relationship, now: float) -> Optional[_Entry]:
+        by_id = self._by_relation.get((rel.resource.type, rel.relation), {})
+        entry = by_id.get(rel.resource.id, {}).get(rel.subject)
+        if entry is None or entry.rel.expired(now):
+            return None
+        return entry
+
+    def _put(self, rel: Relationship, rev: int) -> None:
+        key = (rel.resource.type, rel.relation)
+        by_id = self._by_relation.setdefault(key, {})
+        subjects = by_id.setdefault(rel.resource.id, {})
+        subjects[rel.subject] = _Entry(rel=rel, revision=rev)
+
+    def _remove(self, rel: Relationship) -> bool:
+        key = (rel.resource.type, rel.relation)
+        by_id = self._by_relation.get(key)
+        if not by_id:
+            return False
+        subjects = by_id.get(rel.resource.id)
+        if not subjects or rel.subject not in subjects:
+            return False
+        del subjects[rel.subject]
+        if not subjects:
+            del by_id[rel.resource.id]
+        if not by_id:
+            del self._by_relation[key]
+        return True
+
+    def _check_preconditions(self, preconditions: list) -> None:
+        for p in preconditions:
+            matched = bool(self.read(p.filter))
+            if p.op == PreconditionOp.MUST_MATCH and not matched:
+                raise PreconditionFailedError(p)
+            if p.op == PreconditionOp.MUST_NOT_MATCH and matched:
+                raise PreconditionFailedError(p)
+
+    def _broadcast(self, update: WatchUpdate) -> None:
+        for fn in list(self._delta_listeners):
+            fn(update)
+        for w in list(self._watchers):
+            w._publish(update)

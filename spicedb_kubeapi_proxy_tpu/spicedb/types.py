@@ -1,0 +1,203 @@
+"""Core relationship-store data types.
+
+These mirror the subset of the authzed API v1 surface the reference proxy
+consumes (see SURVEY.md §5: CheckPermission, CheckBulkPermissions,
+LookupResources, ReadRelationships, WriteRelationships, DeleteRelationships,
+Watch), expressed as plain Python dataclasses rather than protobufs.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from dataclasses import dataclass, field, replace
+from enum import Enum
+from typing import Any, Iterable, Optional
+
+# Subject relation value meaning "the subject object itself" (authzed API's
+# ellipsis relation).
+ELLIPSIS = "..."
+
+# Wildcard subject id (`user:*`).
+WILDCARD = "*"
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    type: str
+    id: str
+
+    def __str__(self) -> str:
+        return f"{self.type}:{self.id}"
+
+
+@dataclass(frozen=True)
+class SubjectRef:
+    type: str
+    id: str
+    relation: str = ""  # "" == direct subject (ellipsis)
+
+    def __str__(self) -> str:
+        s = f"{self.type}:{self.id}"
+        if self.relation:
+            s += f"#{self.relation}"
+        return s
+
+
+@dataclass(frozen=True)
+class Relationship:
+    resource: ObjectRef
+    relation: str
+    subject: SubjectRef
+    expires_at: Optional[float] = None  # unix seconds; None = no expiration
+
+    def rel_string(self) -> str:
+        s = f"{self.resource}#{self.relation}@{self.subject}"
+        if self.expires_at is not None:
+            s += f"[expiration:{self.expires_at}]"
+        return s
+
+    def key(self) -> tuple:
+        """Identity key — expiration is an attribute, not part of identity."""
+        return (self.resource.type, self.resource.id, self.relation,
+                self.subject.type, self.subject.id, self.subject.relation)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.expires_at is None:
+            return False
+        return (now if now is not None else time.time()) >= self.expires_at
+
+
+_EXPIRATION_SUFFIX = re.compile(r"\[expiration:([^\]]+)\]$")
+
+
+def parse_relationship(rel: str) -> Relationship:
+    """Parse a concrete `type:id#rel@type:id(#rel)` string (no templates)."""
+    expires_at: Optional[float] = None
+    m = _EXPIRATION_SUFFIX.search(rel)
+    if m:
+        expires_at = float(m.group(1))
+        rel = rel[: m.start()]
+    from ..rules.relstring import parse_rel_string  # local import, avoids cycle
+    u = parse_rel_string(rel)
+    for fieldval in (u.resource_type, u.resource_id, u.resource_relation,
+                     u.subject_type, u.subject_id):
+        if "{{" in fieldval or not fieldval:
+            raise ValueError(f"not a concrete relationship: {rel!r}")
+    subject_relation = u.subject_relation
+    if subject_relation == ELLIPSIS:
+        subject_relation = ""
+    return Relationship(
+        resource=ObjectRef(u.resource_type, u.resource_id),
+        relation=u.resource_relation,
+        subject=SubjectRef(u.subject_type, u.subject_id, subject_relation),
+        expires_at=expires_at,
+    )
+
+
+class UpdateOp(Enum):
+    CREATE = "create"   # error if the relationship already exists
+    TOUCH = "touch"     # upsert
+    DELETE = "delete"   # remove if present
+
+
+@dataclass(frozen=True)
+class RelationshipUpdate:
+    op: UpdateOp
+    rel: Relationship
+
+
+@dataclass(frozen=True)
+class SubjectFilter:
+    type: str = ""
+    id: str = ""
+    relation: Optional[str] = None  # None = any; "" = direct only
+
+    def matches(self, s: SubjectRef) -> bool:
+        if self.type and s.type != self.type:
+            return False
+        if self.id and s.id != self.id:
+            return False
+        if self.relation is not None and s.relation != self.relation:
+            return False
+        return True
+
+
+@dataclass(frozen=True)
+class RelationshipFilter:
+    """All empty fields match everything (reference update.go:197-271 builds
+    these from `$`-wildcard template fields)."""
+    resource_type: str = ""
+    resource_id: str = ""
+    relation: str = ""
+    subject: Optional[SubjectFilter] = None
+
+    def matches(self, r: Relationship) -> bool:
+        if self.resource_type and r.resource.type != self.resource_type:
+            return False
+        if self.resource_id and r.resource.id != self.resource_id:
+            return False
+        if self.relation and r.relation != self.relation:
+            return False
+        if self.subject is not None and not self.subject.matches(r.subject):
+            return False
+        return True
+
+
+class PreconditionOp(Enum):
+    MUST_MATCH = "must_match"
+    MUST_NOT_MATCH = "must_not_match"
+
+
+@dataclass(frozen=True)
+class Precondition:
+    op: PreconditionOp
+    filter: RelationshipFilter
+
+
+class Permissionship(Enum):
+    NO_PERMISSION = 0
+    HAS_PERMISSION = 1
+    CONDITIONAL_PERMISSION = 2  # reserved for caveats; LR skips these
+
+
+@dataclass(frozen=True)
+class CheckRequest:
+    resource: ObjectRef
+    permission: str
+    subject: SubjectRef
+
+
+@dataclass
+class CheckResult:
+    permissionship: Permissionship
+    checked_at: int = 0  # store revision
+
+    @property
+    def allowed(self) -> bool:
+        return self.permissionship == Permissionship.HAS_PERMISSION
+
+
+@dataclass(frozen=True)
+class WatchUpdate:
+    """One batch of relationship updates at a revision."""
+    updates: tuple  # tuple[RelationshipUpdate, ...]
+    revision: int
+
+
+class PreconditionFailedError(Exception):
+    def __init__(self, precondition: Precondition):
+        self.precondition = precondition
+        super().__init__(f"precondition failed: {precondition}")
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+class SchemaError(Exception):
+    pass
+
+
+class MaxDepthExceededError(Exception):
+    pass

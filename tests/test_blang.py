@@ -206,3 +206,27 @@ class TestParseErrors:
     def test_bad_input(self, src):
         with pytest.raises(blang.BlangParseError):
             ENV.parse(src)
+
+
+class TestReviewRegressions:
+    def test_split_empty_separator_splits_chars(self):
+        assert q('"abc".split("")') == ["a", "b", "c"]
+
+    def test_or_lazy_on_error(self):
+        assert q('"a".number().or(0)') == 0
+        assert q('this.missing.or("fb")', {"a": 1}) == "fb"
+        assert q('this.a.or(9)', {"a": 1}) == 1
+
+    def test_let_terminated_by_newline(self):
+        assert q('let a = this.name\n["x", $a]', {"name": "n"}) == ["x", "n"]
+        # the newline ends the let RHS; the next line is the result expression
+        assert q('let a = this.n\n-1', {"n": 5}) == -1
+
+    def test_let_rhs_can_span_brackets(self):
+        assert q('let a = [1,\n2]\n$a', {}) == [1, 2]
+
+    def test_wrong_arity_is_blang_error(self):
+        with pytest.raises(blang.BlangEvalError):
+            q('"a/b".split()')
+        with pytest.raises(blang.BlangEvalError):
+            q('"abc".contains()')

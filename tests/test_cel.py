@@ -101,3 +101,20 @@ class TestEvalErrors:
     def test_type_error_in_logic(self):
         with pytest.raises(cel.CELEvalError):
             cel.compile_condition("user.name && true").eval(ACT)
+
+
+class TestMacros:
+    def test_exists(self):
+        assert run("user.groups.exists(g, g == 'dev')") is True
+        assert run("user.groups.exists(g, g == 'nope')") is False
+
+    def test_all(self):
+        assert run("user.groups.all(g, g.size() > 0)") is True
+        assert run("user.groups.all(g, g == 'dev')") is False
+
+    def test_exists_one(self):
+        assert run("user.groups.exists_one(g, g == 'dev')") is True
+
+    def test_macro_bad_args(self):
+        with pytest.raises(cel.CELEvalError):
+            run("user.groups.exists(g)")

@@ -1,0 +1,478 @@
+"""Schema DSL, tuple store, and host evaluator (oracle) tests."""
+
+import asyncio
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+    Bootstrap,
+    EmbeddedEndpoint,
+    EndpointConfigError,
+    create_endpoint,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    AlreadyExistsError,
+    CheckRequest,
+    MaxDepthExceededError,
+    ObjectRef,
+    Precondition,
+    PreconditionFailedError,
+    PreconditionOp,
+    Relationship,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SchemaError,
+    SubjectFilter,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+BOOTSTRAP_SCHEMA = """
+use expiration
+
+definition cluster {}
+definition user {}
+definition namespace {
+  relation cluster: cluster
+  relation creator: user
+  relation viewer: user
+
+  permission admin = creator
+  permission edit = creator
+  permission view = viewer + creator
+  permission no_one_at_all = nil
+}
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user
+  permission edit = creator
+  permission view = viewer + creator
+}
+"""
+
+
+def rel(s):
+    return parse_relationship(s)
+
+
+def touch(*rels):
+    return [RelationshipUpdate(UpdateOp.TOUCH, rel(r)) for r in rels]
+
+
+class TestSchemaParser:
+    def test_bootstrap_schema_parses(self):
+        s = sch.parse_schema(BOOTSTRAP_SCHEMA)
+        assert set(s.definitions) == {"cluster", "user", "namespace", "pod"}
+        assert s.uses == ("expiration",)
+        ns = s.definitions["namespace"]
+        assert set(ns.relations) == {"cluster", "creator", "viewer"}
+        assert set(ns.permissions) == {"admin", "edit", "view", "no_one_at_all"}
+        assert isinstance(ns.permissions["view"], sch.Union)
+        assert isinstance(ns.permissions["no_one_at_all"], sch.Nil)
+
+    def test_subject_relation_and_wildcard(self):
+        s = sch.parse_schema("""
+definition user {}
+definition group {
+  relation member: user | group#member | user:*
+}
+""")
+        refs = s.definitions["group"].relations["member"]
+        assert refs[0] == sch.TypeRef("user")
+        assert refs[1] == sch.TypeRef("group", relation="member")
+        assert refs[2] == sch.TypeRef("user", wildcard=True)
+
+    def test_with_expiration_trait(self):
+        s = sch.parse_schema("""
+definition activity {}
+definition workflow {
+  relation idempotency_key: activity with expiration
+}
+""")
+        ref = s.definitions["workflow"].relations["idempotency_key"][0]
+        assert ref.traits == ("expiration",)
+
+    def test_arrow_and_operators(self):
+        s = sch.parse_schema("""
+definition user {}
+definition org { relation admin: user }
+definition doc {
+  relation org: org
+  relation writer: user
+  relation banned: user
+  permission edit = (writer + org->admin) & writer - banned
+}
+""")
+        e = s.definitions["doc"].permissions["edit"]
+        assert isinstance(e, sch.Intersection)
+
+    def test_comments(self):
+        s = sch.parse_schema("""
+// line comment
+definition user {} /* block
+comment */ definition t { relation u: user }
+""")
+        assert set(s.definitions) == {"user", "t"}
+
+    def test_unknown_subject_type_rejected(self):
+        with pytest.raises(SchemaError, match="unknown subject type"):
+            sch.parse_schema("definition t { relation r: missing }")
+
+    def test_unknown_permission_target_rejected(self):
+        with pytest.raises(SchemaError, match="unknown relation"):
+            sch.parse_schema("definition t { permission p = nope }")
+
+    def test_duplicate_definition_rejected(self):
+        with pytest.raises(SchemaError, match="duplicate"):
+            sch.parse_schema("definition t {} definition t {}")
+
+    def test_caveat_skipped(self):
+        s = sch.parse_schema("""
+caveat only_on_tuesday(day string) { day == "tuesday" }
+definition user {}
+""")
+        assert set(s.definitions) == {"user"}
+
+
+class TestTupleStore:
+    def test_create_touch_delete(self):
+        st = TupleStore()
+        st.write([RelationshipUpdate(UpdateOp.CREATE, rel("namespace:a#creator@user:u1"))])
+        assert st.has_exact(rel("namespace:a#creator@user:u1"))
+        with pytest.raises(AlreadyExistsError):
+            st.write([RelationshipUpdate(UpdateOp.CREATE, rel("namespace:a#creator@user:u1"))])
+        st.write(touch("namespace:a#creator@user:u1"))  # touch is idempotent
+        st.write([RelationshipUpdate(UpdateOp.DELETE, rel("namespace:a#creator@user:u1"))])
+        assert not st.has_exact(rel("namespace:a#creator@user:u1"))
+
+    def test_atomic_create_failure_leaves_store_unchanged(self):
+        st = TupleStore()
+        st.write(touch("a:1#r@user:u"))
+        with pytest.raises(AlreadyExistsError):
+            st.write([
+                RelationshipUpdate(UpdateOp.TOUCH, rel("a:2#r@user:u")),
+                RelationshipUpdate(UpdateOp.CREATE, rel("a:1#r@user:u")),
+            ])
+        assert not st.has_exact(rel("a:2#r@user:u"))
+
+    def test_preconditions(self):
+        st = TupleStore()
+        st.write(touch("namespace:a#creator@user:u1"))
+        must = Precondition(PreconditionOp.MUST_MATCH,
+                            RelationshipFilter(resource_type="namespace",
+                                               resource_id="a"))
+        must_not = Precondition(PreconditionOp.MUST_NOT_MATCH,
+                                RelationshipFilter(resource_type="namespace",
+                                                   resource_id="b"))
+        st.write(touch("namespace:a#viewer@user:u2"), [must, must_not])
+        bad = Precondition(PreconditionOp.MUST_NOT_MATCH,
+                           RelationshipFilter(resource_type="namespace",
+                                              resource_id="a"))
+        with pytest.raises(PreconditionFailedError):
+            st.write(touch("namespace:c#creator@user:u1"), [bad])
+        assert not st.has_exact(rel("namespace:c#creator@user:u1"))
+
+    def test_filters(self):
+        st = TupleStore()
+        st.write(touch(
+            "pod:ns/p1#creator@user:u1",
+            "pod:ns/p2#creator@user:u2",
+            "pod:ns/p1#viewer@user:u2",
+            "namespace:ns#creator@user:u1",
+        ))
+        assert len(st.read(RelationshipFilter(resource_type="pod"))) == 3
+        assert len(st.read(RelationshipFilter(resource_type="pod",
+                                              relation="creator"))) == 2
+        assert len(st.read(RelationshipFilter(
+            subject=SubjectFilter(type="user", id="u2")))) == 2
+        assert len(st.read(RelationshipFilter(resource_id="ns/p1"))) == 2
+
+    def test_delete_by_filter(self):
+        st = TupleStore()
+        st.write(touch("pod:ns/p1#creator@user:u1", "pod:ns/p2#creator@user:u1",
+                       "namespace:ns#creator@user:u1"))
+        _, deleted = st.delete_by_filter(RelationshipFilter(resource_type="pod"))
+        assert len(deleted) == 2
+        assert len(st.read()) == 1
+
+    def test_expiration(self):
+        now = [1000.0]
+        st = TupleStore(clock=lambda: now[0])
+        r = Relationship(ObjectRef("workflow", "w1"), "idempotency_key",
+                         SubjectRef("activity", "a1"), expires_at=1010.0)
+        st.write([RelationshipUpdate(UpdateOp.TOUCH, r)])
+        assert st.has_exact(r)
+        now[0] = 1011.0
+        assert not st.has_exact(r)
+        assert st.read() == []
+        # expired entry can be re-created
+        st.write([RelationshipUpdate(UpdateOp.CREATE, r)])
+
+    def test_revision_monotonic(self):
+        st = TupleStore()
+        r0 = st.revision
+        r1 = st.write(touch("a:1#r@user:u"))
+        r2 = st.write(touch("a:2#r@user:u"))
+        assert r0 < r1 < r2
+
+    def test_watch(self):
+        st = TupleStore()
+        w = st.subscribe(object_types=["pod"])
+        st.write(touch("namespace:ns#creator@user:u1"))  # filtered out
+        st.write(touch("pod:ns/p1#creator@user:u1"))
+        ev = w.poll(timeout=1)
+        assert ev is not None
+        assert ev.updates[0].rel.resource.type == "pod"
+        assert ev.updates[0].op == UpdateOp.TOUCH
+        st.write([RelationshipUpdate(UpdateOp.DELETE, rel("pod:ns/p1#creator@user:u1"))])
+        ev2 = w.poll(timeout=1)
+        assert ev2.updates[0].op == UpdateOp.DELETE
+        w.close()
+        assert w.poll(timeout=0.01) is None
+
+    def test_delete_nonexistent_emits_no_event(self):
+        st = TupleStore()
+        w = st.subscribe()
+        st.write([RelationshipUpdate(UpdateOp.DELETE, rel("a:1#r@user:u"))])
+        assert w.poll(timeout=0.05) is None
+
+
+def make_eval(schema_text, rels):
+    schema = sch.parse_schema(schema_text)
+    store = TupleStore()
+    if rels:
+        store.write(touch(*rels))
+    return Evaluator(schema, store), store
+
+
+GROUPS_SCHEMA = """
+definition user {}
+definition group {
+  relation member: user | group#member
+}
+definition team {
+  relation member: user | group#member
+}
+definition namespace {
+  relation viewer: user | group#member | team#member
+  permission view = viewer
+}
+"""
+
+
+class TestEvaluator:
+    def test_direct_relation(self):
+        ev, _ = make_eval(BOOTSTRAP_SCHEMA, ["namespace:a#creator@user:u1"])
+        assert ev.check(ObjectRef("namespace", "a"), "creator", SubjectRef("user", "u1"))
+        assert not ev.check(ObjectRef("namespace", "a"), "creator", SubjectRef("user", "u2"))
+
+    def test_union_permission(self):
+        ev, _ = make_eval(BOOTSTRAP_SCHEMA, [
+            "namespace:a#creator@user:owner",
+            "namespace:a#viewer@user:watcher",
+        ])
+        for u in ("owner", "watcher"):
+            assert ev.check(ObjectRef("namespace", "a"), "view", SubjectRef("user", u))
+        assert not ev.check(ObjectRef("namespace", "a"), "view", SubjectRef("user", "nobody"))
+        assert ev.check(ObjectRef("namespace", "a"), "admin", SubjectRef("user", "owner"))
+        assert not ev.check(ObjectRef("namespace", "a"), "admin", SubjectRef("user", "watcher"))
+
+    def test_nil_permission(self):
+        ev, _ = make_eval(BOOTSTRAP_SCHEMA, ["namespace:a#creator@user:u"])
+        assert not ev.check(ObjectRef("namespace", "a"), "no_one_at_all", SubjectRef("user", "u"))
+
+    def test_nested_groups_depth4(self):
+        ev, _ = make_eval(GROUPS_SCHEMA, [
+            "group:inner#member@user:alice",
+            "group:outer#member@group:inner#member",
+            "team:t#member@group:outer#member",
+            "namespace:ns#viewer@team:t#member",
+        ])
+        assert ev.check(ObjectRef("namespace", "ns"), "view", SubjectRef("user", "alice"))
+        assert not ev.check(ObjectRef("namespace", "ns"), "view", SubjectRef("user", "bob"))
+
+    def test_userset_exact_match(self):
+        ev, _ = make_eval(GROUPS_SCHEMA, [
+            "namespace:ns#viewer@group:g#member",
+        ])
+        assert ev.check(ObjectRef("namespace", "ns"), "view",
+                        SubjectRef("group", "g", "member"))
+
+    def test_wildcard(self):
+        schema = """
+definition user {}
+definition doc {
+  relation viewer: user | user:*
+  permission view = viewer
+}
+"""
+        ev, _ = make_eval(schema, ["doc:d#viewer@user:*"])
+        assert ev.check(ObjectRef("doc", "d"), "view", SubjectRef("user", "anyone"))
+        # wildcard does not satisfy userset subjects
+        assert not ev.check(ObjectRef("doc", "d"), "view",
+                            SubjectRef("group", "g", "member"))
+
+    def test_intersection_exclusion(self):
+        schema = """
+definition user {}
+definition doc {
+  relation assigned: user
+  relation approved: user
+  relation banned: user
+  permission edit = assigned & approved - banned
+}
+"""
+        ev, _ = make_eval(schema, [
+            "doc:d#assigned@user:a", "doc:d#approved@user:a",
+            "doc:d#assigned@user:b",
+            "doc:d#assigned@user:c", "doc:d#approved@user:c", "doc:d#banned@user:c",
+        ])
+        assert ev.check(ObjectRef("doc", "d"), "edit", SubjectRef("user", "a"))
+        assert not ev.check(ObjectRef("doc", "d"), "edit", SubjectRef("user", "b"))
+        assert not ev.check(ObjectRef("doc", "d"), "edit", SubjectRef("user", "c"))
+
+    def test_arrow(self):
+        schema = """
+definition user {}
+definition namespace {
+  relation admin: user
+  permission admin_perm = admin
+}
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  permission edit = creator + namespace->admin_perm
+}
+"""
+        ev, _ = make_eval(schema, [
+            "namespace:ns#admin@user:boss",
+            "pod:ns/p#namespace@namespace:ns",
+            "pod:ns/p#creator@user:dev",
+        ])
+        assert ev.check(ObjectRef("pod", "ns/p"), "edit", SubjectRef("user", "dev"))
+        assert ev.check(ObjectRef("pod", "ns/p"), "edit", SubjectRef("user", "boss"))
+        assert not ev.check(ObjectRef("pod", "ns/p"), "edit", SubjectRef("user", "rando"))
+
+    def test_cyclic_groups_terminate(self):
+        ev, _ = make_eval(GROUPS_SCHEMA, [
+            "group:a#member@group:b#member",
+            "group:b#member@group:a#member",
+            "group:b#member@user:alice",
+            "namespace:ns#viewer@group:a#member",
+        ])
+        assert ev.check(ObjectRef("namespace", "ns"), "view", SubjectRef("user", "alice"))
+        assert not ev.check(ObjectRef("namespace", "ns"), "view", SubjectRef("user", "bob"))
+
+    def test_cycle_memo_not_poisoned(self):
+        # checking `a` first must not cache a stale False for `b`
+        ev, _ = make_eval(GROUPS_SCHEMA, [
+            "group:a#member@group:b#member",
+            "group:b#member@group:a#member",
+            "group:a#member@user:alice",
+        ])
+        assert ev.check(ObjectRef("group", "a"), "member", SubjectRef("user", "alice"))
+        assert ev.check(ObjectRef("group", "b"), "member", SubjectRef("user", "alice"))
+
+    def test_max_depth(self):
+        rels = [f"group:g{i}#member@group:g{i+1}#member" for i in range(60)]
+        rels.append("group:g60#member@user:deep")
+        ev, _ = make_eval(GROUPS_SCHEMA, rels)
+        with pytest.raises(MaxDepthExceededError):
+            ev.check(ObjectRef("group", "g0"), "member", SubjectRef("user", "deep"))
+
+    def test_unknown_relation_errors(self):
+        ev, _ = make_eval(BOOTSTRAP_SCHEMA, [])
+        with pytest.raises(SchemaError):
+            ev.check(ObjectRef("namespace", "a"), "nope", SubjectRef("user", "u"))
+
+    def test_lookup_resources(self):
+        ev, _ = make_eval(BOOTSTRAP_SCHEMA, [
+            "namespace:a#creator@user:u1",
+            "namespace:b#viewer@user:u1",
+            "namespace:c#creator@user:u2",
+        ])
+        assert ev.lookup_resources("namespace", "view", SubjectRef("user", "u1")) == ["a", "b"]
+        assert ev.lookup_resources("namespace", "view", SubjectRef("user", "u2")) == ["c"]
+        assert ev.lookup_resources("namespace", "view", SubjectRef("user", "u3")) == []
+
+    def test_lookup_resources_nested(self):
+        ev, _ = make_eval(GROUPS_SCHEMA, [
+            "group:eng#member@user:alice",
+            "namespace:ns1#viewer@group:eng#member",
+            "namespace:ns2#viewer@user:alice",
+            "namespace:ns3#viewer@user:bob",
+        ])
+        assert ev.lookup_resources("namespace", "view", SubjectRef("user", "alice")) == ["ns1", "ns2"]
+
+    def test_lookup_subjects(self):
+        ev, _ = make_eval(BOOTSTRAP_SCHEMA, [
+            "namespace:a#creator@user:u1",
+            "namespace:a#viewer@user:u2",
+            "namespace:b#viewer@user:u3",
+        ])
+        assert ev.lookup_subjects(ObjectRef("namespace", "a"), "view", "user") == ["u1", "u2"]
+
+
+class TestEmbeddedEndpoint:
+    def test_bootstrap_and_verbs(self):
+        bs = Bootstrap(schema_text=BOOTSTRAP_SCHEMA,
+                       relationships_text="namespace:spicedb-kubeapi-proxy#viewer@user:rakis\n")
+        ep = EmbeddedEndpoint.from_bootstrap(bs)
+
+        async def run():
+            res = await ep.check_permission(CheckRequest(
+                ObjectRef("namespace", "spicedb-kubeapi-proxy"), "view",
+                SubjectRef("user", "rakis")))
+            assert res.allowed
+            bulk = await ep.check_bulk_permissions([
+                CheckRequest(ObjectRef("namespace", "spicedb-kubeapi-proxy"),
+                             "view", SubjectRef("user", "rakis")),
+                CheckRequest(ObjectRef("namespace", "spicedb-kubeapi-proxy"),
+                             "view", SubjectRef("user", "other")),
+            ])
+            assert [b.allowed for b in bulk] == [True, False]
+            ids = await ep.lookup_resources("namespace", "view",
+                                            SubjectRef("user", "rakis"))
+            assert ids == ["spicedb-kubeapi-proxy"]
+        asyncio.run(run())
+
+    def test_create_endpoint_dispatch(self):
+        ep = create_endpoint("embedded://")
+        assert isinstance(ep, EmbeddedEndpoint)
+        with pytest.raises(EndpointConfigError, match="grpcio"):
+            create_endpoint("grpc://localhost:50051")
+        with pytest.raises(EndpointConfigError, match="unsupported"):
+            create_endpoint("carrier-pigeon://x")
+
+    def test_default_bootstrap_schema(self):
+        ep = create_endpoint("embedded://")
+        assert "workflow" in ep.schema.definitions
+        assert "lock" in ep.schema.definitions
+
+
+class TestRelationshipParsing:
+    def test_round_trip(self):
+        r = rel("pod:ns/p1#creator@user:alice")
+        assert r.rel_string() == "pod:ns/p1#creator@user:alice"
+
+    def test_subject_relation(self):
+        r = rel("namespace:ns#viewer@group:eng#member")
+        assert r.subject.relation == "member"
+
+    def test_ellipsis_normalized(self):
+        r = rel("namespace:ns#viewer@user:u#...")
+        assert r.subject.relation == ""
+
+    def test_expiration_suffix(self):
+        r = rel("workflow:w#idempotency_key@activity:a[expiration:12345.5]")
+        assert r.expires_at == 12345.5
+        assert r.rel_string().endswith("[expiration:12345.5]")
+
+    def test_template_rejected(self):
+        with pytest.raises(ValueError):
+            rel("pod:{{name}}#view@user:u")
