@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Benchmark harness: authz checks/sec, jax:// kernel vs embedded oracle.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The headline config follows BASELINE.json: filtering list requests against a
+1M-tuple multi-tenant depth-4 graph, 256 concurrent list subjects, on one
+TPU chip.  `value` is effective authz checks/sec through LookupResources
+(each batched LR answers <permission> for every object of the listed type,
+i.e. batch_size x num_objects checks per kernel invocation); `vs_baseline`
+is the speedup over the embedded (host oracle) backend on the same workload.
+
+All progress/diagnostics go to stderr; stdout carries only the JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+# NOTE: do not touch JAX_PLATFORMS/PYTHONPATH here — the driver environment
+# routes jax to the real TPU chip.
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_endpoint(workload, kind: str):
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import parse_relationship
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+
+    schema = sch.parse_schema(workload.schema_text)
+    t0 = time.time()
+    rels = [parse_relationship(r) for r in workload.relationships]
+    log(f"parsed {len(rels)} tuples in {time.time() - t0:.1f}s")
+    ep = (JaxEndpoint(schema) if kind == "jax" else EmbeddedEndpoint(schema))
+    ep.store.bulk_load(rels)
+    return ep
+
+
+def bench_jax(workload, batch: int, rounds: int) -> dict:
+    import asyncio
+
+    ep = build_endpoint(workload, "jax")
+    subjects = [s for s in workload.subjects]
+
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+    def batch_subjects(r):
+        base = (r * batch) % max(1, len(subjects) - batch)
+        return [SubjectRef("user", subjects[(base + i) % len(subjects)])
+                for i in range(batch)]
+
+    async def run():
+        # warmup: builds device graph + compiles the kernel
+        t0 = time.time()
+        first = await ep.lookup_resources_batch(
+            workload.resource_type, workload.permission, batch_subjects(0))
+        warm = time.time() - t0
+        n_obj = len(ep.store.object_ids_of_type(workload.resource_type))
+        log(f"jax warmup {warm:.1f}s (graph build + XLA compile);"
+            f" {n_obj} objects of type {workload.resource_type};"
+            f" first batch allowed sizes sample"
+            f" {[len(x) for x in first[:4]]}")
+        times = []
+        for r in range(rounds):
+            t0 = time.time()
+            await ep.lookup_resources_batch(
+                workload.resource_type, workload.permission,
+                batch_subjects(r + 1))
+            times.append(time.time() - t0)
+        per_batch = statistics.median(times)
+        checks = batch * n_obj
+        return {
+            "per_batch_s": per_batch,
+            "p99_s": sorted(times)[max(0, int(len(times) * 0.99) - 1)],
+            "checks_per_s": checks / per_batch,
+            "objects": n_obj,
+            "warmup_s": warm,
+        }
+
+    return asyncio.run(run())
+
+
+def bench_oracle(workload, queries: int) -> dict:
+    import asyncio
+
+    ep = build_endpoint(workload, "embedded")
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+    async def run():
+        n_obj = len(ep.store.object_ids_of_type(workload.resource_type))
+        times = []
+        for i in range(queries):
+            s = SubjectRef("user", workload.subjects[i % len(workload.subjects)])
+            t0 = time.time()
+            await ep.lookup_resources(workload.resource_type,
+                                      workload.permission, s)
+            times.append(time.time() - t0)
+        per_query = statistics.median(times)
+        return {
+            "per_query_s": per_query,
+            "checks_per_s": n_obj / per_query,
+            "objects": n_obj,
+        }
+
+    return asyncio.run(run())
+
+
+CONFIGS = {
+    "namespace-baseline": ("namespace_baseline", {}),
+    "pods-depth1": ("pods_depth1", {}),
+    "nested-groups-depth4": ("nested_groups", {}),
+    "rbac-deny": ("rbac_deny", {}),
+    "multitenant-1m": ("multitenant_1m", {}),
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="multitenant-1m", choices=CONFIGS)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--oracle-queries", type=int, default=2)
+    ap.add_argument("--all", action="store_true",
+                    help="run every config; headline metric stays the default config")
+    args = ap.parse_args()
+
+    sys.path.insert(0, ".")
+    from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+
+    def run_one(name):
+        fn_name, kw = CONFIGS[name]
+        workload = getattr(wl, fn_name)(**kw)
+        log(f"== config {name}: {len(workload.relationships)} tuples ==")
+        jax_res = bench_jax(workload, args.batch, args.rounds)
+        log(f"jax: {jax_res['checks_per_s']:.3g} checks/s"
+            f" ({jax_res['per_batch_s'] * 1000:.1f} ms / {args.batch}-batch)")
+        oracle_res = bench_oracle(workload, args.oracle_queries)
+        log(f"oracle: {oracle_res['checks_per_s']:.3g} checks/s"
+            f" ({oracle_res['per_query_s'] * 1000:.1f} ms / query)")
+        return jax_res, oracle_res
+
+    if args.all:
+        for name in CONFIGS:
+            if name == args.config:
+                continue
+            try:
+                run_one(name)
+            except Exception as e:  # keep the headline alive
+                log(f"config {name} failed: {e!r}")
+
+    jax_res, oracle_res = run_one(args.config)
+    speedup = jax_res["checks_per_s"] / max(oracle_res["checks_per_s"], 1e-9)
+    print(json.dumps({
+        "metric": f"authz checks/sec ({args.config}, {args.batch} concurrent list subjects)",
+        "value": round(jax_res["checks_per_s"], 1),
+        "unit": "checks/s",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

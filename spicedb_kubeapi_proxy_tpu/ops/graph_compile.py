@@ -1,0 +1,346 @@
+"""Schema + tuple-snapshot -> TPU reachability program.
+
+Lowers Zanzibar userset-rewrite evaluation onto an iterative boolean-SpMV
+fixpoint (the BASELINE.json north star): the authorization state is one
+boolean vector over `(slot, object)` pairs, relationship tuples become edges
+whose one-step closure is a gather + segment-sum, and permission expressions
+(union / intersection / exclusion / arrow) become an elementwise program over
+slot ranges executed each iteration.  This replaces the reference's recursive
+graph walk inside embedded SpiceDB (the dominant cost behind
+CheckBulkPermissions / LookupResources — reference pkg/authz/check.go:48,
+lookups.go:74-135).
+
+State layout
+------------
+Every definition type T contributes:
+  - a `self` slot (one-hot marks "this object IS the query subject"),
+  - one slot per relation,
+  - one slot per permission,
+  - one slot per arrow occurrence in its permission expressions (aux).
+Each slot spans T's object-id range; ranges are concatenated into one state
+vector of size `state_size` (+1 trailing dead index used for edge padding).
+
+Edges (all boolean-OR semantics, presorted by destination):
+  - direct tuple  o#rel@u       : self(type(u))[u]        -> rel(type(o))[o]
+  - userset tuple o#rel@s#r2    : slot(type(s), r2)[s]    -> rel(type(o))[o]
+  - arrow tuple   o#left@s (for `left->target` in a permission of type(o)):
+                                  slot(type(s), target)[s] -> aux[o]
+Wildcard tuples (`o#rel@T:*`) are not edges: each (rel, subject-type) pair
+yields a dense mask applied when any self(T) bit is live in the query column.
+
+Per iteration: y = OR-SpMV(x); wildcard masks OR'd in; x = max(y, x0); then
+permission slots are recomputed from x by the expression program.  All values
+are monotone in x, so recomputation converges to the least fixpoint; the
+iteration count bounds effective recursion depth exactly like SpiceDB's
+dispatch depth cap (reference pkg/spicedb/spicedb.go:34).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..spicedb import schema as sch
+from ..spicedb.types import Relationship, SchemaError, WILDCARD
+
+SELF_SLOT = "__self__"
+
+
+# -- expression program -----------------------------------------------------
+
+@dataclass(frozen=True)
+class PRead:
+    """Read a slot range (a relation/permission/aux vector of this type)."""
+    offset: int
+    length: int
+
+
+@dataclass(frozen=True)
+class PZero:
+    length: int
+
+
+@dataclass(frozen=True)
+class PUnion:
+    children: tuple
+
+
+@dataclass(frozen=True)
+class PIntersect:
+    children: tuple
+
+
+@dataclass(frozen=True)
+class PExclude:
+    base: object
+    subtract: object
+
+
+@dataclass(frozen=True)
+class PermOp:
+    """Write `expr` into [offset, offset+length) each iteration."""
+    offset: int
+    length: int
+    expr: object
+
+
+@dataclass(frozen=True)
+class WildcardTerm:
+    """OR `mask` into y wherever any self(subject-type) bit is live."""
+    self_offset: int
+    self_length: int
+    mask_indices: tuple  # state indices activated by this wildcard
+
+
+@dataclass
+class GraphProgram:
+    state_size: int                      # includes trailing dead index
+    edge_src: np.ndarray                 # int32 [E] (sorted by dst)
+    edge_dst: np.ndarray                 # int32 [E]
+    perm_ops: list = field(default_factory=list)       # topo-ordered PermOp
+    wildcard_terms: list = field(default_factory=list)
+    num_objects: dict = field(default_factory=dict)    # type -> count
+    object_ids: dict = field(default_factory=dict)     # type -> list[str]
+    object_index: dict = field(default_factory=dict)   # type -> {id: local}
+    slot_offsets: dict = field(default_factory=dict)   # (type, slot) -> offset
+    suggested_iterations: int = 8
+
+    @property
+    def dead_index(self) -> int:
+        return self.state_size - 1
+
+    # -- host-side lookups --------------------------------------------------
+
+    def state_index(self, type_name: str, slot: str, object_id: str) -> Optional[int]:
+        off = self.slot_offsets.get((type_name, slot))
+        if off is None:
+            return None
+        local = self.object_index.get(type_name, {}).get(object_id)
+        if local is None:
+            return None
+        return off + local
+
+    def slot_range(self, type_name: str, slot: str) -> Optional[tuple]:
+        off = self.slot_offsets.get((type_name, slot))
+        if off is None:
+            return None
+        return off, self.num_objects[type_name]
+
+    def subject_index(self, subject_type: str, subject_id: str,
+                      subject_relation: str = "") -> Optional[int]:
+        """State index whose one-hot encodes this query subject."""
+        slot = subject_relation if subject_relation else SELF_SLOT
+        return self.state_index(subject_type, slot, subject_id)
+
+
+def compile_graph(schema: sch.Schema, tuples: list,
+                  extra_subject_ids: Optional[dict] = None) -> GraphProgram:
+    """Build a GraphProgram from a schema and a tuple snapshot.
+
+    `extra_subject_ids` ({type: iterable of ids}) registers objects that
+    appear in queries but not (yet) in tuples, so checks against them index
+    correctly instead of falling to the dead slot.
+    """
+    # -- collect object universes ------------------------------------------
+    ids_by_type: dict[str, set] = {t: set() for t in schema.definitions}
+    for rel in tuples:
+        if rel.resource.type in ids_by_type:
+            ids_by_type[rel.resource.type].add(rel.resource.id)
+        if rel.subject.type in ids_by_type and rel.subject.id != WILDCARD:
+            ids_by_type[rel.subject.type].add(rel.subject.id)
+    if extra_subject_ids:
+        for t, ids in extra_subject_ids.items():
+            if t in ids_by_type:
+                ids_by_type[t].update(ids)
+
+    prog = GraphProgram(state_size=0, edge_src=np.zeros(0, np.int32),
+                        edge_dst=np.zeros(0, np.int32))
+    for t, ids in ids_by_type.items():
+        ordered = sorted(ids)
+        prog.object_ids[t] = ordered
+        prog.object_index[t] = {oid: i for i, oid in enumerate(ordered)}
+        prog.num_objects[t] = len(ordered)
+
+    # -- assign slot offsets -----------------------------------------------
+    offset = 0
+    arrow_slots: dict[tuple, str] = {}  # (type, perm, occurrence) -> slot name
+
+    def add_slot(t: str, slot: str) -> None:
+        nonlocal offset
+        prog.slot_offsets[(t, slot)] = offset
+        offset += prog.num_objects[t]
+
+    for t, d in schema.definitions.items():
+        add_slot(t, SELF_SLOT)
+        for r in d.relations:
+            add_slot(t, r)
+        for p in d.permissions:
+            add_slot(t, p)
+        # aux slots for arrows, one per occurrence
+        for p, expr in d.permissions.items():
+            for k, arrow in enumerate(_find_arrows(expr)):
+                slot = f"__arrow__:{p}:{k}"
+                arrow_slots[(t, p, k)] = slot
+                add_slot(t, slot)
+    prog.state_size = offset + 1  # trailing dead index
+
+    # -- edges --------------------------------------------------------------
+    srcs: list[int] = []
+    dsts: list[int] = []
+    wildcard_map: dict[str, list] = {}  # subject type -> [state indices]
+
+    # arrow tuple-edge construction needs, per (type, left-relation), the list
+    # of (perm, occurrence, target) arrows reading it
+    arrows_by_left: dict[tuple, list] = {}
+    for t, d in schema.definitions.items():
+        for p, expr in d.permissions.items():
+            for k, arrow in enumerate(_find_arrows(expr)):
+                arrows_by_left.setdefault((t, arrow.left), []).append(
+                    (p, k, arrow.target))
+
+    for rel in tuples:
+        rt = rel.resource.type
+        if rt not in schema.definitions:
+            continue
+        d = schema.definitions[rt]
+        if rel.relation not in d.relations:
+            continue  # tuples on undefined relations are unreachable
+        dst = prog.state_index(rt, rel.relation, rel.resource.id)
+        st, sid, srel = rel.subject.type, rel.subject.id, rel.subject.relation
+        if sid == WILDCARD:
+            if dst is not None:
+                wildcard_map.setdefault(st, []).append(dst)
+        else:
+            src = (prog.state_index(st, srel, sid) if srel
+                   else prog.state_index(st, SELF_SLOT, sid))
+            if src is not None and dst is not None:
+                srcs.append(src)
+                dsts.append(dst)
+        # arrow edges ride the same tuples (direct subjects only)
+        for (p, k, target) in arrows_by_left.get((rt, rel.relation), ()):
+            if sid == WILDCARD or srel:
+                continue
+            target_def = schema.definitions.get(st)
+            if target_def is None or not target_def.has_relation_or_permission(target):
+                continue
+            src = prog.state_index(st, target, sid)
+            aux = prog.state_index(rt, arrow_slots[(rt, p, k)], rel.resource.id)
+            if src is not None and aux is not None:
+                srcs.append(src)
+                dsts.append(aux)
+
+    if srcs:
+        src_arr = np.asarray(srcs, np.int32)
+        dst_arr = np.asarray(dsts, np.int32)
+        order = np.argsort(dst_arr, kind="stable")
+        prog.edge_src = src_arr[order]
+        prog.edge_dst = dst_arr[order]
+
+    # -- wildcard terms -----------------------------------------------------
+    for st, indices in wildcard_map.items():
+        rng = prog.slot_range(st, SELF_SLOT)
+        if rng is None:
+            continue
+        prog.wildcard_terms.append(WildcardTerm(
+            self_offset=rng[0], self_length=rng[1],
+            mask_indices=tuple(sorted(set(indices)))))
+
+    # -- permission program (topo order within each type) -------------------
+    for t, d in schema.definitions.items():
+        order = _topo_permissions(d)
+        for p in order:
+            expr = d.permissions[p]
+            off, n = prog.slot_range(t, p)
+            arrow_iter = iter(range(len(_find_arrows(expr))))
+            compiled = _compile_expr(prog, schema, t, p, expr, arrow_slots,
+                                     counter=[0])
+            prog.perm_ops.append(PermOp(offset=off, length=n, expr=compiled))
+
+    prog.suggested_iterations = max(2, schema.max_rewrite_depth() + 2)
+    return prog
+
+
+def _find_arrows(expr: sch.Expr) -> list:
+    out = []
+
+    def walk(e: sch.Expr) -> None:
+        if isinstance(e, sch.Arrow):
+            out.append(e)
+        elif isinstance(e, (sch.Union, sch.Intersection)):
+            for c in e.children:
+                walk(c)
+        elif isinstance(e, sch.Exclusion):
+            walk(e.base)
+            walk(e.subtract)
+
+    walk(expr)
+    return out
+
+
+def _compile_expr(prog: GraphProgram, schema: sch.Schema, t: str, perm: str,
+                  expr: sch.Expr, arrow_slots: dict, counter: list):
+    n = prog.num_objects[t]
+    if isinstance(expr, sch.Nil):
+        return PZero(n)
+    if isinstance(expr, sch.RelRef):
+        off, ln = prog.slot_range(t, expr.name)
+        return PRead(off, ln)
+    if isinstance(expr, sch.Arrow):
+        k = counter[0]
+        counter[0] += 1
+        off, ln = prog.slot_range(t, arrow_slots[(t, perm, k)])
+        return PRead(off, ln)
+    if isinstance(expr, sch.Union):
+        return PUnion(tuple(
+            _compile_expr(prog, schema, t, perm, c, arrow_slots, counter)
+            for c in expr.children))
+    if isinstance(expr, sch.Intersection):
+        return PIntersect(tuple(
+            _compile_expr(prog, schema, t, perm, c, arrow_slots, counter)
+            for c in expr.children))
+    if isinstance(expr, sch.Exclusion):
+        base = _compile_expr(prog, schema, t, perm, expr.base, arrow_slots, counter)
+        sub = _compile_expr(prog, schema, t, perm, expr.subtract, arrow_slots, counter)
+        return PExclude(base, sub)
+    raise SchemaError(f"unknown expression {expr!r}")
+
+
+def _topo_permissions(d: sch.Definition) -> list:
+    """Order permissions so intra-type references resolve in one pass;
+    cycles fall back to declaration order (converge across iterations)."""
+    deps: dict[str, set] = {}
+    for p, expr in d.permissions.items():
+        refs: set[str] = set()
+
+        def walk(e: sch.Expr) -> None:
+            if isinstance(e, sch.RelRef) and e.name in d.permissions:
+                refs.add(e.name)
+            elif isinstance(e, (sch.Union, sch.Intersection)):
+                for c in e.children:
+                    walk(c)
+            elif isinstance(e, sch.Exclusion):
+                walk(e.base)
+                walk(e.subtract)
+
+        walk(expr)
+        deps[p] = refs
+
+    ordered: list[str] = []
+    visiting: set[str] = set()
+    done: set[str] = set()
+
+    def visit(p: str) -> None:
+        if p in done or p in visiting:
+            return
+        visiting.add(p)
+        for q in deps[p]:
+            visit(q)
+        visiting.discard(p)
+        done.add(p)
+        ordered.append(p)
+
+    for p in d.permissions:
+        visit(p)
+    return ordered

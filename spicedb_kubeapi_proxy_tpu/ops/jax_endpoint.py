@@ -1,0 +1,470 @@
+"""`jax://` endpoint: the TPU execution backend for checks and lookups.
+
+Same host tuple store as `embedded://` (source of truth, watch, durable
+semantics), but CheckPermission / CheckBulkPermissions / LookupResources
+execute on device as batched boolean-SpMV reachability
+(ops/graph_compile.py + ops/spmv.py).  The device graph is a cache:
+
+- full (re)builds produce dst-sorted edge arrays (fast segment path);
+- store deltas (dual-writes, watch traffic) are applied incrementally into
+  padded edge-array slack via scatter updates (unsorted segment path) — a
+  rebuild is only forced when a new object id appears or slack runs out;
+- relationship expiration is enforced lazily: expired tuples are
+  delta-removed before the next query.
+
+Reads are fully consistent w.r.t. the store (reference check.go:41-45 uses
+FullyConsistent): every query first drains pending deltas under the graph
+lock, so the device CSR always reflects the committed store revision.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Iterable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..spicedb import schema as sch
+from ..spicedb.endpoints import (
+    Bootstrap,
+    DEFAULT_BOOTSTRAP_SCHEMA,
+    PermissionsEndpoint,
+)
+from ..spicedb.evaluator import Evaluator
+from ..spicedb.store import TupleStore, Watcher
+from ..spicedb.types import (
+    CheckRequest,
+    CheckResult,
+    Permissionship,
+    Precondition,
+    Relationship,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    WatchUpdate,
+    WILDCARD,
+)
+from .graph_compile import GraphProgram, SELF_SLOT, compile_graph
+from .spmv import KernelCache, bucket, pad_edges
+
+_MIN_EDGE_BUCKET = 256
+_MIN_BATCH_BUCKET = 8
+
+
+class _DeviceGraph:
+    """Compiled program + device edge arrays + incremental-update state."""
+
+    def __init__(self, prog: GraphProgram, capacity: int, sorted_edges: bool,
+                 num_iters: Optional[int] = None):
+        self.prog = prog
+        self.capacity = capacity
+        self.num_iters = num_iters
+        src, dst = pad_edges(prog, capacity)
+        self.edge_src = jnp.asarray(src)
+        self.edge_dst = jnp.asarray(dst)
+        self.sorted_edges = sorted_edges
+        e = len(prog.edge_src)
+        self.free: list[int] = list(range(e, capacity))
+        # tuple key -> positions occupied by that tuple's edges
+        self.positions: dict[tuple, list] = {}
+        self._kernels: dict[bool, KernelCache] = {}
+
+    def kernel(self) -> KernelCache:
+        key = self.sorted_edges
+        k = self._kernels.get(key)
+        if k is None:
+            k = KernelCache(self.prog, num_iters=self.num_iters,
+                            indices_sorted=key)
+            self._kernels[key] = k
+        return k
+
+
+class JaxEndpoint(PermissionsEndpoint):
+    def __init__(self, schema: sch.Schema, store: Optional[TupleStore] = None,
+                 num_iters: Optional[int] = None):
+        self.schema = schema
+        self.store = store if store is not None else TupleStore()
+        # oracle fallback for query endpoints outside the compiled universe
+        self._oracle = Evaluator(schema, self.store)
+        self._num_iters = num_iters
+        self._lock = threading.RLock()
+        self._graph: Optional[_DeviceGraph] = None
+        self._pending: list[WatchUpdate] = []
+        self._expiry_heap: list = []  # (expires_at, rel key tuple)
+        self._known_extra_subjects: dict[str, set] = {}
+        self.stats = {"rebuilds": 0, "delta_batches": 0, "kernel_calls": 0}
+        self.store.add_delta_listener(self._on_delta)
+        self.store.add_reset_listener(self._on_reset)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_bootstrap(cls, bootstrap: Optional[Bootstrap] = None,
+                       **kwargs) -> "JaxEndpoint":
+        if bootstrap is None or not bootstrap.schema_text:
+            schema_text = DEFAULT_BOOTSTRAP_SCHEMA
+            rel_text = bootstrap.relationships_text if bootstrap else ""
+        else:
+            schema_text = bootstrap.schema_text
+            rel_text = bootstrap.relationships_text
+        ep = cls(sch.parse_schema(schema_text), **kwargs)
+        bs = Bootstrap(schema_text=schema_text, relationships_text=rel_text)
+        rels = bs.relationships()
+        if rels:
+            ep.store.bulk_load(rels)
+        return ep
+
+    # -- delta intake -------------------------------------------------------
+
+    def _on_delta(self, update: WatchUpdate) -> None:
+        with self._lock:
+            self._pending.append(update)
+
+    def _on_reset(self) -> None:
+        """bulk_load/delete_all invalidate the device graph wholesale."""
+        with self._lock:
+            self._graph = None
+            self._pending.clear()
+
+    # -- graph maintenance --------------------------------------------------
+
+    def _edge_endpoints(self, prog: GraphProgram, rel: Relationship) -> Optional[list]:
+        """(src, dst) pairs this tuple contributes, or None if an id is
+        outside the compiled universe (forces rebuild)."""
+        rt = rel.resource.type
+        d = self.schema.definitions.get(rt)
+        if d is None or rel.relation not in d.relations:
+            return []
+        dst = prog.state_index(rt, rel.relation, rel.resource.id)
+        if dst is None:
+            return None
+        out = []
+        st, sid, srel = rel.subject.type, rel.subject.id, rel.subject.relation
+        if sid == WILDCARD:
+            # wildcard masks are baked into the compiled program; changing
+            # them requires a rebuild
+            return None
+        src = prog.subject_index(st, sid, srel)
+        if src is None:
+            return None
+        out.append((src, dst))
+        # arrow edges
+        for (perm, k, target, slot) in self._arrow_specs(prog).get((rt, rel.relation), ()):
+            if srel:
+                continue
+            target_def = self.schema.definitions.get(st)
+            if target_def is None or not target_def.has_relation_or_permission(target):
+                continue
+            asrc = prog.state_index(st, target, sid)
+            adst = prog.state_index(rt, slot, rel.resource.id)
+            if asrc is None or adst is None:
+                return None
+            out.append((asrc, adst))
+        return out
+
+    def _arrow_specs(self, prog: GraphProgram) -> dict:
+        cached = getattr(prog, "_arrow_specs", None)
+        if cached is not None:
+            return cached
+        specs: dict[tuple, list] = {}
+        for t, d in self.schema.definitions.items():
+            for p, expr in d.permissions.items():
+                from .graph_compile import _find_arrows
+                for k, arrow in enumerate(_find_arrows(expr)):
+                    slot = f"__arrow__:{p}:{k}"
+                    specs.setdefault((t, arrow.left), []).append(
+                        (p, k, arrow.target, slot))
+        prog._arrow_specs = specs  # type: ignore[attr-defined]
+        return specs
+
+    def _rebuild(self) -> None:
+        # a rebuild reflects the current store snapshot; any queued deltas
+        # are subsumed by it
+        self._pending.clear()
+        tuples = self.store.read(None)
+        extra = {t: set(ids) for t, ids in self._known_extra_subjects.items()}
+        prog = compile_graph(self.schema, tuples, extra_subject_ids=extra)
+        capacity = bucket(max(len(prog.edge_src) * 2, _MIN_EDGE_BUCKET))
+        graph = _DeviceGraph(prog, capacity, sorted_edges=True,
+                             num_iters=self._num_iters)
+        # index tuple keys -> edge positions (edges were emitted in tuple
+        # order then sorted; recover positions by scanning)
+        pos_by_pair: dict[tuple, list] = {}
+        for i, (s, dd) in enumerate(zip(prog.edge_src, prog.edge_dst)):
+            pos_by_pair.setdefault((int(s), int(dd)), []).append(i)
+        for rel in tuples:
+            pairs = self._edge_endpoints(prog, rel)
+            if not pairs:
+                continue
+            positions = []
+            for pair in pairs:
+                stack = pos_by_pair.get(pair)
+                if stack:
+                    positions.append(stack.pop())
+            graph.positions[rel.key()] = positions
+        self._reset_expiry(tuples)
+        self._graph = graph
+        self.stats["rebuilds"] += 1
+
+    def _reset_expiry(self, tuples: list) -> None:
+        self._expiry_heap = []
+        for rel in tuples:
+            if rel.expires_at is not None:
+                heapq.heappush(self._expiry_heap, (rel.expires_at, rel.key()))
+
+    def _apply_pending(self) -> None:
+        """Drain store deltas into the device graph (under lock)."""
+        graph = self._graph
+        if graph is None:
+            self._rebuild()
+            return
+        # expire lazily
+        now = time.time()
+        expired_keys = []
+        while self._expiry_heap and self._expiry_heap[0][0] <= now:
+            _, key = heapq.heappop(self._expiry_heap)
+            expired_keys.append(key)
+
+        if not self._pending and not expired_keys:
+            return
+
+        updates: list[tuple] = []  # (pos, src, dst)
+        needs_rebuild = False
+        for batch in self._pending:
+            for u in batch.updates:
+                key = u.rel.key()
+                if u.op == UpdateOp.DELETE:
+                    for pos in graph.positions.pop(key, ()):
+                        updates.append((pos, graph.prog.dead_index,
+                                        graph.prog.dead_index))
+                        graph.free.append(pos)
+                else:  # TOUCH
+                    if key in graph.positions:
+                        continue  # idempotent touch; edges already present
+                    pairs = self._edge_endpoints(graph.prog, u.rel)
+                    if pairs is None:
+                        needs_rebuild = True
+                        break
+                    positions = []
+                    for (s, dd) in pairs:
+                        if not graph.free:
+                            needs_rebuild = True
+                            break
+                        pos = graph.free.pop()
+                        updates.append((pos, s, dd))
+                        positions.append(pos)
+                    if needs_rebuild:
+                        break
+                    graph.positions[key] = positions
+                    if u.rel.expires_at is not None:
+                        heapq.heappush(self._expiry_heap,
+                                       (u.rel.expires_at, key))
+            if needs_rebuild:
+                break
+        for key in expired_keys:
+            if needs_rebuild:
+                break
+            for pos in graph.positions.pop(key, ()):
+                updates.append((pos, graph.prog.dead_index,
+                                graph.prog.dead_index))
+                graph.free.append(pos)
+
+        self._pending.clear()
+        if needs_rebuild:
+            self._rebuild()
+            return
+        if updates:
+            # a position freed and re-allocated within one drain appears
+            # twice; scatter order for duplicate indices is undefined in
+            # XLA, so collapse to last-write-wins first
+            final: dict[int, tuple] = {}
+            for (pos, s_, d_) in updates:
+                final[pos] = (s_, d_)
+            pos = jnp.asarray(list(final.keys()), jnp.int32)
+            srcs = jnp.asarray([v[0] for v in final.values()], jnp.int32)
+            dsts = jnp.asarray([v[1] for v in final.values()], jnp.int32)
+            graph.edge_src = graph.edge_src.at[pos].set(srcs)
+            graph.edge_dst = graph.edge_dst.at[pos].set(dsts)
+            graph.sorted_edges = False
+            self.stats["delta_batches"] += 1
+
+    def _current_graph(self) -> _DeviceGraph:
+        if self._graph is None:
+            self._rebuild()
+        else:
+            self._apply_pending()
+        return self._graph
+
+    # -- query encoding -----------------------------------------------------
+
+    def _encode_subjects(self, graph: _DeviceGraph, subjects: list) -> tuple:
+        """Dedupe subjects into query columns; returns (q_idx array,
+        col_of_subject dict, unknown set)."""
+        cols: dict = {}
+        q: list[int] = []
+        unknown: set = set()
+        for s in subjects:
+            if s in cols or s in unknown:
+                continue
+            idx = graph.prog.subject_index(s.type, s.id, s.relation)
+            if idx is None:
+                unknown.add(s)
+                continue
+            cols[s] = len(q)
+            q.append(idx)
+        b = bucket(max(len(q), 1), _MIN_BATCH_BUCKET)
+        q_arr = np.full(b, graph.prog.dead_index, np.int32)
+        q_arr[: len(q)] = q
+        return q_arr, cols, unknown
+
+    # -- verbs --------------------------------------------------------------
+
+    def _check_batch_sync(self, reqs: list) -> list:
+        with self._lock:
+            graph = self._current_graph()
+            q_arr, cols, unknown = self._encode_subjects(
+                graph, [r.subject for r in reqs])
+            gather_idx: list[int] = []
+            gather_col: list[int] = []
+            kernel_rows: list[int] = []  # positions in reqs served by kernel
+            results: list[Optional[bool]] = [None] * len(reqs)
+            for i, r in enumerate(reqs):
+                if r.subject in unknown:
+                    # outside the compiled universe: oracle fallback (only
+                    # wildcard-derived permissions can apply)
+                    results[i] = self._oracle.check(r.resource, r.permission,
+                                                    r.subject)
+                    continue
+                state_idx = graph.prog.state_index(
+                    r.resource.type, r.permission, r.resource.id)
+                if state_idx is None:
+                    d = self.schema.definitions.get(r.resource.type)
+                    if d is None or not d.has_relation_or_permission(r.permission):
+                        # surface schema errors like the oracle does
+                        results[i] = self._oracle.check(
+                            r.resource, r.permission, r.subject)
+                    else:
+                        results[i] = False  # unknown object: no tuples
+                    continue
+                gather_idx.append(state_idx)
+                gather_col.append(cols[r.subject])
+                kernel_rows.append(i)
+            if kernel_rows:
+                g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
+                gi = np.zeros(g, np.int32)
+                gc = np.zeros(g, np.int32)
+                gi[: len(gather_idx)] = gather_idx
+                gc[: len(gather_col)] = gather_col
+                out = graph.kernel().checks(q_arr, gi, gc, graph.edge_src,
+                                            graph.edge_dst)
+                self.stats["kernel_calls"] += 1
+                for j, row in enumerate(kernel_rows):
+                    results[row] = bool(out[j])
+            rev = self.store.revision
+        return [CheckResult(
+            permissionship=(Permissionship.HAS_PERMISSION if r
+                            else Permissionship.NO_PERMISSION),
+            checked_at=rev) for r in results]
+
+    async def check_permission(self, req: CheckRequest) -> CheckResult:
+        return self._check_batch_sync([req])[0]
+
+    async def check_bulk_permissions(self, reqs: list) -> list:
+        if not reqs:
+            return []
+        return self._check_batch_sync(reqs)
+
+    def _lookup_sync(self, resource_type: str, permission: str,
+                     subject: SubjectRef) -> list:
+        self.schema.definition(resource_type)  # raises like the oracle
+        with self._lock:
+            graph = self._current_graph()
+            rng = graph.prog.slot_range(resource_type, permission)
+            if rng is None:
+                return self._oracle.lookup_resources(resource_type, permission,
+                                                     subject)
+            q_arr, cols, unknown = self._encode_subjects(graph, [subject])
+            if subject in unknown:
+                return self._oracle.lookup_resources(resource_type, permission,
+                                                     subject)
+            col = cols[subject]
+            bitmap = graph.kernel().lookup(rng[0], rng[1], q_arr,
+                                           graph.edge_src, graph.edge_dst)
+            self.stats["kernel_calls"] += 1
+            ids = graph.prog.object_ids[resource_type]
+        return [ids[i] for i in np.nonzero(bitmap[:, col])[0]]
+
+    async def lookup_resources(self, resource_type: str, permission: str,
+                               subject: SubjectRef) -> list:
+        return self._lookup_sync(resource_type, permission, subject)
+
+    def _lookup_batch_sync(self, resource_type: str, permission: str,
+                           subjects: list) -> list:
+        self.schema.definition(resource_type)
+        with self._lock:
+            graph = self._current_graph()
+            rng = graph.prog.slot_range(resource_type, permission)
+            if rng is None:
+                return [self._oracle.lookup_resources(resource_type, permission, s)
+                        for s in subjects]
+            q_arr, cols, unknown = self._encode_subjects(graph, subjects)
+            bitmap = graph.kernel().lookup(rng[0], rng[1], q_arr,
+                                           graph.edge_src, graph.edge_dst)
+            self.stats["kernel_calls"] += 1
+            ids = graph.prog.object_ids[resource_type]
+            out = []
+            for s in subjects:
+                if s in unknown:
+                    out.append(self._oracle.lookup_resources(
+                        resource_type, permission, s))
+                else:
+                    out.append([ids[i] for i in
+                                np.nonzero(bitmap[:, cols[s]])[0]])
+        return out
+
+    async def lookup_resources_batch(self, resource_type: str, permission: str,
+                                     subjects: list) -> list:
+        if not subjects:
+            return []
+        return self._lookup_batch_sync(resource_type, permission, subjects)
+
+    async def read_relationships(self, flt: RelationshipFilter) -> list:
+        return self.store.read(flt)
+
+    async def write_relationships(self, updates: Iterable[RelationshipUpdate],
+                                  preconditions: Iterable[Precondition] = ()) -> int:
+        return self.store.write(updates, preconditions)
+
+    async def delete_relationships(self, flt: RelationshipFilter,
+                                   preconditions: Iterable[Precondition] = ()) -> int:
+        rev, _ = self.store.delete_by_filter(flt, preconditions)
+        return rev
+
+    def watch(self, object_types: Optional[Iterable[str]] = None) -> Watcher:
+        return self.store.subscribe(object_types)
+
+    # -- maintenance hooks --------------------------------------------------
+
+    def register_query_subjects(self, subjects: dict) -> None:
+        """Pre-register subject ids ({type: iterable}) so queries about them
+        hit the kernel instead of the oracle fallback on first contact."""
+        with self._lock:
+            changed = False
+            for t, ids in subjects.items():
+                bucket_set = self._known_extra_subjects.setdefault(t, set())
+                new = set(ids) - bucket_set
+                if new:
+                    bucket_set.update(new)
+                    changed = True
+            if changed:
+                self._graph = None  # force rebuild on next query
+                self._pending.clear()
+
+    def force_rebuild(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._rebuild()

@@ -1,0 +1,211 @@
+"""Iterative boolean-SpMV reachability kernel (jit/scan/while_loop).
+
+The device-side half of the `jax://` backend: one fixpoint iteration is a
+gather + segment-sum over the edge arrays (boolean OR semantics) followed by
+the elementwise permission program, run under `lax.scan` (fixed iterations)
+or `lax.while_loop` (until convergence, capped at the SpiceDB dispatch-depth
+equivalent).  State is laid out `[state_size, batch]` so the segment reduce
+runs over the leading axis with presorted destination indices.
+
+Everything here is shape-static: edge arrays are padded to bucket sizes with
+edges into the trailing dead index, batches are padded to bucket widths, and
+the jit cache is keyed on (bucket shapes, program identity).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .graph_compile import (
+    GraphProgram,
+    PermOp,
+    PExclude,
+    PIntersect,
+    PRead,
+    PUnion,
+    PZero,
+)
+
+DTYPE = jnp.float32
+
+
+def bucket(n: int, minimum: int = 16) -> int:
+    """Next power-of-two bucket ≥ n (recompile-avoidance discipline)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_edges(prog: GraphProgram, capacity: Optional[int] = None) -> tuple:
+    """Pad edge arrays into a power-of-two bucket; padding edges read the
+    dead index (always 0) and write the dead index (never read)."""
+    e = len(prog.edge_src)
+    cap = capacity if capacity is not None else bucket(max(e, 1))
+    if cap < e:
+        raise ValueError(f"capacity {cap} < edge count {e}")
+    src = np.full(cap, prog.dead_index, np.int32)
+    dst = np.full(cap, prog.dead_index, np.int32)
+    src[:e] = prog.edge_src
+    dst[:e] = prog.edge_dst
+    return src, dst
+
+
+# -- single iteration -------------------------------------------------------
+
+def _apply_perm_expr(expr, x: jnp.ndarray) -> jnp.ndarray:
+    if isinstance(expr, PRead):
+        return jax.lax.dynamic_slice_in_dim(x, expr.offset, expr.length, axis=0)
+    if isinstance(expr, PZero):
+        return jnp.zeros((expr.length, x.shape[1]), dtype=x.dtype)
+    if isinstance(expr, PUnion):
+        out = _apply_perm_expr(expr.children[0], x)
+        for c in expr.children[1:]:
+            out = jnp.maximum(out, _apply_perm_expr(c, x))
+        return out
+    if isinstance(expr, PIntersect):
+        out = _apply_perm_expr(expr.children[0], x)
+        for c in expr.children[1:]:
+            out = jnp.minimum(out, _apply_perm_expr(c, x))
+        return out
+    if isinstance(expr, PExclude):
+        base = _apply_perm_expr(expr.base, x)
+        sub = _apply_perm_expr(expr.subtract, x)
+        return base * (1.0 - sub)
+    raise TypeError(f"unknown perm expr {expr!r}")
+
+
+def make_step(prog: GraphProgram, indices_sorted: bool = True):
+    """Build the per-iteration transition fn(x, x0, edge_src, edge_dst).
+
+    `indices_sorted` promises edge_dst is nondecreasing (true after a full
+    rebuild; false once incremental deltas have been scattered in)."""
+    n = prog.state_size
+    perm_ops = tuple(prog.perm_ops)
+    wildcard_terms = tuple(prog.wildcard_terms)
+    wildcard_masks = []
+    for term in wildcard_terms:
+        mask = np.zeros((n, 1), np.float32)
+        mask[np.asarray(term.mask_indices, np.int64)] = 1.0
+        wildcard_masks.append(jnp.asarray(mask))
+
+    def step(x, x0, edge_src, edge_dst):
+        vals = x[edge_src]  # [E, B]
+        y = jax.ops.segment_sum(vals, edge_dst, num_segments=n,
+                                indices_are_sorted=indices_sorted)
+        y = (y > 0).astype(x.dtype)
+        for term, mask in zip(wildcard_terms, wildcard_masks):
+            live = jax.lax.dynamic_slice_in_dim(
+                x, term.self_offset, term.self_length, axis=0)
+            any_live = jnp.max(live, axis=0, keepdims=True)  # [1, B]
+            y = jnp.maximum(y, mask * any_live)
+        x1 = jnp.maximum(y, x0)
+        for op in perm_ops:
+            vec = _apply_perm_expr(op.expr, x1)
+            seed = jax.lax.dynamic_slice_in_dim(x0, op.offset, op.length, axis=0)
+            x1 = jax.lax.dynamic_update_slice_in_dim(
+                x1, jnp.maximum(vec, seed), op.offset, axis=0)
+        # the dead row must stay zero (edge padding reads it)
+        x1 = x1.at[n - 1].set(0.0)
+        return x1
+
+    return step
+
+
+# -- full evaluation --------------------------------------------------------
+
+def make_evaluate(prog: GraphProgram, num_iters: int, use_while: bool = False,
+                  indices_sorted: bool = True):
+    """Build fn(q_idx, edge_src, edge_dst) -> x_final of shape [N, B].
+
+    q_idx: int32 [B] state index of each query's one-hot (dead index for
+    padding columns).  With `use_while`, iterates until fixpoint, capped at
+    `num_iters`.
+    """
+    n = prog.state_size
+    step = make_step(prog, indices_sorted=indices_sorted)
+
+    def init(q_idx):
+        b = q_idx.shape[0]
+        x0 = jnp.zeros((n, b), DTYPE)
+        x0 = x0.at[q_idx, jnp.arange(b)].max(1.0)
+        x0 = x0.at[n - 1].set(0.0)
+        return x0
+
+    if use_while:
+        def evaluate(q_idx, edge_src, edge_dst):
+            x0 = init(q_idx)
+
+            def cond(state):
+                x, prev_changed, i = state
+                return jnp.logical_and(prev_changed, i < num_iters)
+
+            def body(state):
+                x, _, i = state
+                x1 = step(x, x0, edge_src, edge_dst)
+                changed = jnp.any(x1 != x)
+                return (x1, changed, i + 1)
+
+            x_final, _, _ = jax.lax.while_loop(
+                cond, body, (x0, jnp.bool_(True), jnp.int32(0)))
+            return x_final
+    else:
+        def evaluate(q_idx, edge_src, edge_dst):
+            x0 = init(q_idx)
+
+            def body(x, _):
+                return step(x, x0, edge_src, edge_dst), None
+
+            x_final, _ = jax.lax.scan(body, x0, None, length=num_iters)
+            return x_final
+
+    return evaluate
+
+
+class KernelCache:
+    """Jitted check/lookup entry points for one GraphProgram.
+
+    Jit cache is keyed implicitly by argument shapes (edge bucket, batch
+    bucket); rebuilding the program (schema or object-universe change)
+    invalidates the cache wholesale.
+    """
+
+    def __init__(self, prog: GraphProgram, num_iters: Optional[int] = None,
+                 use_while: bool = True, indices_sorted: bool = True):
+        self.prog = prog
+        self.num_iters = num_iters or min(50, prog.suggested_iterations + 8)
+        evaluate = make_evaluate(prog, self.num_iters, use_while=use_while,
+                                 indices_sorted=indices_sorted)
+
+        def run_checks(q_idx, gather_idx, gather_col, edge_src, edge_dst):
+            x = evaluate(q_idx, edge_src, edge_dst)
+            return x[gather_idx, gather_col] > 0
+
+        def run_lookup(slot_offset, slot_length, q_idx, edge_src, edge_dst):
+            x = evaluate(q_idx, edge_src, edge_dst)
+            return jax.lax.dynamic_slice_in_dim(
+                x, slot_offset, slot_length, axis=0) > 0
+
+        self._checks = jax.jit(run_checks)
+        # slot offset/length are static: one compile per (type, permission)
+        self._lookup = jax.jit(run_lookup, static_argnums=(0, 1))
+
+    # -- host-facing --------------------------------------------------------
+
+    def checks(self, q_idx: np.ndarray, gather_idx: np.ndarray,
+               gather_col: np.ndarray, edge_src, edge_dst) -> np.ndarray:
+        """gather_idx/gather_col: per-check state index and query column."""
+        return np.asarray(self._checks(
+            jnp.asarray(q_idx), jnp.asarray(gather_idx),
+            jnp.asarray(gather_col), edge_src, edge_dst))
+
+    def lookup(self, slot_offset: int, slot_length: int, q_idx: np.ndarray,
+               edge_src, edge_dst) -> np.ndarray:
+        """Returns bool [slot_length, B] allowed bitmap."""
+        return np.asarray(self._lookup(
+            slot_offset, slot_length, jnp.asarray(q_idx), edge_src, edge_dst))

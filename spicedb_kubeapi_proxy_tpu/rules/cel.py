@@ -572,7 +572,7 @@ def _call(node: Call, act: dict[str, Any]) -> Any:
         base = _eval(node.base, act)
         if not isinstance(base, (list, dict)):
             raise CELEvalError(f"{node.name}() on {type(base).__name__}")
-        items = list(base) if isinstance(base, (list, dict)) else base
+        items = list(base)
         count = 0
         for item in items:
             v = _eval(node.args[1], {**act, var: item})

@@ -15,7 +15,6 @@ consumes (SURVEY.md §5) — and never know which backend ran.
 
 from __future__ import annotations
 
-import asyncio
 from dataclasses import dataclass
 from typing import Any, Iterable, Optional
 from urllib.parse import urlsplit
@@ -28,10 +27,8 @@ from .store import TupleStore, Watcher
 from .types import (
     CheckRequest,
     CheckResult,
-    ObjectRef,
     Permissionship,
     Precondition,
-    Relationship,
     RelationshipFilter,
     RelationshipUpdate,
     SubjectRef,
@@ -51,6 +48,13 @@ class PermissionsEndpoint:
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
         raise NotImplementedError
+
+    async def lookup_resources_batch(self, resource_type: str, permission: str,
+                                     subjects: list) -> list:
+        """One allowed-id list per subject.  Backends that can batch (jax://)
+        fuse the whole batch into a single kernel invocation."""
+        return [await self.lookup_resources(resource_type, permission, s)
+                for s in subjects]
 
     async def read_relationships(self, flt: RelationshipFilter) -> list:
         raise NotImplementedError
@@ -168,9 +172,7 @@ class EmbeddedEndpoint(PermissionsEndpoint):
         bs = Bootstrap(schema_text=schema_text, relationships_text=rel_text)
         rels = bs.relationships()
         if rels:
-            from .types import UpdateOp
-            endpoint.store.write([RelationshipUpdate(UpdateOp.TOUCH, r)
-                                  for r in rels])
+            endpoint.store.bulk_load(rels)
         return endpoint
 
     # -- verbs --------------------------------------------------------------

@@ -98,6 +98,9 @@ class TupleStore:
         # delta listeners get every committed batch synchronously under the
         # store lock — used by the jax:// backend for incremental CSR updates.
         self._delta_listeners: list[Callable[[WatchUpdate], None]] = []
+        # reset listeners fire on non-delta mass changes (bulk_load,
+        # delete_all) that require a full cache rebuild.
+        self._reset_listeners: list[Callable[[], None]] = []
 
     # -- revision -----------------------------------------------------------
 
@@ -190,12 +193,19 @@ class TupleStore:
                 f"{len(preconditions)} preconditions exceeds limit {MAX_PRECONDITIONS}")
         with self._lock:
             self._check_preconditions(preconditions)
-            # validate CREATEs before mutating (atomicity)
+            # validate CREATEs before mutating (atomicity); duplicates
+            # within the batch are also conflicts
             now = self._clock()
+            created_in_batch: set = set()
             for u in updates:
-                if u.op == UpdateOp.CREATE and self._live_entry(u.rel, now) is not None:
+                if u.op != UpdateOp.CREATE:
+                    continue
+                key = u.rel.key()
+                if (self._live_entry(u.rel, now) is not None
+                        or key in created_in_batch):
                     raise AlreadyExistsError(
                         f"relationship already exists: {u.rel.rel_string()}")
+                created_in_batch.add(key)
             self._revision += 1
             rev = self._revision
             applied = []
@@ -208,6 +218,20 @@ class TupleStore:
                         applied.append(RelationshipUpdate(UpdateOp.DELETE, u.rel))
             if applied:
                 self._broadcast(WatchUpdate(updates=tuple(applied), revision=rev))
+            return rev
+
+    def bulk_load(self, rels: Iterable[Relationship]) -> int:
+        """Bootstrap/benchmark path: load relationships without the per-call
+        API update limit (the reference seeds bootstrap data straight into
+        the datastore, not through WriteRelationships — spicedb.go:63-67).
+        One revision, no watch events."""
+        with self._lock:
+            self._revision += 1
+            rev = self._revision
+            for rel in rels:
+                self._put(rel, rev)
+            for fn in list(self._reset_listeners):
+                fn()
             return rev
 
     def delete_by_filter(self, flt: RelationshipFilter,
@@ -234,6 +258,8 @@ class TupleStore:
         with self._lock:
             self._by_relation.clear()
             self._revision += 1
+            for fn in list(self._reset_listeners):
+                fn()
 
     # -- watch --------------------------------------------------------------
 
@@ -251,6 +277,10 @@ class TupleStore:
     def add_delta_listener(self, fn: Callable[[WatchUpdate], None]) -> None:
         with self._lock:
             self._delta_listeners.append(fn)
+
+    def add_reset_listener(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            self._reset_listeners.append(fn)
 
     def remove_delta_listener(self, fn: Callable[[WatchUpdate], None]) -> None:
         with self._lock:

@@ -84,6 +84,8 @@ def parse_relationship(rel: str) -> Relationship:
                      u.subject_type, u.subject_id):
         if "{{" in fieldval or not fieldval:
             raise ValueError(f"not a concrete relationship: {rel!r}")
+    if "{{" in u.subject_relation:
+        raise ValueError(f"not a concrete relationship: {rel!r}")
     subject_relation = u.subject_relation
     if subject_relation == ELLIPSIS:
         subject_relation = ""
