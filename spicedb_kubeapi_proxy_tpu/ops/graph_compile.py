@@ -101,6 +101,10 @@ class GraphProgram:
     edge_dst: np.ndarray                 # int32 [E]
     perm_ops: list = field(default_factory=list)       # topo-ordered PermOp
     wildcard_terms: list = field(default_factory=list)
+    # (resource_type, left_relation) -> [(perm, occurrence, target, aux_slot)]
+    # — the arrow edges each tuple on that relation contributes; consumed by
+    # the jax endpoint's incremental delta path
+    arrow_specs: dict = field(default_factory=dict)
     num_objects: dict = field(default_factory=dict)    # type -> count
     object_ids: dict = field(default_factory=dict)     # type -> list[str]
     object_index: dict = field(default_factory=dict)   # type -> {id: local}
@@ -199,6 +203,8 @@ def compile_graph(schema: sch.Schema, tuples: list,
             for k, arrow in enumerate(_find_arrows(expr)):
                 arrows_by_left.setdefault((t, arrow.left), []).append(
                     (p, k, arrow.target))
+                prog.arrow_specs.setdefault((t, arrow.left), []).append(
+                    (p, k, arrow.target, arrow_slots[(t, p, k)]))
 
     for rel in tuples:
         rt = rel.resource.type
@@ -253,7 +259,6 @@ def compile_graph(schema: sch.Schema, tuples: list,
         for p in order:
             expr = d.permissions[p]
             off, n = prog.slot_range(t, p)
-            arrow_iter = iter(range(len(_find_arrows(expr))))
             compiled = _compile_expr(prog, schema, t, p, expr, arrow_slots,
                                      counter=[0])
             prog.perm_ops.append(PermOp(offset=off, length=n, expr=compiled))

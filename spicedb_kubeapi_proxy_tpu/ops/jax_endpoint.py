@@ -19,6 +19,7 @@ lock, so the device CSR always reflects the committed store revision.
 
 from __future__ import annotations
 
+import collections
 import heapq
 import threading
 import time
@@ -93,8 +94,16 @@ class JaxEndpoint(PermissionsEndpoint):
         self._num_iters = num_iters
         self._lock = threading.RLock()
         self._graph: Optional[_DeviceGraph] = None
-        self._pending: list[WatchUpdate] = []
+        # listener callbacks run while the STORE lock is held; they must
+        # never take self._lock (ABBA deadlock with queries that hold
+        # self._lock and read the store), so delta intake is a lock-free
+        # deque append plus an invalidation flag.
+        self._pending: collections.deque = collections.deque()
+        self._graph_invalid = False
         self._expiry_heap: list = []  # (expires_at, rel key tuple)
+        # current expiration per tuple key; heap entries not matching this
+        # map are stale and skipped (lazy deletion)
+        self._expiry_meta: dict = {}
         self._known_extra_subjects: dict[str, set] = {}
         self.stats = {"rebuilds": 0, "delta_batches": 0, "kernel_calls": 0}
         self.store.add_delta_listener(self._on_delta)
@@ -121,14 +130,13 @@ class JaxEndpoint(PermissionsEndpoint):
     # -- delta intake -------------------------------------------------------
 
     def _on_delta(self, update: WatchUpdate) -> None:
-        with self._lock:
-            self._pending.append(update)
+        # called under the store lock — must not acquire self._lock
+        self._pending.append(update)
 
     def _on_reset(self) -> None:
-        """bulk_load/delete_all invalidate the device graph wholesale."""
-        with self._lock:
-            self._graph = None
-            self._pending.clear()
+        """bulk_load/delete_all invalidate the device graph wholesale
+        (called under the store lock — must not acquire self._lock)."""
+        self._graph_invalid = True
 
     # -- graph maintenance --------------------------------------------------
 
@@ -152,8 +160,8 @@ class JaxEndpoint(PermissionsEndpoint):
         if src is None:
             return None
         out.append((src, dst))
-        # arrow edges
-        for (perm, k, target, slot) in self._arrow_specs(prog).get((rt, rel.relation), ()):
+        # arrow edges (specs recorded by the graph compiler)
+        for (perm, k, target, slot) in prog.arrow_specs.get((rt, rel.relation), ()):
             if srel:
                 continue
             target_def = self.schema.definitions.get(st)
@@ -166,25 +174,11 @@ class JaxEndpoint(PermissionsEndpoint):
             out.append((asrc, adst))
         return out
 
-    def _arrow_specs(self, prog: GraphProgram) -> dict:
-        cached = getattr(prog, "_arrow_specs", None)
-        if cached is not None:
-            return cached
-        specs: dict[tuple, list] = {}
-        for t, d in self.schema.definitions.items():
-            for p, expr in d.permissions.items():
-                from .graph_compile import _find_arrows
-                for k, arrow in enumerate(_find_arrows(expr)):
-                    slot = f"__arrow__:{p}:{k}"
-                    specs.setdefault((t, arrow.left), []).append(
-                        (p, k, arrow.target, slot))
-        prog._arrow_specs = specs  # type: ignore[attr-defined]
-        return specs
-
     def _rebuild(self) -> None:
         # a rebuild reflects the current store snapshot; any queued deltas
         # are subsumed by it
-        self._pending.clear()
+        self._drain_pending()
+        self._graph_invalid = False
         tuples = self.store.read(None)
         extra = {t: set(ids) for t, ids in self._known_extra_subjects.items()}
         prog = compile_graph(self.schema, tuples, extra_subject_ids=extra)
@@ -212,39 +206,62 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def _reset_expiry(self, tuples: list) -> None:
         self._expiry_heap = []
+        self._expiry_meta = {}
         for rel in tuples:
             if rel.expires_at is not None:
+                self._expiry_meta[rel.key()] = rel.expires_at
                 heapq.heappush(self._expiry_heap, (rel.expires_at, rel.key()))
 
+    def _set_expiry(self, key: tuple, expires_at) -> None:
+        if expires_at is None:
+            self._expiry_meta.pop(key, None)
+        else:
+            self._expiry_meta[key] = expires_at
+            heapq.heappush(self._expiry_heap, (expires_at, key))
+
+    def _drain_pending(self) -> list:
+        """Atomically take all queued delta batches."""
+        out = []
+        while True:
+            try:
+                out.append(self._pending.popleft())
+            except IndexError:
+                return out
+
     def _apply_pending(self) -> None:
-        """Drain store deltas into the device graph (under lock)."""
+        """Drain store deltas into the device graph (under self._lock)."""
+        if self._graph_invalid:
+            self._graph_invalid = False
+            self._graph = None
         graph = self._graph
         if graph is None:
             self._rebuild()
             return
-        # expire lazily
-        now = time.time()
-        expired_keys = []
-        while self._expiry_heap and self._expiry_heap[0][0] <= now:
-            _, key = heapq.heappop(self._expiry_heap)
-            expired_keys.append(key)
-
-        if not self._pending and not expired_keys:
+        batches = self._drain_pending()
+        if not batches and not (self._expiry_heap
+                                and self._expiry_heap[0][0] <= time.time()):
             return
 
         updates: list[tuple] = []  # (pos, src, dst)
         needs_rebuild = False
-        for batch in self._pending:
+        for batch in batches:
             for u in batch.updates:
                 key = u.rel.key()
                 if u.op == UpdateOp.DELETE:
+                    if u.rel.subject.id == WILDCARD:
+                        # wildcard contributions are baked into the compiled
+                        # program's masks; only a rebuild removes them
+                        needs_rebuild = True
+                        break
+                    self._set_expiry(key, None)
                     for pos in graph.positions.pop(key, ()):
                         updates.append((pos, graph.prog.dead_index,
                                         graph.prog.dead_index))
                         graph.free.append(pos)
                 else:  # TOUCH
+                    self._set_expiry(key, u.rel.expires_at)
                     if key in graph.positions:
-                        continue  # idempotent touch; edges already present
+                        continue  # edges already present; expiry updated above
                     pairs = self._edge_endpoints(graph.prog, u.rel)
                     if pairs is None:
                         needs_rebuild = True
@@ -260,20 +277,27 @@ class JaxEndpoint(PermissionsEndpoint):
                     if needs_rebuild:
                         break
                     graph.positions[key] = positions
-                    if u.rel.expires_at is not None:
-                        heapq.heappush(self._expiry_heap,
-                                       (u.rel.expires_at, key))
             if needs_rebuild:
                 break
-        for key in expired_keys:
-            if needs_rebuild:
+        # expire lazily AFTER batch processing so expirations registered by
+        # the batches just drained take effect this query; heap entries whose
+        # expiry no longer matches the current metadata are stale (tuple
+        # deleted/re-touched) and skipped
+        now = time.time()
+        while (not needs_rebuild and self._expiry_heap
+               and self._expiry_heap[0][0] <= now):
+            exp, key = heapq.heappop(self._expiry_heap)
+            if self._expiry_meta.get(key) != exp:
+                continue
+            del self._expiry_meta[key]
+            if key[4] == WILDCARD:
+                needs_rebuild = True
                 break
             for pos in graph.positions.pop(key, ()):
                 updates.append((pos, graph.prog.dead_index,
                                 graph.prog.dead_index))
                 graph.free.append(pos)
 
-        self._pending.clear()
         if needs_rebuild:
             self._rebuild()
             return
@@ -293,10 +317,7 @@ class JaxEndpoint(PermissionsEndpoint):
             self.stats["delta_batches"] += 1
 
     def _current_graph(self) -> _DeviceGraph:
-        if self._graph is None:
-            self._rebuild()
-        else:
-            self._apply_pending()
+        self._apply_pending()
         return self._graph
 
     # -- query encoding -----------------------------------------------------
@@ -462,9 +483,7 @@ class JaxEndpoint(PermissionsEndpoint):
                     changed = True
             if changed:
                 self._graph = None  # force rebuild on next query
-                self._pending.clear()
 
     def force_rebuild(self) -> None:
         with self._lock:
-            self._pending.clear()
             self._rebuild()

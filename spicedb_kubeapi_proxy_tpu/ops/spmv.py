@@ -2,20 +2,24 @@
 
 The device-side half of the `jax://` backend: one fixpoint iteration is a
 gather + segment-sum over the edge arrays (boolean OR semantics) followed by
-the elementwise permission program, run under `lax.scan` (fixed iterations)
-or `lax.while_loop` (until convergence, capped at the SpiceDB dispatch-depth
-equivalent).  State is laid out `[state_size, batch]` so the segment reduce
-runs over the leading axis with presorted destination indices.
+the elementwise permission program, run under `lax.while_loop` until
+convergence (capped at the SpiceDB dispatch-depth equivalent, 50 —
+reference pkg/spicedb/spicedb.go:34) or `lax.scan` for a fixed iteration
+count.  State is laid out `[state_size, batch]` so the segment reduce runs
+over the leading axis.
 
 Everything here is shape-static: edge arrays are padded to bucket sizes with
 edges into the trailing dead index, batches are padded to bucket widths, and
 the jit cache is keyed on (bucket shapes, program identity).
+
+The same per-iteration body serves the single-chip and the sharded kernels:
+`make_step(..., combine=...)` lets parallel/sharding.py inject the
+cross-chip boolean all-reduce without duplicating the step semantics.
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -23,7 +27,6 @@ import numpy as np
 
 from .graph_compile import (
     GraphProgram,
-    PermOp,
     PExclude,
     PIntersect,
     PRead,
@@ -32,6 +35,11 @@ from .graph_compile import (
 )
 
 DTYPE = jnp.float32
+
+# Default iteration cap == the embedded reference's max dispatch depth
+# (spicedb.go:34).  The while_loop exits as soon as the fixpoint converges,
+# so shallow graphs pay only their true depth.
+MAX_ITERATIONS = 50
 
 
 def bucket(n: int, minimum: int = 16) -> int:
@@ -54,6 +62,16 @@ def pad_edges(prog: GraphProgram, capacity: Optional[int] = None) -> tuple:
     src[:e] = prog.edge_src
     dst[:e] = prog.edge_dst
     return src, dst
+
+
+def wildcard_masks(prog: GraphProgram) -> list:
+    """Dense [N, 1] float masks, one per wildcard term."""
+    masks = []
+    for term in prog.wildcard_terms:
+        m = np.zeros((prog.state_size, 1), np.float32)
+        m[np.asarray(term.mask_indices, np.int64)] = 1.0
+        masks.append(jnp.asarray(m))
+    return masks
 
 
 # -- single iteration -------------------------------------------------------
@@ -80,26 +98,27 @@ def _apply_perm_expr(expr, x: jnp.ndarray) -> jnp.ndarray:
     raise TypeError(f"unknown perm expr {expr!r}")
 
 
-def make_step(prog: GraphProgram, indices_sorted: bool = True):
+def make_step(prog: GraphProgram, indices_sorted: bool = True,
+              combine: Optional[Callable] = None):
     """Build the per-iteration transition fn(x, x0, edge_src, edge_dst).
 
     `indices_sorted` promises edge_dst is nondecreasing (true after a full
-    rebuild; false once incremental deltas have been scattered in)."""
+    rebuild; false once incremental deltas have been scattered in).
+    `combine` (optional) reduces the partial one-step closure across shards
+    (e.g. `lambda y: lax.pmax(y, "graph")`); identity when None."""
     n = prog.state_size
     perm_ops = tuple(prog.perm_ops)
-    wildcard_terms = tuple(prog.wildcard_terms)
-    wildcard_masks = []
-    for term in wildcard_terms:
-        mask = np.zeros((n, 1), np.float32)
-        mask[np.asarray(term.mask_indices, np.int64)] = 1.0
-        wildcard_masks.append(jnp.asarray(mask))
+    wc_terms = tuple(prog.wildcard_terms)
+    wc_masks = wildcard_masks(prog)
 
     def step(x, x0, edge_src, edge_dst):
         vals = x[edge_src]  # [E, B]
         y = jax.ops.segment_sum(vals, edge_dst, num_segments=n,
                                 indices_are_sorted=indices_sorted)
+        if combine is not None:
+            y = combine(y)
         y = (y > 0).astype(x.dtype)
-        for term, mask in zip(wildcard_terms, wildcard_masks):
+        for term, mask in zip(wc_terms, wc_masks):
             live = jax.lax.dynamic_slice_in_dim(
                 x, term.self_offset, term.self_length, axis=0)
             any_live = jnp.max(live, axis=0, keepdims=True)  # [1, B]
@@ -117,29 +136,33 @@ def make_step(prog: GraphProgram, indices_sorted: bool = True):
     return step
 
 
+def init_state(prog: GraphProgram, q_idx) -> jnp.ndarray:
+    """One-hot [N, B] initial state from per-query state indices."""
+    n = prog.state_size
+    b = q_idx.shape[0]
+    x0 = jnp.zeros((n, b), DTYPE)
+    x0 = x0.at[q_idx, jnp.arange(b)].max(1.0)
+    return x0.at[n - 1].set(0.0)
+
+
 # -- full evaluation --------------------------------------------------------
 
-def make_evaluate(prog: GraphProgram, num_iters: int, use_while: bool = False,
-                  indices_sorted: bool = True):
+def make_evaluate(prog: GraphProgram, num_iters: int, use_while: bool = True,
+                  indices_sorted: bool = True,
+                  combine: Optional[Callable] = None,
+                  changed_reduce: Optional[Callable] = None):
     """Build fn(q_idx, edge_src, edge_dst) -> x_final of shape [N, B].
 
     q_idx: int32 [B] state index of each query's one-hot (dead index for
     padding columns).  With `use_while`, iterates until fixpoint, capped at
-    `num_iters`.
+    `num_iters`; `changed_reduce` (sharded mode) reduces the per-shard
+    convergence flag so every shard agrees on the trip count.
     """
-    n = prog.state_size
-    step = make_step(prog, indices_sorted=indices_sorted)
-
-    def init(q_idx):
-        b = q_idx.shape[0]
-        x0 = jnp.zeros((n, b), DTYPE)
-        x0 = x0.at[q_idx, jnp.arange(b)].max(1.0)
-        x0 = x0.at[n - 1].set(0.0)
-        return x0
+    step = make_step(prog, indices_sorted=indices_sorted, combine=combine)
 
     if use_while:
         def evaluate(q_idx, edge_src, edge_dst):
-            x0 = init(q_idx)
+            x0 = init_state(prog, q_idx)
 
             def cond(state):
                 x, prev_changed, i = state
@@ -149,6 +172,8 @@ def make_evaluate(prog: GraphProgram, num_iters: int, use_while: bool = False,
                 x, _, i = state
                 x1 = step(x, x0, edge_src, edge_dst)
                 changed = jnp.any(x1 != x)
+                if changed_reduce is not None:
+                    changed = changed_reduce(changed)
                 return (x1, changed, i + 1)
 
             x_final, _, _ = jax.lax.while_loop(
@@ -156,7 +181,7 @@ def make_evaluate(prog: GraphProgram, num_iters: int, use_while: bool = False,
             return x_final
     else:
         def evaluate(q_idx, edge_src, edge_dst):
-            x0 = init(q_idx)
+            x0 = init_state(prog, q_idx)
 
             def body(x, _):
                 return step(x, x0, edge_src, edge_dst), None
@@ -178,7 +203,7 @@ class KernelCache:
     def __init__(self, prog: GraphProgram, num_iters: Optional[int] = None,
                  use_while: bool = True, indices_sorted: bool = True):
         self.prog = prog
-        self.num_iters = num_iters or min(50, prog.suggested_iterations + 8)
+        self.num_iters = num_iters or MAX_ITERATIONS
         evaluate = make_evaluate(prog, self.num_iters, use_while=use_while,
                                  indices_sorted=indices_sorted)
 

@@ -1,0 +1,140 @@
+"""Multi-chip sharded reachability: 2D (data x graph) mesh over ICI/DCN.
+
+Replaces the reference's single-process graph-walk distribution (SpiceDB
+internal dispatch, reference pkg/spicedb/spicedb.go:31-47) with a
+`shard_map` program over a `jax.sharding.Mesh`:
+
+- `data` axis  — query batch sharded (each chip owns B/n_data query
+  columns): pure data parallelism for concurrent list requests, zero
+  communication.
+- `graph` axis — edge set sharded (each chip owns E/n_graph edges of the
+  tuple graph): each chip computes a partial one-step closure over the full
+  state vector, combined with a boolean all-reduce (`lax.pmax`) per
+  iteration.  This is what lets tuple counts exceed single-chip HBM.
+
+The per-iteration body is ops/spmv.make_step with the all-reduce injected
+via its `combine` hook, so single-chip and sharded kernels cannot drift.
+Convergence (while_loop) uses a globally all-reduced changed flag so every
+shard agrees on the trip count.  On a v5e-8 both axes map onto ICI, and
+`jax.distributed` extends the same program across hosts over DCN
+(SURVEY.md §5 communication-backend note).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.graph_compile import GraphProgram
+from ..ops.spmv import MAX_ITERATIONS, bucket, make_evaluate, pad_edges
+
+
+def make_mesh(devices=None, data: Optional[int] = None,
+              graph: Optional[int] = None) -> Mesh:
+    """Build a 2D (data, graph) mesh.  Defaults: square-ish split of all
+    local devices with the graph axis at least as large as the data axis."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if data is None or graph is None:
+        graph = 1
+        while graph * 2 <= n and (n // (graph * 2)) * (graph * 2) == n:
+            if graph >= (n // graph):
+                break
+            graph *= 2
+        data = n // graph
+    if data * graph != n:
+        raise ValueError(f"mesh {data}x{graph} != {n} devices")
+    arr = np.asarray(devices).reshape(data, graph)
+    return Mesh(arr, axis_names=("data", "graph"))
+
+
+def make_sharded_evaluate(prog: GraphProgram, mesh: Mesh, num_iters: int):
+    """Build fn(q_idx, edge_src, edge_dst) -> x_final [N, B] where q_idx is
+    sharded over `data` and the edge arrays over `graph`.  The state vector
+    is replicated along `graph`."""
+    shard_fn = make_evaluate(
+        prog, num_iters, use_while=True, indices_sorted=False,
+        combine=lambda y: jax.lax.pmax(y, "graph"),
+        changed_reduce=lambda c: jax.lax.pmax(
+            c.astype(jnp.int32), ("data", "graph")) > 0,
+    )
+    return jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P("data"), P("graph"), P("graph")),
+        out_specs=P(None, "data"),
+        check_vma=False,  # x is replicated along `graph` by construction
+    )
+
+
+class ShardedKernel:
+    """Sharded check/lookup entry points (multi-chip counterpart of
+    ops.spmv.KernelCache)."""
+
+    def __init__(self, prog: GraphProgram, mesh: Mesh,
+                 num_iters: Optional[int] = None):
+        self.prog = prog
+        self.mesh = mesh
+        self.num_iters = num_iters or MAX_ITERATIONS
+        evaluate = make_sharded_evaluate(prog, mesh, self.num_iters)
+
+        def run_checks(q_idx, gather_idx, gather_col, edge_src, edge_dst):
+            x = evaluate(q_idx, edge_src, edge_dst)
+            return x[gather_idx, gather_col] > 0
+
+        def run_lookup(slot_offset, slot_length, q_idx, edge_src, edge_dst):
+            x = evaluate(q_idx, edge_src, edge_dst)
+            return jax.lax.dynamic_slice_in_dim(
+                x, slot_offset, slot_length, axis=0) > 0
+
+        self._checks = jax.jit(run_checks)
+        self._lookup = jax.jit(run_lookup, static_argnums=(0, 1))
+
+    # -- shape discipline ---------------------------------------------------
+
+    def _pad_batch(self, q_idx: np.ndarray) -> np.ndarray:
+        n_data = self.mesh.shape["data"]
+        b = bucket(max(len(q_idx), 1), max(8, n_data))
+        if b % n_data:
+            b += n_data - (b % n_data)
+        out = np.full(b, self.prog.dead_index, np.int32)
+        out[: len(q_idx)] = q_idx
+        return out
+
+    def pad_edges_for_mesh(self, capacity: Optional[int] = None) -> tuple:
+        n_graph = self.mesh.shape["graph"]
+        e = max(len(self.prog.edge_src), 1)
+        cap = capacity if capacity is not None else bucket(e)
+        if cap % n_graph:
+            cap += n_graph - (cap % n_graph)
+        return pad_edges(self.prog, cap)
+
+    def device_edges(self, capacity: Optional[int] = None) -> tuple:
+        src, dst = self.pad_edges_for_mesh(capacity)
+        spec = NamedSharding(self.mesh, P("graph"))
+        return (jax.device_put(src, spec), jax.device_put(dst, spec))
+
+    # -- host-facing --------------------------------------------------------
+
+    def lookup(self, slot_offset: int, slot_length: int, q_idx: np.ndarray,
+               edge_src, edge_dst) -> np.ndarray:
+        q = self._pad_batch(np.asarray(q_idx, np.int32))
+        q = jax.device_put(q, NamedSharding(self.mesh, P("data")))
+        return np.asarray(self._lookup(slot_offset, slot_length, q,
+                                       edge_src, edge_dst))[:, : len(q_idx)]
+
+    def checks(self, q_idx: np.ndarray, gather_idx: np.ndarray,
+               gather_col: np.ndarray, edge_src, edge_dst) -> np.ndarray:
+        q = self._pad_batch(np.asarray(q_idx, np.int32))
+        q = jax.device_put(q, NamedSharding(self.mesh, P("data")))
+        g = bucket(max(len(gather_idx), 1), 8)
+        gi = np.zeros(g, np.int32)
+        gc = np.zeros(g, np.int32)
+        gi[: len(gather_idx)] = gather_idx
+        gc[: len(gather_col)] = gather_col
+        out = np.asarray(self._checks(q, jnp.asarray(gi), jnp.asarray(gc),
+                                      edge_src, edge_dst))
+        return out[: len(gather_idx)]

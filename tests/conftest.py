@@ -1,11 +1,20 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so
-multi-chip sharding tests run without TPU hardware."""
+multi-chip sharding tests run without TPU hardware.
+
+The sandbox's sitecustomize registers the `axon` TPU-relay PJRT plugin at
+interpreter start and forces `jax_platforms="axon,cpu"` via jax.config —
+the env var alone is not enough, so we override the config value too,
+before any backend initializes."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -340,3 +340,79 @@ class TestJaxEndpointBehavior:
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
         assert jx.stats["kernel_calls"] > 0
         assert jx.stats["rebuilds"] >= 1
+
+
+class TestReviewRegressions:
+    def test_wildcard_revocation_rebuilds(self):
+        jx, oracle = make_pair(WILDCARD_SCHEMA, [
+            "doc:d1#viewer@user:*",
+            "doc:d1#editor@user:eve",
+        ])
+        assert_agreement(jx, oracle, "doc", "view", users("zed", "eve"))
+        jx.store.write(delete("doc:d1#viewer@user:*"))
+        # after revoking the wildcard, arbitrary users must lose access
+        assert_agreement(jx, oracle, "doc", "view", users("zed", "eve"))
+
+    def test_touch_adds_expiry_to_existing_tuple(self):
+        import time
+        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns#viewer@user:alice"])
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+        # re-touch the same tuple, now with a short expiration
+        jx.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            f"namespace:ns#viewer@user:alice[expiration:{time.time() + 0.2}]"))])
+        time.sleep(0.25)
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+
+    def test_delete_then_readd_clears_stale_expiry(self):
+        import time
+        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns0#viewer@user:z"])
+        jx.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            f"namespace:ns#viewer@user:alice[expiration:{time.time() + 0.2}]"))])
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+        jx.store.write(delete("namespace:ns#viewer@user:alice"))
+        jx.store.write(touch("namespace:ns#viewer@user:alice"))  # no expiry
+        time.sleep(0.25)  # stale heap entry fires; must be ignored
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+
+    def test_deep_membership_chain(self):
+        # 15-deep nested groups: beyond the old rewrite-depth-derived cap
+        rels = [f"group:g{i+1}#member@group:g{i}#member" for i in range(15)]
+        rels.append("group:g0#member@user:deep")
+        rels.append("namespace:ns#viewer@group:g15#member")
+        jx, oracle = make_pair(GROUPS_SCHEMA, rels)
+        assert_agreement(jx, oracle, "namespace", "view", users("deep", "shallow"))
+
+    def test_concurrent_writes_and_checks_no_deadlock(self):
+        import threading
+        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns#viewer@user:alice"])
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+        errors = []
+
+        def writer():
+            try:
+                for i in range(30):
+                    jx.store.write(touch("namespace:ns#viewer@user:alice"))
+                    jx.store.write(delete("namespace:ns#viewer@user:alice"))
+                    jx.store.write(touch("namespace:ns#viewer@user:alice"))
+            except Exception as e:
+                errors.append(e)
+
+        def checker():
+            import asyncio
+            try:
+                for _ in range(15):
+                    asyncio.run(jx.lookup_resources(
+                        "namespace", "view", SubjectRef("user", "alice")))
+            except Exception as e:
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer),
+                   threading.Thread(target=checker)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+            assert not t.is_alive(), "deadlock: thread did not finish"
+        assert not errors, errors
+        # converge: final state must agree
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
