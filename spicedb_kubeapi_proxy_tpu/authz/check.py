@@ -1,0 +1,68 @@
+"""Bulk permission-check runner (reference pkg/authz/check.go).
+
+All Check/PostCheck templates across the matched rules resolve to
+relationships and are checked concurrently per-expression; each expression's
+relationships go through one CheckBulkPermissions call and every item must
+be HAS_PERMISSION.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..rules.engine import ResolveInput
+from ..spicedb.endpoints import PermissionsEndpoint
+from ..spicedb.types import CheckRequest, ObjectRef, SubjectRef
+
+
+class UnauthorizedError(Exception):
+    pass
+
+
+def check_request_from_rel(rel) -> CheckRequest:
+    return CheckRequest(
+        resource=ObjectRef(rel.resource_type, rel.resource_id),
+        permission=rel.resource_relation,
+        subject=SubjectRef(rel.subject_type, rel.subject_id,
+                           rel.subject_relation),
+    )
+
+
+async def check_relationships(endpoint: PermissionsEndpoint, resolved_rels: list,
+                              check_type: str) -> None:
+    """One bulk check; all must pass (reference check.go:18-72)."""
+    if not resolved_rels:
+        return
+    reqs = [check_request_from_rel(rel) for rel in resolved_rels]
+    results = await endpoint.check_bulk_permissions(reqs)
+    for rel, result in zip(resolved_rels, results):
+        if not result.allowed:
+            raise UnauthorizedError(
+                f"bulk {check_type} failed for {rel.rel_string()}")
+
+
+async def _run_exprs(endpoint: PermissionsEndpoint, rules_list: list,
+                     input: ResolveInput, attr: str, check_type: str) -> None:
+    async def one(expr):
+        resolved = expr.generate_relationships(input)
+        await check_relationships(endpoint, resolved, check_type)
+
+    tasks = [one(c) for r in rules_list for c in getattr(r, attr)]
+    if not tasks:
+        return
+    results = await asyncio.gather(*tasks, return_exceptions=True)
+    for res in results:
+        if isinstance(res, BaseException):
+            raise res
+
+
+async def run_all_matching_checks(endpoint: PermissionsEndpoint,
+                                  matching_rules: list,
+                                  input: ResolveInput) -> None:
+    await _run_exprs(endpoint, matching_rules, input, "checks", "check")
+
+
+async def run_all_matching_post_checks(endpoint: PermissionsEndpoint,
+                                       matching_rules: list,
+                                       input: ResolveInput) -> None:
+    await _run_exprs(endpoint, matching_rules, input, "post_checks", "postcheck")

@@ -1,0 +1,27 @@
+"""Watch frame capture (reference pkg/authz/frames.go).
+
+Kube JSON watch streams are newline-delimited; each complete line is one
+frame whose raw bytes must be preserved for byte-exact replay.  This
+generator re-chunks an arbitrary byte stream into raw frame lines,
+buffering partial lines across chunks (the mutex-guarded capture window in
+the reference becomes plain sequential buffering here).
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+
+async def frame_lines(stream: AsyncIterator[bytes]) -> AsyncIterator[bytes]:
+    buf = bytearray()
+    async for chunk in stream:
+        buf.extend(chunk)
+        while True:
+            idx = buf.find(b"\n")
+            if idx < 0:
+                break
+            frame = bytes(buf[: idx + 1])
+            del buf[: idx + 1]
+            yield frame
+    if buf:
+        yield bytes(buf)

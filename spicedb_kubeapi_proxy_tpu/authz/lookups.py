@@ -1,0 +1,87 @@
+"""PreFilter LookupResources (reference pkg/authz/lookups.go).
+
+Resolves the single LR template, streams allowed resource ids from the
+endpoint, and maps each id to a NamespacedName via the rule's
+fromObjectIDName/Namespace expressions.  The namespace expression is first
+queried against `{"resourceId": id}`; a null result falls back to the full
+request input (reference lookups.go:108-127).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..config.proxyrule import MATCHING_ID_FIELD_VALUE
+from ..rules import blang
+from ..rules.engine import ResolveInput, ResolvedPreFilter, _to_template_data
+from ..spicedb.endpoints import PermissionsEndpoint
+from ..spicedb.types import SubjectRef
+
+
+class PreFilterError(Exception):
+    pass
+
+
+@dataclass
+class PrefilterResult:
+    """The allowed NamespacedName set (reference lookups.go:19-36)."""
+    all_allowed: bool = False
+    allowed: set = field(default_factory=set)  # {(namespace, name)}
+    error: Optional[Exception] = None
+
+    def is_allowed(self, namespace: str, name: str) -> bool:
+        if self.all_allowed:
+            return True
+        return (namespace, name) in self.allowed
+
+
+def extract_namespaced_name(filter: ResolvedPreFilter, input: ResolveInput,
+                            resource_id: str,
+                            subject_id: str = "") -> tuple:
+    """Map an object id to (namespace, name) via the filter expressions."""
+    data = {"resourceId": resource_id, "subjectId": subject_id}
+    try:
+        name = filter.name_from_object_id.query(data)
+    except blang.BlangError as e:
+        raise PreFilterError(f"error querying name from object ID: {e}") from e
+    if not isinstance(name, str) or not name:
+        raise PreFilterError(
+            f"unable to determine name for resource {resource_id!r}")
+    try:
+        namespace = filter.namespace_from_object_id.query(data)
+    except blang.BlangError as e:
+        raise PreFilterError(f"error querying namespace from object ID: {e}") from e
+    if namespace is None:
+        # fall back to the request input for rules whose namespace comes from
+        # the request rather than the object id
+        try:
+            namespace = filter.namespace_from_object_id.query(
+                _to_template_data(input))
+        except blang.BlangError as e:
+            raise PreFilterError(
+                f"error querying namespace from input: {e}") from e
+    if namespace is None:
+        namespace = ""
+    if not isinstance(namespace, str):
+        raise PreFilterError(
+            f"namespace expression returned {type(namespace).__name__}")
+    return namespace, name
+
+
+async def run_lookup_resources(endpoint: PermissionsEndpoint,
+                               filter: ResolvedPreFilter,
+                               input: ResolveInput) -> PrefilterResult:
+    """LR + per-result extraction (reference lookups.go:43-136)."""
+    if filter.rel.resource_id != MATCHING_ID_FIELD_VALUE:
+        raise PreFilterError("preFilter called with non-$ resource ID")
+    ids = await endpoint.lookup_resources(
+        filter.rel.resource_type,
+        filter.rel.resource_relation,
+        SubjectRef(filter.rel.subject_type, filter.rel.subject_id,
+                   filter.rel.subject_relation),
+    )
+    result = PrefilterResult()
+    for rid in ids:
+        result.allowed.add(extract_namespaced_name(filter, input, rid))
+    return result

@@ -1,0 +1,173 @@
+"""Authorization middleware (reference pkg/authz/authz.go WithAuthorization).
+
+Per-request orchestration: extract ResolveInput -> match rules -> CEL filter
+-> run Checks (concurrent bulk) -> dispatch to the update workflow / watch
+filter / prefilter+response-filter / post-check / post-filter path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..proxy.httpcore import Handler, Request, Response, json_response
+from ..proxy.kube import RequestInfo
+from ..proxy.restmapper import CachingRESTMapper
+from ..rules.engine import (
+    MapMatcher,
+    ResolveError,
+    filter_rules_with_cel_conditions,
+    resolve_input_from_request,
+)
+from ..spicedb.endpoints import PermissionsEndpoint
+from .check import (
+    UnauthorizedError,
+    run_all_matching_checks,
+    run_all_matching_post_checks,
+)
+from .postfilter import filter_list_response
+from .responsefilterer import (
+    EmptyResponseFilterer,
+    StandardResponseFilterer,
+    WatchResponseFilterer,
+)
+from .rulesel import MultipleRulesError, single_pre_filter_rule, single_update_rule
+
+UPDATE_VERBS = ("create", "update", "patch", "delete")
+
+FILTERER_KEY = "response_filterer"
+
+
+def forbidden_response(message: str) -> Response:
+    return json_response(403, {
+        "kind": "Status", "apiVersion": "v1", "metadata": {},
+        "status": "Failure", "message": message, "reason": "Forbidden",
+        "code": 403,
+    })
+
+
+def always_allow(info: RequestInfo) -> bool:
+    """Unfiltered access to api metadata (reference authz.go:207-210)."""
+    return info.path in ("/api", "/apis", "/openapi/v2") and info.verb == "get"
+
+
+def should_run_post_checks(verb: str) -> bool:
+    return verb == "get"
+
+
+def should_run_post_filters(verb: str, rules_list: list) -> bool:
+    return verb == "list" and any(r.post_filter for r in rules_list)
+
+
+def with_authorization(handler: Handler, failed: Handler,
+                       rest_mapper: CachingRESTMapper,
+                       endpoint: PermissionsEndpoint,
+                       matcher_ref,  # callable returning the current matcher
+                       workflow_client=None,
+                       input_extractor=None) -> Handler:
+    """Build the authorization handler (reference authz.go:23-197).
+
+    `matcher_ref` is a zero-arg callable returning the active MapMatcher so
+    tests can swap rule sets at runtime (the reference exposes *Matcher)."""
+
+    async def authorized(req: Request) -> Response:
+        info: RequestInfo = req.context["request_info"]
+        user = req.context["user"]
+        try:
+            if input_extractor is not None:
+                input = input_extractor(req, info, user)
+            else:
+                input = resolve_input_from_request(
+                    info, user, req.body, req.headers.to_dict())
+        except ResolveError as e:
+            return forbidden_response(str(e))
+
+        if always_allow(info):
+            req.context[FILTERER_KEY] = EmptyResponseFilterer()
+            return await handler(req)
+
+        matching_rules = matcher_ref().match(info)
+        if not matching_rules:
+            return await failed(req)
+
+        try:
+            filtered_rules = filter_rules_with_cel_conditions(
+                matching_rules, input)
+        except ResolveError:
+            return await failed(req)
+        if not filtered_rules:
+            return await failed(req)
+
+        try:
+            await run_all_matching_checks(endpoint, filtered_rules, input)
+        except (UnauthorizedError, ResolveError):
+            return await failed(req)
+
+        try:
+            update_rule = single_update_rule(filtered_rules)
+        except MultipleRulesError:
+            return await failed(req)
+
+        if update_rule is not None:
+            if info.verb not in UPDATE_VERBS:
+                return await failed(req)
+            if workflow_client is None:
+                return json_response(500, {
+                    "kind": "Status", "apiVersion": "v1",
+                    "status": "Failure", "code": 500,
+                    "message": "update engine not configured"})
+            from .update import perform_update
+            try:
+                return await perform_update(update_rule, input, req,
+                                            workflow_client)
+            except Exception as e:
+                return forbidden_response(f"failed to perform update: {e}")
+
+        if info.verb == "watch":
+            try:
+                watch_rule = single_pre_filter_rule(filtered_rules)
+            except MultipleRulesError:
+                return await failed(req)
+            if watch_rule is None:
+                return await failed(req)
+            filterer = WatchResponseFilterer(rest_mapper, input, watch_rule,
+                                             endpoint)
+            try:
+                filterer.run_watcher()
+            except Exception:
+                return await failed(req)
+            req.context[FILTERER_KEY] = filterer
+            return await handler(req)
+
+        filterer = StandardResponseFilterer(rest_mapper, input,
+                                            filtered_rules, endpoint)
+        req.context[FILTERER_KEY] = filterer
+        try:
+            filterer.run_pre_filters()
+        except Exception:
+            return await failed(req)
+
+        if should_run_post_checks(info.verb):
+            resp = await handler(req)
+            if 200 <= resp.status < 300:
+                try:
+                    await run_all_matching_post_checks(endpoint,
+                                                       filtered_rules, input)
+                except (UnauthorizedError, ResolveError):
+                    return await failed(req)
+            return resp
+        if should_run_post_filters(info.verb, filtered_rules):
+            resp = await handler(req)
+            if 200 <= resp.status < 300 and info.verb == "list":
+                try:
+                    body = await filter_list_response(
+                        resp.body, filtered_rules, input, endpoint)
+                except Exception:
+                    return await failed(req)
+                resp.body = body
+                resp.headers.set("Content-Type", "application/json")
+                resp.headers.set("Content-Length", str(len(body)))
+            return resp
+        return await handler(req)
+
+    return authorized

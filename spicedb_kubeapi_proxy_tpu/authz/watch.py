@@ -1,0 +1,66 @@
+"""SpiceDB-side watch bridge (reference pkg/authz/watch.go).
+
+Watches the tuple store for updates on the prefilter's resource type; each
+update triggers a CheckPermission for the watching subject and pushes an
+allow/revoke change keyed by NamespacedName into the tracker consumed by
+the watch response filterer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from ..rules.engine import ResolveInput, ResolvedPreFilter
+from ..spicedb.endpoints import PermissionsEndpoint
+from ..spicedb.types import CheckRequest, ObjectRef, SubjectRef
+from .lookups import extract_namespaced_name
+
+
+@dataclass
+class ResultChange:
+    allowed: bool
+    namespace: str
+    name: str
+
+
+@dataclass
+class WatchTracker:
+    changes: asyncio.Queue = field(default_factory=asyncio.Queue)
+
+
+async def run_watch(endpoint: PermissionsEndpoint, tracker: WatchTracker,
+                    config: ResolvedPreFilter, input: ResolveInput,
+                    watcher=None) -> None:
+    """Long-lived store watch -> per-update check -> tracker change
+    (reference watch.go:27-111).
+
+    `watcher` should be subscribed by the caller BEFORE scheduling this
+    coroutine, so tuple writes racing the watch setup are not lost."""
+    if watcher is None:
+        watcher = endpoint.watch([config.rel.resource_type])
+    loop = asyncio.get_event_loop()
+    try:
+        while True:
+            update = await loop.run_in_executor(None, watcher.poll, 0.5)
+            if update is None:
+                if watcher.closed:
+                    return
+                continue
+            for u in update.updates:
+                resource_id = u.rel.resource.id
+                result = await endpoint.check_permission(CheckRequest(
+                    resource=ObjectRef(config.rel.resource_type, resource_id),
+                    permission=config.rel.resource_relation,
+                    subject=SubjectRef(config.rel.subject_type,
+                                       config.rel.subject_id,
+                                       config.rel.subject_relation),
+                ))
+                namespace, name = extract_namespaced_name(
+                    config, input, resource_id, u.rel.subject.id)
+                await tracker.changes.put(ResultChange(
+                    allowed=result.allowed, namespace=namespace, name=name))
+    except asyncio.CancelledError:
+        raise
+    finally:
+        watcher.close()

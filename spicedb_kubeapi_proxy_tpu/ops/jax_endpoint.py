@@ -346,6 +346,10 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def _check_batch_sync(self, reqs: list) -> list:
         with self._lock:
+            # capture the revision BEFORE draining deltas so checked_at is
+            # never newer than the evaluated snapshot (writes committing
+            # during evaluation must not be attributed to the result)
+            rev = self.store.revision
             graph = self._current_graph()
             q_arr, cols, unknown = self._encode_subjects(
                 graph, [r.subject for r in reqs])
@@ -385,7 +389,6 @@ class JaxEndpoint(PermissionsEndpoint):
                 self.stats["kernel_calls"] += 1
                 for j, row in enumerate(kernel_rows):
                     results[row] = bool(out[j])
-            rev = self.store.revision
         return [CheckResult(
             permissionship=(Permissionship.HAS_PERMISSION if r
                             else Permissionship.NO_PERMISSION),

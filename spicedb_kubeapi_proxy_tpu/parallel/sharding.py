@@ -40,11 +40,14 @@ def make_mesh(devices=None, data: Optional[int] = None,
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     if data is None or graph is None:
-        graph = 1
-        while graph * 2 <= n and (n // (graph * 2)) * (graph * 2) == n:
-            if graph >= (n // graph):
-                break
-            graph *= 2
+        # smallest factor pair with graph >= data: the graph axis is the
+        # HBM-capacity axis and must get the larger share
+        graph = n
+        g = 1
+        while g * g <= n:
+            if n % g == 0:
+                graph = n // g  # g = data candidate, n//g = graph >= g
+            g += 1
         data = n // graph
     if data * graph != n:
         raise ValueError(f"mesh {data}x{graph} != {n} devices")

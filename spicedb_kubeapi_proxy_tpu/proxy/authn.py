@@ -1,0 +1,79 @@
+"""Request authentication.
+
+Mirrors the reference's authenticator stack (pkg/proxy/authn.go): in
+embedded mode a header-based authenticator reads `X-Remote-User`,
+`X-Remote-Group`, and `X-Remote-Extra-*` (reference authn.go:78-119); in
+serving mode a TLS client certificate maps CN -> user and O -> groups (the
+kube client-cert convention).  Authenticators compose: first success wins.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .httpcore import Request
+from .kube import UserInfo
+
+REMOTE_USER_HEADER = "X-Remote-User"
+REMOTE_GROUP_HEADER = "X-Remote-Group"
+REMOTE_EXTRA_PREFIX = "X-Remote-Extra-"
+
+
+class Authenticator:
+    def authenticate(self, req: Request) -> Optional[UserInfo]:
+        raise NotImplementedError
+
+
+class HeaderAuthenticator(Authenticator):
+    """Embedded-mode authenticator (reference authn.go:78-119)."""
+
+    def authenticate(self, req: Request) -> Optional[UserInfo]:
+        name = req.headers.get(REMOTE_USER_HEADER)
+        if not name:
+            return None
+        groups = req.headers.get_all(REMOTE_GROUP_HEADER)
+        extra: dict = {}
+        for k, v in req.headers.items():
+            if k.lower().startswith(REMOTE_EXTRA_PREFIX.lower()):
+                extra.setdefault(k[len(REMOTE_EXTRA_PREFIX):].lower(), []).append(v)
+        return UserInfo(name=name, groups=list(groups), extra=extra)
+
+
+class ClientCertAuthenticator(Authenticator):
+    """TLS client-certificate authenticator: CN -> user, O -> groups."""
+
+    def authenticate(self, req: Request) -> Optional[UserInfo]:
+        cert = req.peer_cert
+        if not cert:
+            return None
+        name = ""
+        groups: list = []
+        for rdn in cert.get("subject", ()):  # ((('commonName', 'x'),), ...)
+            for key, value in rdn:
+                if key == "commonName":
+                    name = value
+                elif key == "organizationName":
+                    groups.append(value)
+        if not name:
+            return None
+        return UserInfo(name=name, groups=groups)
+
+
+class AnonymousAuthenticator(Authenticator):
+    """Kube-style anonymous fallback."""
+
+    def authenticate(self, req: Request) -> Optional[UserInfo]:
+        return UserInfo(name="system:anonymous",
+                        groups=["system:unauthenticated"])
+
+
+class AuthenticatorChain(Authenticator):
+    def __init__(self, authenticators: list):
+        self.authenticators = authenticators
+
+    def authenticate(self, req: Request) -> Optional[UserInfo]:
+        for a in self.authenticators:
+            user = a.authenticate(req)
+            if user is not None:
+                return user
+        return None

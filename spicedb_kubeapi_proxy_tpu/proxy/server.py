@@ -1,0 +1,250 @@
+"""Proxy server: handler-chain assembly and the reverse proxy
+(reference pkg/proxy/server.go).
+
+Chain (bottom-up, reference server.go:153-160):
+  PanicRecovery -> HTTPLogging -> RequestInfo -> Authentication ->
+  Authorization -> ReverseProxy(upstream, ModifyResponse=FilterResp)
+
+plus /readyz and /livez health endpoints, and the embedded in-process
+client with header-injecting transport (reference server.go:282-403 and
+pkg/inmemory).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import ssl
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..authz.middleware import (
+    FILTERER_KEY,
+    forbidden_response,
+    with_authorization,
+)
+from ..authz.responsefilterer import FilterError
+from ..config import proxyrule
+from ..rules.engine import MapMatcher
+from ..spicedb.endpoints import Bootstrap, PermissionsEndpoint, create_endpoint
+from .authn import (
+    Authenticator,
+    AuthenticatorChain,
+    ClientCertAuthenticator,
+    HeaderAuthenticator,
+    REMOTE_EXTRA_PREFIX,
+    REMOTE_GROUP_HEADER,
+    REMOTE_USER_HEADER,
+)
+from .httpcore import (
+    Handler,
+    HandlerTransport,
+    Headers,
+    HttpServer,
+    Request,
+    Response,
+    Transport,
+    json_response,
+)
+from .kube import UserInfo, parse_request_info
+from .restmapper import CachingRESTMapper
+
+logger = logging.getLogger("spicedb_kubeapi_proxy_tpu.proxy")
+
+
+@dataclass
+class Options:
+    """Server configuration (reference pkg/proxy/options.go)."""
+    spicedb_endpoint: str = "embedded://"
+    bootstrap: Optional[Bootstrap] = None
+    rules_yaml: str = ""
+    rule_configs: list = field(default_factory=list)
+    upstream_transport: Optional[Transport] = None  # kube-apiserver seam
+    authenticators: Optional[list] = None
+    workflow_database_path: str = ""  # "" => in-memory journal
+    lock_mode_default: str = proxyrule.PESSIMISTIC_LOCK_MODE
+    ssl_context: Optional[ssl.SSLContext] = None
+    endpoint_kwargs: dict = field(default_factory=dict)
+
+
+class ProxyServer:
+    """The assembled proxy (reference pkg/proxy/server.go:41-164)."""
+
+    def __init__(self, opts: Options):
+        if opts.upstream_transport is None:
+            raise ValueError("upstream_transport (kube-apiserver seam) is required")
+        self.opts = opts
+        self.endpoint: PermissionsEndpoint = create_endpoint(
+            opts.spicedb_endpoint, bootstrap=opts.bootstrap,
+            **opts.endpoint_kwargs)
+        configs = list(opts.rule_configs)
+        if opts.rules_yaml:
+            configs.extend(proxyrule.parse(opts.rules_yaml))
+        # exposed mutable matcher (reference server.go:145-146: e2e tests
+        # swap rule sets at runtime through the *Matcher pointer)
+        self.matcher = MapMatcher(configs)
+        self.rest_mapper = CachingRESTMapper(opts.upstream_transport)
+        self.authenticator: Authenticator = AuthenticatorChain(
+            opts.authenticators if opts.authenticators is not None
+            else [HeaderAuthenticator(), ClientCertAuthenticator()])
+        self.workflow_client = None  # wired by enable_dual_writes()
+        self._worker = None
+        self.handler = self._build_chain()
+        self._http: Optional[HttpServer] = None
+
+    # -- dual-write wiring ---------------------------------------------------
+
+    def enable_dual_writes(self) -> None:
+        from ..authz.distributedtx.client import setup_workflow_engine
+        self.workflow_client, self._worker = setup_workflow_engine(
+            self.endpoint, self.opts.upstream_transport,
+            self.opts.workflow_database_path,
+            default_lock_mode=self.opts.lock_mode_default)
+        self.handler = self._build_chain()
+
+    # -- chain ---------------------------------------------------------------
+
+    def _build_chain(self) -> Handler:
+        cluster_proxy = self._make_cluster_proxy()
+
+        async def failed(req: Request) -> Response:
+            return forbidden_response("forbidden: not permitted by proxy rules")
+
+        authorized = with_authorization(
+            cluster_proxy, failed, self.rest_mapper, self.endpoint,
+            matcher_ref=lambda: self.matcher,
+            workflow_client=self.workflow_client)
+
+        async def authenticated(req: Request) -> Response:
+            user = self.authenticator.authenticate(req)
+            if user is None:
+                return json_response(401, {
+                    "kind": "Status", "apiVersion": "v1", "metadata": {},
+                    "status": "Failure", "message": "Unauthorized",
+                    "reason": "Unauthorized", "code": 401})
+            req.context["user"] = user
+            return await authorized(req)
+
+        async def with_request_info(req: Request) -> Response:
+            if req.path in ("/readyz", "/livez", "/healthz"):
+                return Response(status=200, body=b"ok")
+            req.context["request_info"] = parse_request_info(req.method,
+                                                             req.target)
+            return await authenticated(req)
+
+        async def with_logging(req: Request) -> Response:
+            resp = await with_request_info(req)
+            logger.info("%s %s -> %d", req.method, req.target, resp.status)
+            return resp
+
+        async def with_panic_recovery(req: Request) -> Response:
+            try:
+                return await with_logging(req)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.exception("panic serving %s %s", req.method, req.target)
+                return json_response(500, {
+                    "kind": "Status", "apiVersion": "v1", "metadata": {},
+                    "status": "Failure", "message": f"internal error: {e}",
+                    "code": 500})
+
+        return with_panic_recovery
+
+    def _make_cluster_proxy(self) -> Handler:
+        upstream = self.opts.upstream_transport
+
+        async def cluster_proxy(req: Request) -> Response:
+            up_headers = Headers()
+            for k, v in req.headers.items():
+                lk = k.lower()
+                # the proxy owns encoding (reference server.go:98-108) and
+                # identity headers must not leak upstream
+                if lk in ("accept-encoding", "authorization", "connection",
+                          "content-length", "host"):
+                    continue
+                if lk.startswith("x-remote-"):
+                    continue
+                up_headers.add(k, v)
+            up_req = Request(method=req.method, target=req.target,
+                             headers=up_headers, body=req.body)
+            resp = await upstream.round_trip(up_req)
+
+            filterer = req.context.get(FILTERER_KEY)
+            if filterer is not None:
+                try:
+                    await filterer.filter_resp(resp, req)
+                except FilterError as e:
+                    # ModifyResponse errors surface as 502 (server.go:119-124)
+                    return json_response(502, {
+                        "kind": "Status", "apiVersion": "v1", "metadata": {},
+                        "status": "Failure",
+                        "message": f"bad gateway: {e}", "code": 502})
+            return resp
+
+        return cluster_proxy
+
+    # -- serving -------------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._http = HttpServer(self.handler, ssl_context=self.opts.ssl_context)
+        bound = await self._http.start(host, port)
+        if self._worker is not None:
+            await self._worker.start()
+        return bound
+
+    async def stop(self) -> None:
+        if self._http is not None:
+            await self._http.stop()
+            self._http = None
+        if self._worker is not None:
+            await self._worker.stop()
+
+    # -- embedded client (reference server.go:317-364, pkg/inmemory) ---------
+
+    def get_embedded_client(self, user: str = "", groups: Optional[list] = None,
+                            extra: Optional[dict] = None) -> "EmbeddedClient":
+        return EmbeddedClient(self.handler, user=user, groups=groups or [],
+                              extra=extra or {})
+
+
+class EmbeddedClient:
+    """In-process client with auth-header-injecting transport
+    (reference server.go:377-403 + inmemory/transport.go)."""
+
+    def __init__(self, handler: Handler, user: str, groups: list, extra: dict):
+        self._transport = HandlerTransport(handler)
+        self.user = user
+        self.groups = groups
+        self.extra = extra
+
+    async def request(self, method: str, target: str, body: bytes = b"",
+                      headers: Optional[list] = None) -> Response:
+        h = Headers(headers or [])
+        if self.user:
+            h.set(REMOTE_USER_HEADER, self.user)
+            for g in self.groups:
+                h.add(REMOTE_GROUP_HEADER, g)
+            for k, values in self.extra.items():
+                for v in values:
+                    h.add(REMOTE_EXTRA_PREFIX + k, v)
+        if "Accept" not in h:
+            h.set("Accept", "application/json")
+        if body and "Content-Type" not in h:
+            h.set("Content-Type", "application/json")
+        return await self._transport.round_trip(Request(
+            method=method, target=target, headers=h, body=body))
+
+    # convenience verbs
+    async def get(self, target: str, **kw) -> Response:
+        return await self.request("GET", target, **kw)
+
+    async def post(self, target: str, obj: dict, **kw) -> Response:
+        return await self.request("POST", target, body=json.dumps(obj).encode(), **kw)
+
+    async def put(self, target: str, obj: dict, **kw) -> Response:
+        return await self.request("PUT", target, body=json.dumps(obj).encode(), **kw)
+
+    async def delete(self, target: str, **kw) -> Response:
+        return await self.request("DELETE", target, **kw)

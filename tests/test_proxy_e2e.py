@@ -1,0 +1,321 @@
+"""E2E proxy tests: fake kube-apiserver + embedded client, read/list/watch
+paths (reference e2e/proxy_test.go scenarios, minus dual writes)."""
+
+import asyncio
+import json
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import FakeKubeApiServer
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
+from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipUpdate,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+definition pod {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+"""
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [get]}]
+check: [{tpl: "namespace:{{name}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-watch-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [list, watch]}]
+prefilter:
+- fromObjectIDNameExpr: "{{resourceId}}"
+  lookupMatchingResources: {tpl: "namespace:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check: [{tpl: "pod:{{namespacedName}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-watch-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list, watch]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: admin-configmaps}
+match: [{apiVersion: v1, resource: configmaps, verbs: [get]}]
+if: ["'admins' in user.groups"]
+check: []
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: postfilter-secrets}
+match: [{apiVersion: v1, resource: secrets, verbs: [list]}]
+postfilter:
+- checkPermissionTemplate: {tpl: "pod:{{namespacedName}}#view@user:{{user.name}}"}
+"""
+
+
+def make_proxy(endpoint_url="embedded://"):
+    kube = FakeKubeApiServer()
+    # seed kube objects
+    for ns in ("team-a", "team-b"):
+        kube.seed("", "v1", "namespaces", {"metadata": {"name": ns}})
+    for i in range(4):
+        ns = "team-a" if i % 2 == 0 else "team-b"
+        kube.seed("", "v1", "pods", {"metadata": {"name": f"p{i}", "namespace": ns}})
+        kube.seed("", "v1", "secrets", {"metadata": {"name": f"p{i}", "namespace": ns}})
+    kube.seed("", "v1", "configmaps", {"metadata": {"name": "cm", "namespace": "team-a"}})
+
+    proxy = ProxyServer(Options(
+        spicedb_endpoint=endpoint_url,
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=HandlerTransport(kube),
+    ))
+    # seed tuples: alice owns team-a + its pods; bob owns team-b
+    rels = ["namespace:team-a#creator@user:alice",
+            "namespace:team-b#creator@user:bob",
+            "pod:team-a/p0#creator@user:alice",
+            "pod:team-a/p2#creator@user:alice",
+            "pod:team-b/p1#creator@user:bob",
+            "pod:team-b/p3#creator@user:bob"]
+    proxy.endpoint.store.bulk_load([parse_relationship(r) for r in rels])
+    return proxy, kube
+
+
+@pytest.fixture(params=["embedded://", "jax://"])
+def proxy_kube(request):
+    return make_proxy(request.param)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestGet:
+    def test_allowed_get(self, proxy_kube):
+        proxy, _ = proxy_kube
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            resp = await alice.get("/api/v1/namespaces/team-a/pods/p0")
+            assert resp.status == 200, resp.body
+            assert json.loads(resp.body)["metadata"]["name"] == "p0"
+        run(go())
+
+    def test_denied_get(self, proxy_kube):
+        proxy, _ = proxy_kube
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            resp = await alice.get("/api/v1/namespaces/team-b/pods/p1")
+            assert resp.status == 403
+        run(go())
+
+    def test_namespace_get(self, proxy_kube):
+        proxy, _ = proxy_kube
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            assert (await alice.get("/api/v1/namespaces/team-a")).status == 200
+            assert (await alice.get("/api/v1/namespaces/team-b")).status == 403
+        run(go())
+
+    def test_unauthenticated(self, proxy_kube):
+        proxy, _ = proxy_kube
+        anon = proxy.get_embedded_client()  # no user header
+
+        async def go():
+            resp = await anon.get("/api/v1/namespaces/team-a/pods/p0")
+            assert resp.status == 401
+        run(go())
+
+    def test_unmatched_resource_forbidden(self, proxy_kube):
+        proxy, _ = proxy_kube
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            resp = await alice.get("/api/v1/nodes/n1")
+            assert resp.status == 403
+        run(go())
+
+
+class TestListFiltering:
+    def test_pods_filtered_per_user(self, proxy_kube):
+        proxy, _ = proxy_kube
+
+        async def go():
+            for user, expect in (("alice", {"p0", "p2"}), ("bob", {"p1", "p3"}),
+                                 ("mallory", set())):
+                client = proxy.get_embedded_client(user=user)
+                resp = await client.get("/api/v1/pods")
+                assert resp.status == 200, (user, resp.status, resp.body)
+                names = {i["metadata"]["name"]
+                         for i in json.loads(resp.body)["items"]}
+                assert names == expect, (user, names)
+        run(go())
+
+    def test_namespaces_filtered(self, proxy_kube):
+        proxy, _ = proxy_kube
+
+        async def go():
+            client = proxy.get_embedded_client(user="alice")
+            resp = await client.get("/api/v1/namespaces")
+            names = {i["metadata"]["name"] for i in json.loads(resp.body)["items"]}
+            assert names == {"team-a"}
+        run(go())
+
+    def test_table_list_filtered(self, proxy_kube):
+        proxy, _ = proxy_kube
+
+        async def go():
+            client = proxy.get_embedded_client(user="alice")
+            resp = await client.get(
+                "/api/v1/pods",
+                headers=[("Accept",
+                          "application/json;as=Table;v=v1;g=meta.k8s.io")])
+            assert resp.status == 200
+            table = json.loads(resp.body)
+            assert table["kind"] == "Table"
+            names = {r["object"]["metadata"]["name"] for r in table["rows"]}
+            assert names == {"p0", "p2"}
+        run(go())
+
+    def test_postfilter_list(self, proxy_kube):
+        proxy, _ = proxy_kube
+
+        async def go():
+            client = proxy.get_embedded_client(user="alice")
+            resp = await client.get("/api/v1/secrets")
+            assert resp.status == 200, resp.body
+            names = {i["metadata"]["name"] for i in json.loads(resp.body)["items"]}
+            # secrets named like alice's pods pass the postfilter template
+            assert names == {"p0", "p2"}
+        run(go())
+
+
+class TestCEL:
+    def test_group_gated_rule(self, proxy_kube):
+        proxy, _ = proxy_kube
+
+        async def go():
+            admin = proxy.get_embedded_client(user="root", groups=["admins"])
+            pleb = proxy.get_embedded_client(user="root", groups=["devs"])
+            assert (await admin.get(
+                "/api/v1/namespaces/team-a/configmaps/cm")).status == 200
+            assert (await pleb.get(
+                "/api/v1/namespaces/team-a/configmaps/cm")).status == 403
+        run(go())
+
+
+class TestAlwaysAllow:
+    def test_api_metadata(self, proxy_kube):
+        proxy, _ = proxy_kube
+
+        async def go():
+            client = proxy.get_embedded_client(user="nobody")
+            for path in ("/api", "/apis", "/openapi/v2"):
+                resp = await client.get(path)
+                assert resp.status == 200, path
+        run(go())
+
+    def test_health(self, proxy_kube):
+        proxy, _ = proxy_kube
+
+        async def go():
+            client = proxy.get_embedded_client()
+            assert (await client.get("/readyz")).status == 200
+            assert (await client.get("/livez")).status == 200
+        run(go())
+
+
+class TestWatch:
+    def test_watch_allow_buffer_revoke(self, proxy_kube):
+        proxy, kube = proxy_kube
+
+        async def go():
+            client = proxy.get_embedded_client(user="alice")
+            resp = await client.get("/api/v1/pods?watch=true")
+            assert resp.status == 200
+            assert resp.stream is not None
+            frames: asyncio.Queue = asyncio.Queue()
+
+            async def consume():
+                async for frame in resp.stream:
+                    await frames.put(json.loads(frame))
+
+            task = asyncio.ensure_future(consume())
+            try:
+                # grant first, then the kube event arrives -> replayed
+                await proxy.endpoint.write_relationships([RelationshipUpdate(
+                    UpdateOp.TOUCH,
+                    parse_relationship("pod:team-a/pnew#creator@user:alice"))])
+                await asyncio.sleep(0.6)  # let the spicedb watch propagate
+                kube.seed("", "v1", "pods", {
+                    "metadata": {"name": "pnew", "namespace": "team-a"}})
+                await kube._notify(("", "v1", "pods"), "ADDED",
+                                   kube.objects[("", "v1", "pods")]["team-a"]["pnew"])
+                ev = await asyncio.wait_for(frames.get(), 5)
+                assert ev["type"] == "ADDED"
+                assert ev["object"]["metadata"]["name"] == "pnew"
+
+                # unauthorized object -> buffered (no frame)
+                kube.seed("", "v1", "pods", {
+                    "metadata": {"name": "phidden", "namespace": "team-b"}})
+                await kube._notify(("", "v1", "pods"), "ADDED",
+                                   kube.objects[("", "v1", "pods")]["team-b"]["phidden"])
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(frames.get(), 0.7)
+
+                # late grant -> buffered frame flushed
+                await proxy.endpoint.write_relationships([RelationshipUpdate(
+                    UpdateOp.TOUCH,
+                    parse_relationship("pod:team-b/phidden#viewer@user:alice"))])
+                ev = await asyncio.wait_for(frames.get(), 5)
+                assert ev["object"]["metadata"]["name"] == "phidden"
+            finally:
+                task.cancel()
+        run(go())
+
+
+class TestMatcherSwap:
+    def test_runtime_matcher_swap(self, proxy_kube):
+        """e2e pattern: tests swap rule sets at runtime (reference
+        server.go:145-146, proxy_test.go:967-981)."""
+        from spicedb_kubeapi_proxy_tpu.config import proxyrule
+        from spicedb_kubeapi_proxy_tpu.rules.engine import MapMatcher
+        proxy, _ = proxy_kube
+
+        async def go():
+            alice = proxy.get_embedded_client(user="alice")
+            assert (await alice.get("/api/v1/namespaces/team-a/pods/p0")).status == 200
+            proxy.matcher = MapMatcher(proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: deny-all-gets}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check: [{tpl: "pod:{{namespacedName}}#view@user:nobody-has-this"}]
+"""))
+            assert (await alice.get("/api/v1/namespaces/team-a/pods/p0")).status == 403
+        run(go())
