@@ -1,0 +1,37 @@
+"""Workflow engine setup (reference pkg/authz/distributedtx/client.go):
+SQLite-file or in-memory journal, monoprocess worker, registers the two
+workflows and the activities."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...proxy.httpcore import Transport
+from ...spicedb.endpoints import PermissionsEndpoint
+from .activity import ActivityHandler
+from .engine import WorkflowEngine
+from .journal import MemoryJournal, SQLiteJournal
+from .workflow import (
+    STRATEGY_OPTIMISTIC,
+    STRATEGY_PESSIMISTIC,
+    WORKFLOWS,
+)
+
+
+def setup_workflow_engine(endpoint: PermissionsEndpoint,
+                          kube_transport: Transport,
+                          database_path: str = "",
+                          default_lock_mode: str = STRATEGY_PESSIMISTIC) -> tuple:
+    """Returns (engine-as-client, engine-as-worker); the caller starts the
+    worker (reference SetupWithSQLiteBackend / SetupWithMemoryBackend)."""
+    journal = SQLiteJournal(database_path) if database_path else MemoryJournal()
+    engine = WorkflowEngine(journal)
+    handler = ActivityHandler(endpoint, kube_transport)
+    engine.register_activity("write_to_spicedb", handler.write_to_spicedb)
+    engine.register_activity("read_relationships", handler.read_relationships)
+    engine.register_activity("write_to_kube", handler.write_to_kube)
+    engine.register_activity("check_kube_resource", handler.check_kube_resource)
+    for name, fn in WORKFLOWS.items():
+        engine.register_workflow(name, fn)
+    engine.default_lock_mode = default_lock_mode  # type: ignore[attr-defined]
+    return engine, engine

@@ -1,0 +1,221 @@
+"""Deterministic workflow runtime over the journal.
+
+A focused equivalent of the go-workflows engine the reference embeds
+(reference client.go:18-77): sequential workflows execute activities through
+`WorkflowContext.execute_activity`, every completion is journaled, and on
+crash (FailPointPanic or process restart) the instance re-runs from the top
+with completed activities replayed from the journal — activities are
+at-least-once, which is why the SpiceDB write activity carries idempotency
+keys (reference activity.go:47-102).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import traceback
+from typing import Any, Awaitable, Callable, Optional
+
+from ...utils.failpoints import FailPointPanic
+from . import journal as journal_mod
+from .journal import Journal
+
+DEFAULT_WORKFLOW_TIMEOUT = 30.0
+
+
+class WorkflowError(Exception):
+    pass
+
+
+class ActivityError(Exception):
+    """A journaled activity failure, replayed deterministically."""
+
+
+class WorkflowContext:
+    def __init__(self, instance_id: str, journal: Journal, activities: dict):
+        self.instance_id = instance_id
+        self._journal = journal
+        self._activities = activities
+        self._replay = journal.events(instance_id)
+        self._seq = 0
+
+    async def execute_activity(self, name: str, *args: Any) -> Any:
+        """Run (or replay) the next activity in the deterministic sequence."""
+        seq = self._seq
+        self._seq += 1
+        if seq < len(self._replay):
+            _, recorded_name, result, error = self._replay[seq]
+            if recorded_name != name:
+                raise WorkflowError(
+                    f"non-deterministic replay: journal has {recorded_name!r}"
+                    f" at seq {seq}, workflow asked for {name!r}")
+            if error:
+                raise ActivityError(error)
+            return result
+        fn = self._activities.get(name)
+        if fn is None:
+            raise WorkflowError(f"unknown activity {name!r}")
+        try:
+            result = fn(*args)
+            if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
+                result = await result
+        except FailPointPanic:
+            # simulated crash: do NOT journal; replay will re-execute
+            raise
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # journaled failure: deterministic on replay
+            self._journal.record_event(self.instance_id, seq, name, None,
+                                       error=str(e) or type(e).__name__)
+            self._replay = self._journal.events(self.instance_id)
+            raise ActivityError(str(e) or type(e).__name__) from e
+        # results must round-trip through JSON (journal durability)
+        result = json.loads(json.dumps(result))
+        self._journal.record_event(self.instance_id, seq, name, result)
+        self._replay = self._journal.events(self.instance_id)
+        return result
+
+    async def sleep(self, seconds: float) -> None:
+        # journaled as a no-op activity so replay doesn't re-sleep
+        seq = self._seq
+        self._seq += 1
+        if seq < len(self._replay):
+            return
+        await asyncio.sleep(seconds)
+        self._journal.record_event(self.instance_id, seq, "__sleep__", None)
+        self._replay = self._journal.events(self.instance_id)
+
+
+Workflow = Callable[[WorkflowContext, dict], Awaitable[Optional[dict]]]
+
+
+class WorkflowEngine:
+    """Client + monoprocess worker (reference client.go:32-77)."""
+
+    def __init__(self, journal: Journal, max_crash_replays: int = 50):
+        self.journal = journal
+        self._workflows: dict[str, Workflow] = {}
+        self._activities: dict[str, Callable] = {}
+        self._wakeup = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self._done_events: dict[str, asyncio.Event] = {}
+        self.max_crash_replays = max_crash_replays
+
+    # -- registration --------------------------------------------------------
+
+    def register_workflow(self, name: str, fn: Workflow) -> None:
+        self._workflows[name] = fn
+
+    def register_activity(self, name: str, fn: Callable) -> None:
+        self._activities[name] = fn
+
+    # -- client --------------------------------------------------------------
+
+    def create_instance(self, instance_id: str, workflow: str, input: dict) -> str:
+        if workflow not in self._workflows:
+            raise WorkflowError(f"unknown workflow {workflow!r}")
+        self.journal.create_instance(instance_id, workflow, input)
+        self._done_events[instance_id] = asyncio.Event()
+        if self._task is None:
+            # no polling worker: execute eagerly in this loop
+            asyncio.ensure_future(self._run_instance(instance_id))
+        else:
+            self._wakeup.set()
+        return instance_id
+
+    async def get_result(self, instance_id: str,
+                         timeout: float = DEFAULT_WORKFLOW_TIMEOUT) -> dict:
+        event = self._done_events.get(instance_id)
+        rec = self.journal.get_instance(instance_id)
+        if rec is None:
+            raise WorkflowError(f"unknown instance {instance_id!r}")
+        if rec.status == journal_mod.STATUS_PENDING:
+            if event is None:
+                raise WorkflowError(f"instance {instance_id!r} has no waiter")
+            try:
+                await asyncio.wait_for(event.wait(), timeout)
+            except asyncio.TimeoutError:
+                raise WorkflowError(
+                    f"timed out waiting for workflow {instance_id}") from None
+            rec = self.journal.get_instance(instance_id)
+        self._done_events.pop(instance_id, None)
+        if rec.status == journal_mod.STATUS_FAILED:
+            raise WorkflowError(rec.error or "workflow failed")
+        return rec.result or {}
+
+    # -- worker --------------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def run_pending_once(self) -> int:
+        """Drain all pending instances (also used for crash-recovery tests
+        and at startup to resume in-flight dual writes)."""
+        count = 0
+        for instance_id in self.journal.pending_instances():
+            await self._run_instance(instance_id)
+            count += 1
+        return count
+
+    async def _run(self) -> None:
+        cycles = 0
+        while True:
+            await self.run_pending_once()
+            cycles += 1
+            if cycles % 120 == 0:
+                self.journal.prune_completed()
+            self._wakeup.clear()
+            try:
+                await asyncio.wait_for(self._wakeup.wait(), 0.5)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _run_instance(self, instance_id: str) -> None:
+        rec = self.journal.get_instance(instance_id)
+        if rec is None or rec.status != journal_mod.STATUS_PENDING:
+            return
+        fn = self._workflows.get(rec.workflow)
+        if fn is None:
+            self.journal.complete_instance(
+                instance_id, None, error=f"unknown workflow {rec.workflow!r}")
+            self._signal(instance_id)
+            return
+        while True:
+            ctx = WorkflowContext(instance_id, self.journal, self._activities)
+            try:
+                result = await fn(ctx, rec.input)
+            except FailPointPanic:
+                # simulated crash: replay the instance (journal intact)
+                attempts = self.journal.bump_attempts(instance_id)
+                if attempts > self.max_crash_replays:
+                    self.journal.complete_instance(
+                        instance_id, None,
+                        error="workflow exceeded crash-replay budget")
+                    break
+                continue
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.journal.complete_instance(
+                    instance_id, None,
+                    error=f"workflow had a panic: {e}\n{traceback.format_exc()}")
+                break
+            self.journal.complete_instance(instance_id, result)
+            break
+        self._signal(instance_id)
+
+    def _signal(self, instance_id: str) -> None:
+        event = self._done_events.get(instance_id)
+        if event is not None:
+            event.set()

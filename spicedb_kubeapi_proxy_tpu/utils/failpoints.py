@@ -1,0 +1,43 @@
+"""Fault-injection failpoints (reference pkg/failpoints).
+
+Named panic sites with arm counters: `enable_failpoint(name, n)` makes the
+next n `fail_point(name)` calls raise FailPointPanic (simulating a process
+crash inside an activity, recovered by the workflow journal).  The reference
+gates these behind a build tag; here they are enabled via this module (a
+no-op unless armed).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class FailPointPanic(Exception):
+    """Simulates the reference's panic() at a failpoint site."""
+
+    def __init__(self, name: str):
+        self.name = name
+        super().__init__(f"failpoint panic: {name}")
+
+
+_lock = threading.Lock()
+_armed: dict[str, int] = {}
+
+
+def enable_failpoint(name: str, times: int) -> None:
+    with _lock:
+        _armed[name] = times
+
+
+def disable_all() -> None:
+    with _lock:
+        _armed.clear()
+
+
+def fail_point(name: str) -> None:
+    with _lock:
+        remaining = _armed.get(name, 0)
+        if remaining <= 0:
+            return
+        _armed[name] = remaining - 1
+    raise FailPointPanic(name)
