@@ -1,0 +1,9 @@
+"""TPU-native kube-apiserver authorizing proxy.
+
+A from-scratch framework with the capabilities of
+authzed/spicedb-kubeapi-proxy; the authorization hot path executes as
+batched boolean-SpMV reachability kernels on TPU via the `jax://` endpoint
+backend (see SURVEY.md).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+"""`python -m spicedb_kubeapi_proxy_tpu` (reference
+cmd/spicedb-kubeapi-proxy/main.go:20-29)."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

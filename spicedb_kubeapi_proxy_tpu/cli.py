@@ -1,0 +1,303 @@
+"""Command-line entry point.
+
+Python counterpart of the reference CLI (cmd/spicedb-kubeapi-proxy/main.go:20-64
+and pkg/proxy/options.go): same flag surface (word-separator normalized, so
+`--rule_config` and `--rule-config` both work), the same
+Complete -> Validate -> NewServer -> Run lifecycle, and the same endpoint
+dispatch on `--spicedb-endpoint` URL scheme — with `jax://` selecting the TPU
+execution backend.
+
+    python -m spicedb_kubeapi_proxy_tpu \
+        --backend-kubeconfig ./backend.yaml \
+        --rule-config ./rules.yaml \
+        --spicedb-endpoint jax:// \
+        --secure-port 8443
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import ssl
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+from . import __version__
+from .config import proxyrule
+from .proxy import kubeconfig as kubecfg
+from .proxy.authn import (
+    Authenticator,
+    AuthenticatorChain,
+    ClientCertAuthenticator,
+    HeaderAuthenticator,
+    TokenFileAuthenticator,
+)
+from .proxy.httpcore import Transport
+from .proxy.server import Options as ServerOptions, ProxyServer
+from .spicedb.endpoints import Bootstrap
+
+DEFAULT_WORKFLOW_DATABASE_PATH = "/tmp/dtx.sqlite"  # options.go:41
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="spicedb-kubeapi-proxy-tpu",
+        description="Authorizes Kube api requests against a relationship "
+                    "graph (TPU-accelerated via the jax:// endpoint).",
+        allow_abbrev=False,
+    )
+    p.add_argument("--version", action="version", version=__version__)
+
+    # SpiceDB endpoint options (reference options.go:106-112)
+    p.add_argument("--spicedb-endpoint", default="embedded://",
+                   help="endpoint authorizing proxy operations: embedded:// "
+                        "(in-memory host evaluator), jax:// (TPU kernel "
+                        "backend), or grpc://host:port (remote SpiceDB)")
+    p.add_argument("--spicedb-insecure", action="store_true",
+                   help="use insecure transport for the remote gRPC endpoint")
+    p.add_argument("--spicedb-skip-verify-ca", action="store_true",
+                   help="do not verify the remote endpoint's certificate chain")
+    p.add_argument("--spicedb-token", default="",
+                   help="preshared key for the remote SpiceDB")
+    p.add_argument("--spicedb-ca-path", default="",
+                   help="directory or file with CAs to trust for SpiceDB")
+    p.add_argument("--spicedb-bootstrap", default="",
+                   help="YAML file with bootstrap schema/relationships for "
+                        "embedded:// and jax:// endpoints")
+
+    # upstream cluster (options.go:203-206)
+    p.add_argument("--backend-kubeconfig", default="",
+                   help="path to the kubeconfig for the upstream apiserver; "
+                        "should authenticate with cluster-admin permission")
+    p.add_argument("--use-in-cluster-config", action="store_true",
+                   help="use the ambient service-account config as upstream")
+    p.add_argument("--override-upstream", action="store_true", default=True,
+                   help="rewrite the kubeconfig server address from the "
+                        "KUBERNETES_SERVICE_HOST/PORT environment")
+    p.add_argument("--no-override-upstream", dest="override_upstream",
+                   action="store_false")
+
+    # rules + workflow (options.go:201-202,207)
+    p.add_argument("--rule-config", default="",
+                   help="path to the proxy rule configuration (multi-doc YAML)")
+    p.add_argument("--workflow-database-path",
+                   default=DEFAULT_WORKFLOW_DATABASE_PATH,
+                   help="SQLite database backing the dual-write workflow "
+                        "engine")
+    p.add_argument("--lock-mode", default=proxyrule.PESSIMISTIC_LOCK_MODE,
+                   choices=[proxyrule.PESSIMISTIC_LOCK_MODE,
+                            proxyrule.OPTIMISTIC_LOCK_MODE],
+                   help="default dual-write locking strategy")
+
+    # serving (SecureServingOptions)
+    p.add_argument("--bind-address", default="0.0.0.0")
+    p.add_argument("--secure-port", type=int, default=443)
+    p.add_argument("--cert-dir", default="apiserver.local.config/certificates",
+                   help="directory for the serving certificate pair; a "
+                        "self-signed pair is generated if none exists")
+    p.add_argument("--tls-cert-file", default="")
+    p.add_argument("--tls-private-key-file", default="")
+    p.add_argument("--embedded-mode", action="store_true",
+                   help="serve plain HTTP with header authentication "
+                        "(X-Remote-User/-Group/-Extra-*); for use behind a "
+                        "trusted front end or for embedding")
+
+    # authentication (reference authn.go:17-53)
+    p.add_argument("--client-ca-file", default="",
+                   help="CA bundle for verifying client certificates "
+                        "(CN -> user, O -> groups)")
+    p.add_argument("--token-auth-file", default="",
+                   help="CSV file of static bearer tokens "
+                        "(token,user,uid,groups)")
+
+    p.add_argument("-v", "--verbosity", type=int, default=3,
+                   help="log verbosity (reference defaults to 3)")
+    return p
+
+
+@dataclass
+class CompletedConfig:
+    server_options: ServerOptions
+    bind_address: str
+    secure_port: int
+    embedded_mode: bool
+
+
+class OptionsError(ValueError):
+    pass
+
+
+def validate(args: argparse.Namespace) -> list:
+    """Mirror of Options.Validate (reference options.go:412-427)."""
+    errs = []
+    if not args.backend_kubeconfig and not args.use_in_cluster_config:
+        errs.append("either --backend-kubeconfig or --use-in-cluster-config"
+                    " must be specified")
+    if not args.rule_config:
+        errs.append("--rule-config is required")
+    if not args.embedded_mode and not (0 < args.secure_port < 65536):
+        errs.append(f"--secure-port {args.secure_port} is not a valid port")
+    return errs
+
+
+def complete(args: argparse.Namespace,
+             upstream_transport: Optional[Transport] = None) -> CompletedConfig:
+    """Mirror of Options.Complete (reference options.go:213-380): logging,
+    upstream transport, rules, serving certs, authenticators, endpoint."""
+    level = (logging.DEBUG if args.verbosity >= 4
+             else logging.INFO if args.verbosity >= 2 else logging.WARNING)
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+
+    rule_configs: list = []
+    if args.rule_config:
+        try:
+            with open(args.rule_config, "r", encoding="utf-8") as f:
+                rules_yaml = f.read()
+        except OSError as e:
+            raise OptionsError(f"couldn't load rule config: {e}") from e
+        try:
+            rule_configs = proxyrule.parse(rules_yaml)
+        except Exception as e:
+            raise OptionsError(f"invalid rule config: {e}") from e
+
+    if upstream_transport is None:
+        if args.use_in_cluster_config:
+            ctx = kubecfg.in_cluster_context()
+        elif args.backend_kubeconfig:
+            try:
+                ctx = kubecfg.load_kubeconfig(
+                    args.backend_kubeconfig,
+                    override_upstream=args.override_upstream)
+            except OSError as e:
+                raise OptionsError(
+                    f"couldn't load kubeconfig from path: {e}") from e
+        else:
+            raise OptionsError("no upstream configured")
+        upstream_transport = kubecfg.transport_for(ctx)
+
+    bootstrap = None
+    if args.spicedb_bootstrap:
+        try:
+            bootstrap = Bootstrap.from_file(args.spicedb_bootstrap)
+        except (OSError, ValueError) as e:
+            raise OptionsError(f"couldn't load spicedb bootstrap: {e}") from e
+
+    ssl_context: Optional[ssl.SSLContext] = None
+    authenticators: list[Authenticator] = []
+    if args.embedded_mode:
+        authenticators.append(HeaderAuthenticator())
+    else:
+        cert_file, key_file = args.tls_cert_file, args.tls_private_key_file
+        if bool(cert_file) != bool(key_file):
+            raise OptionsError(
+                "--tls-cert-file and --tls-private-key-file must be"
+                " specified together")
+        if not cert_file:
+            cert_file, key_file = kubecfg.generate_self_signed_cert(
+                args.cert_dir, hosts=[args.bind_address])
+        ssl_context = kubecfg.serving_ssl_context(
+            cert_file, key_file, client_ca_file=args.client_ca_file)
+        if args.client_ca_file:
+            authenticators.append(ClientCertAuthenticator())
+    if args.token_auth_file:
+        try:
+            authenticators.append(TokenFileAuthenticator(args.token_auth_file))
+        except OSError as e:
+            raise OptionsError(f"couldn't load token auth file: {e}") from e
+    if not authenticators:
+        # serving mode with no explicit authn: accept client certs if the
+        # handshake produced one (self-signed default trusts none)
+        authenticators.append(ClientCertAuthenticator())
+
+    endpoint_kwargs = {}
+    if args.spicedb_endpoint.startswith(("grpc", "http")):
+        endpoint_kwargs = {
+            "token": args.spicedb_token,
+            "insecure": args.spicedb_insecure,
+            "skip_verify_ca": args.spicedb_skip_verify_ca,
+            "ca_path": args.spicedb_ca_path,
+        }
+
+    server_options = ServerOptions(
+        spicedb_endpoint=args.spicedb_endpoint,
+        bootstrap=bootstrap,
+        rule_configs=rule_configs,
+        upstream_transport=upstream_transport,
+        authenticators=authenticators,
+        workflow_database_path=args.workflow_database_path,
+        lock_mode_default=args.lock_mode,
+        ssl_context=ssl_context,
+        endpoint_kwargs=endpoint_kwargs,
+    )
+    return CompletedConfig(server_options=server_options,
+                           bind_address=args.bind_address,
+                           secure_port=args.secure_port,
+                           embedded_mode=args.embedded_mode)
+
+
+async def run_server(completed: CompletedConfig) -> None:
+    """Server.Run equivalent (reference server.go:170-208): serve until
+    SIGINT/SIGTERM."""
+    server = ProxyServer(completed.server_options)
+    server.enable_dual_writes()
+    port = await server.start(completed.bind_address, completed.secure_port)
+    scheme = "http" if completed.embedded_mode else "https"
+    logging.getLogger("spicedb_kubeapi_proxy_tpu").info(
+        "serving on %s://%s:%d", scheme, completed.bind_address, port)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # non-unix
+            pass
+    try:
+        await stop.wait()
+    finally:
+        await server.stop()
+
+
+def _normalize_argv(argv: list) -> list:
+    """pflag word-separator normalization (reference main.go:23): underscores
+    in flag names are equivalent to dashes."""
+    out = []
+    for a in argv:
+        if a.startswith("--") and "=" in a:
+            name, _, val = a.partition("=")
+            out.append(name.replace("_", "-") + "=" + val)
+        elif a.startswith("--"):
+            out.append(a.replace("_", "-"))
+        else:
+            out.append(a)
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(_normalize_argv(
+        list(sys.argv[1:] if argv is None else argv)))
+    errs = validate(args)
+    if errs:
+        for e in errs:
+            print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        completed = complete(args)
+    except OptionsError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    try:
+        asyncio.run(run_server(completed))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

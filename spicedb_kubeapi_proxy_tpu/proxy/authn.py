@@ -59,6 +59,38 @@ class ClientCertAuthenticator(Authenticator):
         return UserInfo(name=name, groups=groups)
 
 
+class TokenFileAuthenticator(Authenticator):
+    """Static bearer-token authenticator in the kube token-auth-file format
+    (`token,user,uid[,"group1,group2"]` CSV rows), one of the built-in
+    authentication modes the reference composes in via
+    BuiltInAuthenticationOptions (reference authn.go:17-53)."""
+
+    def __init__(self, path: str):
+        import csv
+
+        self._by_token: dict[str, UserInfo] = {}
+        with open(path, "r", encoding="utf-8", newline="") as f:
+            for row in csv.reader(f):
+                if not row or len(row) < 3:
+                    continue
+                token, name, uid = row[0], row[1], row[2]
+                groups = [g for g in (row[3].split(",") if len(row) > 3 else [])
+                          if g]
+                self._by_token[token] = UserInfo(name=name, uid=uid,
+                                                 groups=groups)
+
+    def authenticate(self, req: Request) -> Optional[UserInfo]:
+        auth = req.headers.get("Authorization")
+        if not auth.startswith("Bearer "):
+            return None
+        user = self._by_token.get(auth[len("Bearer "):].strip())
+        if user is None:
+            return None
+        return UserInfo(name=user.name, uid=user.uid,
+                        groups=list(user.groups),
+                        extra={k: list(v) for k, v in user.extra.items()})
+
+
 class AnonymousAuthenticator(Authenticator):
     """Kube-style anonymous fallback."""
 

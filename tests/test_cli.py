@@ -1,0 +1,304 @@
+"""CLI / options tests (reference pkg/proxy/options_test.go and
+cmd/spicedb-kubeapi-proxy/main.go): flag parsing + normalization,
+Validate invariants, Complete wiring (rules, kubeconfig transport,
+self-signed serving certs, authenticators), and an end-to-end serve/request
+round trip over real TLS."""
+
+import asyncio
+import base64
+import json
+import ssl
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu import cli
+from spicedb_kubeapi_proxy_tpu.config import proxyrule
+from spicedb_kubeapi_proxy_tpu.proxy import kubeconfig as kubecfg
+from spicedb_kubeapi_proxy_tpu.proxy.authn import (
+    ClientCertAuthenticator,
+    HeaderAuthenticator,
+    TokenFileAuthenticator,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+    Headers,
+    Request,
+    Response,
+    Transport,
+)
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [get]}]
+check: [{tpl: "namespace:{{name}}#view@user:{{user.name}}"}]
+"""
+
+
+def parse(argv):
+    return cli.build_parser().parse_args(cli._normalize_argv(argv))
+
+
+# -- flag parsing ------------------------------------------------------------
+
+def test_defaults():
+    args = parse([])
+    assert args.spicedb_endpoint == "embedded://"
+    assert args.workflow_database_path == cli.DEFAULT_WORKFLOW_DATABASE_PATH
+    assert args.lock_mode == proxyrule.PESSIMISTIC_LOCK_MODE
+    assert args.override_upstream is True
+    assert args.secure_port == 443
+    assert args.verbosity == 3
+
+
+def test_word_separator_normalization():
+    # pflag WordSepNormalizeFunc equivalence (reference main.go:23)
+    args = parse(["--rule_config", "/tmp/r.yaml",
+                  "--spicedb_endpoint=jax://"])
+    assert args.rule_config == "/tmp/r.yaml"
+    assert args.spicedb_endpoint == "jax://"
+
+
+def test_lock_mode_choices():
+    with pytest.raises(SystemExit):
+        parse(["--lock-mode", "Bogus"])
+
+
+# -- Validate (reference options.go:412-427) ---------------------------------
+
+def test_validate_requires_upstream_and_rules():
+    errs = cli.validate(parse([]))
+    assert any("--backend-kubeconfig" in e for e in errs)
+    assert any("--rule-config" in e for e in errs)
+
+
+def test_validate_ok_with_in_cluster_and_rules():
+    errs = cli.validate(parse(["--use-in-cluster-config",
+                               "--rule-config", "r.yaml"]))
+    assert errs == []
+
+
+def test_validate_rejects_bad_port():
+    errs = cli.validate(parse(["--use-in-cluster-config",
+                               "--rule-config", "r.yaml",
+                               "--secure-port", "0"]))
+    assert any("secure-port" in e for e in errs)
+
+
+# -- kubeconfig loading (reference options.go:382-449) -----------------------
+
+def write_kubeconfig(tmp_path, server="https://kube.example:6443",
+                     token="", insecure=False):
+    cfg = {
+        "apiVersion": "v1", "kind": "Config",
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx",
+                      "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {
+            "server": server,
+            "insecure-skip-tls-verify": insecure,
+        }}],
+        "users": [{"name": "u", "user": {"token": token} if token else {}}],
+    }
+    path = tmp_path / "kubeconfig.yaml"
+    path.write_text(json.dumps(cfg))
+    return str(path)
+
+
+def test_load_kubeconfig_current_context(tmp_path):
+    path = write_kubeconfig(tmp_path, token="sekrit")
+    ctx = kubecfg.load_kubeconfig(path)
+    assert ctx.server == "https://kube.example:6443"
+    assert ctx.token == "sekrit"
+
+
+def test_load_kubeconfig_override_upstream(tmp_path, monkeypatch):
+    # reference options.go:396-407: env rewrites every cluster server
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    ctx = kubecfg.load_kubeconfig(write_kubeconfig(tmp_path),
+                                  override_upstream=True)
+    assert ctx.server == "https://10.0.0.1:443"
+
+
+def test_load_kubeconfig_cert_data(tmp_path):
+    ca = base64.b64encode(b"CERTDATA").decode()
+    cfg = {
+        "current-context": "ctx",
+        "contexts": [{"name": "ctx",
+                      "context": {"cluster": "c", "user": "u"}}],
+        "clusters": [{"name": "c", "cluster": {
+            "server": "https://k:6443",
+            "certificate-authority-data": ca}}],
+        "users": [{"name": "u", "user": {}}],
+    }
+    path = tmp_path / "k.yaml"
+    path.write_text(json.dumps(cfg))
+    assert kubecfg.load_kubeconfig(str(path)).ca_data == b"CERTDATA"
+
+
+def test_bearer_token_transport_injects():
+    seen = {}
+
+    class Rec(Transport):
+        async def round_trip(self, req):
+            seen["auth"] = req.headers.get("Authorization")
+            return Response(status=200)
+
+    t = kubecfg.BearerTokenTransport(Rec(), "tok")
+    asyncio.run(t.round_trip(Request(method="GET", target="/x")))
+    assert seen["auth"] == "Bearer tok"
+
+
+# -- Complete (reference options.go:213-380) ---------------------------------
+
+class NullTransport(Transport):
+    async def round_trip(self, req):
+        return Response(status=200, body=b"{}")
+
+
+def test_complete_loads_and_validates_rules(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    args = parse(["--rule-config", str(rules), "--use-in-cluster-config",
+                  "--embedded-mode"])
+    completed = cli.complete(args, upstream_transport=NullTransport())
+    assert len(completed.server_options.rule_configs) == 1
+    assert completed.embedded_mode
+
+
+def test_complete_rejects_invalid_rules(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text("apiVersion: authzed.com/v1alpha1\nkind: Nope\n")
+    args = parse(["--rule-config", str(rules), "--embedded-mode"])
+    with pytest.raises(cli.OptionsError, match="invalid rule config"):
+        cli.complete(args, upstream_transport=NullTransport())
+
+
+def test_complete_missing_kubeconfig_errors(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    args = parse(["--rule-config", str(rules),
+                  "--backend-kubeconfig", str(tmp_path / "absent.yaml")])
+    with pytest.raises(cli.OptionsError, match="kubeconfig"):
+        cli.complete(args)
+
+
+def test_complete_embedded_mode_uses_header_auth(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    args = parse(["--rule-config", str(rules), "--embedded-mode"])
+    completed = cli.complete(args, upstream_transport=NullTransport())
+    kinds = [type(a) for a in completed.server_options.authenticators]
+    assert kinds == [HeaderAuthenticator]
+    assert completed.server_options.ssl_context is None
+
+
+def test_complete_serving_mode_generates_self_signed_certs(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    args = parse(["--rule-config", str(rules),
+                  "--cert-dir", str(tmp_path / "certs")])
+    completed = cli.complete(args, upstream_transport=NullTransport())
+    assert completed.server_options.ssl_context is not None
+    assert (tmp_path / "certs" / "tls.crt").exists()
+    assert (tmp_path / "certs" / "tls.key").exists()
+    # idempotent: second Complete reuses the pair
+    before = (tmp_path / "certs" / "tls.crt").read_bytes()
+    cli.complete(args, upstream_transport=NullTransport())
+    assert (tmp_path / "certs" / "tls.crt").read_bytes() == before
+
+
+def test_complete_rejects_half_specified_tls_pair(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    args = parse(["--rule-config", str(rules),
+                  "--tls-cert-file", str(tmp_path / "tls.crt")])
+    with pytest.raises(cli.OptionsError, match="together"):
+        cli.complete(args, upstream_transport=NullTransport())
+
+
+def test_complete_missing_token_auth_file_errors(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    args = parse(["--rule-config", str(rules), "--embedded-mode",
+                  "--token-auth-file", str(tmp_path / "absent.csv")])
+    with pytest.raises(cli.OptionsError, match="token auth file"):
+        cli.complete(args, upstream_transport=NullTransport())
+
+
+def test_complete_token_auth_file(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    tokens = tmp_path / "tokens.csv"
+    tokens.write_text('tok1,alice,uid1,"dev,ops"\ntok2,bob,uid2\n')
+    args = parse(["--rule-config", str(rules), "--embedded-mode",
+                  "--token-auth-file", str(tokens)])
+    completed = cli.complete(args, upstream_transport=NullTransport())
+    tf = [a for a in completed.server_options.authenticators
+          if isinstance(a, TokenFileAuthenticator)]
+    assert len(tf) == 1
+    req = Request(method="GET", target="/",
+                  headers=Headers([("Authorization", "Bearer tok1")]))
+    user = tf[0].authenticate(req)
+    assert user.name == "alice" and user.groups == ["dev", "ops"]
+    assert tf[0].authenticate(Request(
+        method="GET", target="/",
+        headers=Headers([("Authorization", "Bearer nope")]))) is None
+
+
+# -- end-to-end: serve over TLS and round-trip a request ---------------------
+
+def test_serve_tls_end_to_end(tmp_path):
+    """complete() -> ProxyServer over real TLS -> authenticated request is
+    authorized and proxied (upstream faked)."""
+    from spicedb_kubeapi_proxy_tpu.proxy.server import ProxyServer
+
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    tokens = tmp_path / "tokens.csv"
+    tokens.write_text("tok1,alice,uid1\n")
+
+    class Upstream(Transport):
+        async def round_trip(self, req):
+            return Response(status=200, body=json.dumps({
+                "kind": "Namespace", "apiVersion": "v1",
+                "metadata": {"name": "ns1"}}).encode())
+
+    args = parse(["--rule-config", str(rules),
+                  "--cert-dir", str(tmp_path / "certs"),
+                  "--token-auth-file", str(tokens),
+                  "--use-in-cluster-config"])
+    completed = cli.complete(args, upstream_transport=Upstream())
+
+    async def run():
+        server = ProxyServer(completed.server_options)
+        # seed the permission the check rule requires
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipUpdate, UpdateOp, parse_relationship)
+        await server.endpoint.write_relationships([RelationshipUpdate(
+            op=UpdateOp.TOUCH,
+            rel=parse_relationship("namespace:ns1#viewer@user:alice"))])
+        port = await server.start("127.0.0.1", 0)
+        try:
+            ssl_ctx = ssl.create_default_context()
+            ssl_ctx.check_hostname = False
+            ssl_ctx.verify_mode = ssl.CERT_NONE
+            from spicedb_kubeapi_proxy_tpu.proxy.httpcore import H11Transport
+            client = H11Transport(f"https://127.0.0.1:{port}",
+                                  ssl_context=ssl_ctx)
+            ok = await client.round_trip(Request(
+                method="GET", target="/api/v1/namespaces/ns1",
+                headers=Headers([("Authorization", "Bearer tok1"),
+                                 ("Accept", "application/json")])))
+            anon = await client.round_trip(Request(
+                method="GET", target="/api/v1/namespaces/ns1",
+                headers=Headers([("Accept", "application/json")])))
+            return ok, anon
+        finally:
+            await server.stop()
+
+    ok, anon = asyncio.run(run())
+    assert ok.status == 200
+    assert json.loads(ok.body)["metadata"]["name"] == "ns1"
+    assert anon.status == 401
