@@ -38,11 +38,16 @@ class KubeconfigContext:
     insecure_skip_tls_verify: bool = False
 
 
-def _b64_or_file(entry: dict, data_key: str, path_key: str) -> bytes:
+def _b64_or_file(entry: dict, data_key: str, path_key: str,
+                 base_dir: str = "") -> bytes:
     if entry.get(data_key):
         return base64.b64decode(entry[data_key])
     path = entry.get(path_key)
     if path:
+        # relative cert paths resolve against the kubeconfig's directory
+        # (standard clientcmd semantics)
+        if base_dir and not os.path.isabs(path):
+            path = os.path.join(base_dir, path)
         with open(path, "rb") as f:
             return f.read()
     return b""
@@ -71,18 +76,33 @@ def load_kubeconfig(path: str,
     ctx = by_name("contexts", current).get("context", {}) if current else {}
     clusters = data.get("clusters", []) or []
     users = data.get("users", []) or []
-    cluster = (by_name("clusters", ctx.get("cluster", "")).get("cluster")
-               or (clusters[0].get("cluster", {}) if clusters else {}))
-    user = (by_name("users", ctx.get("user", "")).get("user")
-            or (users[0].get("user", {}) if users else {}))
+    # a named cluster/user that is missing is an error (clientcmd semantics);
+    # the single-entry fallback applies only when nothing is named
+    if ctx.get("cluster"):
+        cluster = by_name("clusters", ctx["cluster"]).get("cluster")
+        if cluster is None:
+            raise ValueError(
+                f"kubeconfig context references unknown cluster"
+                f" {ctx['cluster']!r}")
+    else:
+        cluster = clusters[0].get("cluster", {}) if clusters else {}
+    if ctx.get("user"):
+        user = by_name("users", ctx["user"]).get("user")
+        if user is None:
+            raise ValueError(
+                f"kubeconfig context references unknown user {ctx['user']!r}")
+    else:
+        user = users[0].get("user", {}) if users else {}
 
+    base_dir = os.path.dirname(path)
     out = KubeconfigContext(
         server=cluster.get("server", ""),
         ca_data=_b64_or_file(cluster, "certificate-authority-data",
-                             "certificate-authority"),
+                             "certificate-authority", base_dir),
         client_cert_data=_b64_or_file(user, "client-certificate-data",
-                                      "client-certificate"),
-        client_key_data=_b64_or_file(user, "client-key-data", "client-key"),
+                                      "client-certificate", base_dir),
+        client_key_data=_b64_or_file(user, "client-key-data", "client-key",
+                                     base_dir),
         token=user.get("token", ""),
         insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify")),
     )

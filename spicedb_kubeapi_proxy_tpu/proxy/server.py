@@ -66,6 +66,8 @@ class Options:
     lock_mode_default: str = proxyrule.PESSIMISTIC_LOCK_MODE
     ssl_context: Optional[ssl.SSLContext] = None
     endpoint_kwargs: dict = field(default_factory=dict)
+    # endpoint-boundary check/LR latency + batch-size metrics (SURVEY.md §5)
+    enable_metrics: bool = True
 
 
 class ProxyServer:
@@ -78,6 +80,11 @@ class ProxyServer:
         self.endpoint: PermissionsEndpoint = create_endpoint(
             opts.spicedb_endpoint, bootstrap=opts.bootstrap,
             **opts.endpoint_kwargs)
+        if opts.enable_metrics:
+            from ..spicedb.instrumented import InstrumentedEndpoint
+            self.endpoint = InstrumentedEndpoint(
+                self.endpoint,
+                backend_label=opts.spicedb_endpoint.split(":")[0])
         configs = list(opts.rule_configs)
         if opts.rules_yaml:
             configs.extend(proxyrule.parse(opts.rules_yaml))
@@ -124,6 +131,14 @@ class ProxyServer:
                     "status": "Failure", "message": "Unauthorized",
                     "reason": "Unauthorized", "code": 401})
             req.context["user"] = user
+            # /metrics requires authentication (kube-apiserver semantics);
+            # only the health endpoints are open
+            if req.path == "/metrics" and self.opts.enable_metrics:
+                from ..utils.metrics import REGISTRY
+                resp = Response(status=200, body=REGISTRY.render().encode())
+                resp.headers.set("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                return resp
             return await authorized(req)
 
         async def with_request_info(req: Request) -> Response:
@@ -136,6 +151,15 @@ class ProxyServer:
         async def with_logging(req: Request) -> Response:
             resp = await with_request_info(req)
             logger.info("%s %s -> %d", req.method, req.target, resp.status)
+            if self.opts.enable_metrics:
+                from ..utils.metrics import REGISTRY
+                info = req.context.get("request_info")
+                REGISTRY.counter(
+                    "proxy_http_requests_total",
+                    "Proxied HTTP requests by verb and status code",
+                    labels=("verb", "code")).inc(
+                        verb=(info.verb if info else req.method.lower()),
+                        code=resp.status)
             return resp
 
         async def with_panic_recovery(req: Request) -> Response:

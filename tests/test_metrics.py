@@ -1,0 +1,213 @@
+"""Metrics subsystem tests: registry/exposition primitives, the
+endpoint-boundary instrumentation wrapper (SURVEY.md §5), and the proxy's
+/metrics route."""
+
+import asyncio
+import json
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.instrumented import InstrumentedEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.utils import metrics as m
+
+SCHEMA = """
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+
+
+# -- primitives --------------------------------------------------------------
+
+def test_counter_labels_and_render():
+    c = m.Counter("reqs_total", "requests", labels=("verb",))
+    c.inc(verb="get")
+    c.inc(verb="get")
+    c.inc(verb="list")
+    assert c.value(verb="get") == 2
+    lines = c.render()
+    assert 'reqs_total{verb="get"} 2' in lines
+    assert 'reqs_total{verb="list"} 1' in lines
+
+
+def test_histogram_buckets_sum_count():
+    h = m.Histogram("lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    lines = h.render()
+    assert 'lat_bucket{le="0.1"} 1' in lines
+    assert 'lat_bucket{le="1"} 2' in lines
+    assert 'lat_bucket{le="+Inf"} 3' in lines
+    assert any(line.startswith("lat_sum") and "5.55" in line
+               for line in lines)
+    assert "lat_count 3" in lines
+
+
+def test_gauge_callback_sampled_at_render():
+    state = {"v": 1.0}
+    g = m.Gauge("g", callback=lambda: state["v"])
+    assert "g 1" in g.render()
+    state["v"] = 7.5
+    assert "g 7.5" in g.render()
+
+
+def test_registry_render_and_dedup():
+    reg = m.Registry()
+    c1 = reg.counter("x_total", "help text")
+    c2 = reg.counter("x_total")
+    assert c1 is c2
+    c1.inc()
+    text = reg.render()
+    assert "# HELP x_total help text" in text
+    assert "# TYPE x_total counter" in text
+    assert "\nx_total 1\n" in text
+
+
+def test_label_escaping():
+    c = m.Counter("c_total", labels=("path",))
+    c.inc(path='we"ird\npath')
+    assert c.render() == ['c_total{path="we\\"ird\\npath"} 1']
+
+
+# -- endpoint instrumentation ------------------------------------------------
+
+def make_instrumented():
+    reg = m.Registry()
+    ep = EmbeddedEndpoint(sch.parse_schema(SCHEMA))
+    inst = InstrumentedEndpoint(ep, registry=reg, backend_label="embedded")
+    return inst, reg
+
+
+def test_instrumented_endpoint_records_latency_and_batch_size():
+    inst, reg = make_instrumented()
+
+    async def run():
+        await inst.write_relationships([RelationshipUpdate(
+            op=UpdateOp.TOUCH,
+            rel=parse_relationship("doc:d1#viewer@user:alice"))])
+        reqs = [CheckRequest(resource=ObjectRef("doc", "d1"),
+                             permission="view",
+                             subject=SubjectRef("user", u))
+                for u in ("alice", "bob", "carol")]
+        results = await inst.check_bulk_permissions(reqs)
+        ids = await inst.lookup_resources_batch(
+            "doc", "view", [SubjectRef("user", "alice")])
+        return results, ids
+
+    results, ids = asyncio.run(run())
+    assert [r.allowed for r in results] == [True, False, False]
+    assert ids == [["d1"]]
+    assert inst.latency.count(verb="check_bulk", backend="embedded") == 1
+    assert inst.batch_size.count(verb="check_bulk", backend="embedded") == 1
+    text = reg.render()
+    assert 'authz_endpoint_batch_size_bucket{verb="check_bulk"' in text
+    # the 3-check bulk lands in the le="4" bucket
+    assert ('authz_endpoint_batch_size_bucket{verb="check_bulk",'
+            'backend="embedded",le="4"} 1') in text
+
+
+def test_instrumented_endpoint_counts_errors():
+    inst, _ = make_instrumented()
+
+    async def bad():
+        await inst.lookup_resources("nosuchtype", "view",
+                                    SubjectRef("user", "alice"))
+
+    with pytest.raises(Exception):
+        asyncio.run(bad())
+    assert inst.errors.value(verb="lookup_resources",
+                             backend="embedded") == 1
+
+
+def test_instrumented_passthrough_store_and_watch():
+    inst, _ = make_instrumented()
+    assert inst.store is inst.inner.store
+    w = inst.watch()
+    assert w is not None
+    w.close()
+
+
+def test_jax_stats_gauges():
+    pytest.importorskip("jax")
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+
+    reg = m.Registry()
+    ep = JaxEndpoint(sch.parse_schema(SCHEMA))
+    inst = InstrumentedEndpoint(ep, registry=reg, backend_label="jax")
+
+    async def run():
+        await inst.write_relationships([RelationshipUpdate(
+            op=UpdateOp.TOUCH,
+            rel=parse_relationship("doc:d1#viewer@user:alice"))])
+        return await inst.check_permission(CheckRequest(
+            resource=ObjectRef("doc", "d1"), permission="view",
+            subject=SubjectRef("user", "alice")))
+
+    res = asyncio.run(run())
+    assert res.allowed
+    text = reg.render()
+    assert "authz_device_graph_rebuilds_total 1" in text
+    assert "authz_device_graph_kernel_calls_total 1" in text
+
+
+# -- proxy /metrics route ----------------------------------------------------
+
+def test_proxy_metrics_route():
+    from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+        Headers, Request, Response, Transport)
+    from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+
+    class Upstream(Transport):
+        async def round_trip(self, req):
+            return Response(status=200, body=b"{}")
+
+    rules = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-ns}
+match: [{apiVersion: v1, resource: namespaces, verbs: [get]}]
+check: [{tpl: "namespace:{{name}}#view@user:{{user.name}}"}]
+"""
+    bootstrap = Bootstrap(schema_text="""
+definition user {}
+definition namespace {
+  relation viewer: user
+  permission view = viewer
+}
+""", relationships_text="namespace:ns1#viewer@user:alice")
+
+    server = ProxyServer(Options(
+        rules_yaml=rules, bootstrap=bootstrap,
+        upstream_transport=Upstream()))
+    client = server.get_embedded_client(user="alice")
+
+    anon = server.get_embedded_client()  # no user header
+
+    async def run():
+        ok = await client.get("/api/v1/namespaces/ns1")
+        metrics = await client.get("/metrics")
+        denied = await anon.get("/metrics")
+        return ok, metrics, denied
+
+    ok, metrics, denied = asyncio.run(run())
+    assert ok.status == 200
+    text = metrics.body.decode()
+    assert metrics.status == 200
+    assert "authz_endpoint_latency_seconds" in text
+    assert 'proxy_http_requests_total{verb="get",code="200"}' in text
+    # /metrics requires authentication (kube-apiserver semantics)
+    assert denied.status == 401
