@@ -131,8 +131,9 @@ class ProxyServer:
                     "status": "Failure", "message": "Unauthorized",
                     "reason": "Unauthorized", "code": 401})
             req.context["user"] = user
-            # /metrics requires authentication (kube-apiserver semantics);
-            # only the health endpoints are open
+            # /metrics is authenticated-only: any valid principal may scrape
+            # (weaker than kube-apiserver, which additionally authorizes the
+            # path via RBAC nonResourceURLs); health endpoints stay open
             if req.path == "/metrics" and self.opts.enable_metrics:
                 from ..utils.metrics import REGISTRY
                 resp = Response(status=200, body=REGISTRY.render().encode())
@@ -148,18 +149,23 @@ class ProxyServer:
                                                              req.target)
             return await authenticated(req)
 
+        if self.opts.enable_metrics:
+            from ..utils.metrics import REGISTRY
+            request_counter = REGISTRY.counter(
+                "proxy_http_requests_total",
+                "Proxied HTTP requests by verb and status code",
+                labels=("verb", "code"))
+        else:
+            request_counter = None
+
         async def with_logging(req: Request) -> Response:
             resp = await with_request_info(req)
             logger.info("%s %s -> %d", req.method, req.target, resp.status)
-            if self.opts.enable_metrics:
-                from ..utils.metrics import REGISTRY
+            if request_counter is not None:
                 info = req.context.get("request_info")
-                REGISTRY.counter(
-                    "proxy_http_requests_total",
-                    "Proxied HTTP requests by verb and status code",
-                    labels=("verb", "code")).inc(
-                        verb=(info.verb if info else req.method.lower()),
-                        code=resp.status)
+                request_counter.inc(
+                    verb=(info.verb if info else req.method.lower()),
+                    code=resp.status)
             return resp
 
         async def with_panic_recovery(req: Request) -> Response:

@@ -1,0 +1,197 @@
+"""Cross-request batched dispatch (SURVEY.md §2, parallelism table).
+
+The reference fans each HTTP request's checks into one
+`CheckBulkPermissions` RPC (pkg/authz/check.go:23-48) but batches only
+*within* a request.  On TPU the batch IS the kernel invocation, so this
+wrapper also coalesces across concurrent requests: concurrent
+check/LookupResources callers enqueue work, and a drain loop issues fused
+calls to the inner endpoint.
+
+Policy ("natural batching"): when no inner call is in flight, the queue
+flushes immediately — single-caller latency is one kernel call, same as
+direct dispatch.  While a call is in flight, new arrivals accumulate and go
+out together on the next drain, so high concurrency (BASELINE config 5: 256
+simultaneous list requests) produces device-sized batches without a tuning
+knob.  `max_batch` caps one drain's fused size.
+
+Failure isolation: if a fused inner call raises, each member request is
+retried individually so one malformed query (e.g. unknown definition, which
+the endpoint surfaces as an error like the reference does) cannot poison
+unrelated co-batched callers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable, Optional
+
+from .endpoints import PermissionsEndpoint
+from .store import Watcher
+from .types import (
+    CheckRequest,
+    Precondition,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SubjectRef,
+)
+
+
+class BatchingEndpoint(PermissionsEndpoint):
+    def __init__(self, inner: PermissionsEndpoint, max_batch: int = 4096):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.inner = inner
+        self.max_batch = max_batch
+        self._check_queue: list = []   # (CheckRequest, Future)
+        self._lr_queue: dict = {}      # (type, perm) -> list[(SubjectRef, Future)]
+        self._drain_task: Optional[asyncio.Task] = None
+        self._stats = {"drains": 0, "fused_checks": 0, "fused_lookups": 0,
+                       "max_fused_batch": 0}
+
+    @property
+    def stats(self) -> dict:
+        """Own dispatch counters merged over the inner backend's stats."""
+        inner_stats = getattr(self.inner, "stats", None)
+        out = dict(inner_stats) if isinstance(inner_stats, dict) else {}
+        out.update(self._stats)
+        return out
+
+    # -- queue plumbing ------------------------------------------------------
+
+    def _kick(self) -> None:
+        if self._drain_task is None or self._drain_task.done():
+            self._drain_task = asyncio.get_running_loop().create_task(
+                self._drain())
+
+    async def _drain(self) -> None:
+        while self._check_queue or self._lr_queue:
+            self._stats["drains"] += 1
+            if self._check_queue:
+                batch = self._check_queue[: self.max_batch]
+                del self._check_queue[: len(batch)]
+                await self._run_checks(batch)
+            if self._lr_queue:
+                key, waiters = next(iter(self._lr_queue.items()))
+                del self._lr_queue[key]
+                await self._run_lookups(key, waiters[: self.max_batch])
+                rest = waiters[self.max_batch:]
+                if rest:
+                    self._lr_queue.setdefault(key, []).extend(rest)
+
+    async def _run_checks(self, batch: list) -> None:
+        reqs = [r for r, _ in batch]
+        self._stats["fused_checks"] += 1
+        self._stats["max_fused_batch"] = max(self._stats["max_fused_batch"],
+                                            len(reqs))
+        try:
+            results = await self.inner.check_bulk_permissions(reqs)
+        except Exception:
+            for req, fut in batch:  # isolate the poison request
+                if fut.done():
+                    continue
+                try:
+                    res = await self.inner.check_permission(req)
+                except Exception as e:
+                    if not fut.done():  # caller may cancel during the await
+                        fut.set_exception(e)
+                else:
+                    if not fut.done():
+                        fut.set_result(res)
+            return
+        for (_, fut), res in zip(batch, results):
+            if not fut.done():
+                fut.set_result(res)
+
+    async def _run_lookups(self, key: tuple, waiters: list) -> None:
+        resource_type, permission = key
+        subjects = [s for s, _ in waiters]
+        self._stats["fused_lookups"] += 1
+        self._stats["max_fused_batch"] = max(self._stats["max_fused_batch"],
+                                            len(subjects))
+        try:
+            results = await self.inner.lookup_resources_batch(
+                resource_type, permission, subjects)
+        except Exception:
+            for subject, fut in waiters:
+                if fut.done():
+                    continue
+                try:
+                    res = await self.inner.lookup_resources(
+                        resource_type, permission, subject)
+                except Exception as e:
+                    if not fut.done():  # caller may cancel during the await
+                        fut.set_exception(e)
+                else:
+                    if not fut.done():
+                        fut.set_result(res)
+            return
+        for (_, fut), res in zip(waiters, results):
+            if not fut.done():
+                fut.set_result(res)
+
+    # -- batched verbs -------------------------------------------------------
+
+    async def check_permission(self, req: CheckRequest):
+        fut = asyncio.get_running_loop().create_future()
+        self._check_queue.append((req, fut))
+        self._kick()
+        return await fut
+
+    async def check_bulk_permissions(self, reqs: list) -> list:
+        if not reqs:
+            return []
+        loop = asyncio.get_running_loop()
+        futs = []
+        for r in reqs:
+            fut = loop.create_future()
+            self._check_queue.append((r, fut))
+            futs.append(fut)
+        self._kick()
+        return list(await asyncio.gather(*futs))
+
+    async def lookup_resources(self, resource_type: str, permission: str,
+                               subject: SubjectRef) -> list:
+        fut = asyncio.get_running_loop().create_future()
+        self._lr_queue.setdefault((resource_type, permission), []).append(
+            (subject, fut))
+        self._kick()
+        return await fut
+
+    async def lookup_resources_batch(self, resource_type: str, permission: str,
+                                     subjects: list) -> list:
+        if not subjects:
+            return []
+        loop = asyncio.get_running_loop()
+        futs = []
+        bucket = self._lr_queue.setdefault((resource_type, permission), [])
+        for s in subjects:
+            fut = loop.create_future()
+            bucket.append((s, fut))
+            futs.append(fut)
+        self._kick()
+        return list(await asyncio.gather(*futs))
+
+    # -- passthrough verbs ---------------------------------------------------
+
+    async def read_relationships(self, flt: RelationshipFilter) -> list:
+        return await self.inner.read_relationships(flt)
+
+    async def write_relationships(self, updates: Iterable[RelationshipUpdate],
+                                  preconditions: Iterable[Precondition] = ()) -> int:
+        return await self.inner.write_relationships(updates, preconditions)
+
+    async def delete_relationships(self, flt: RelationshipFilter,
+                                   preconditions: Iterable[Precondition] = ()) -> int:
+        return await self.inner.delete_relationships(flt, preconditions)
+
+    def watch(self, object_types=None) -> Watcher:
+        return self.inner.watch(object_types)
+
+    async def close(self) -> None:
+        task = self._drain_task
+        if task is not None and not task.done():
+            await task
+        await self.inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

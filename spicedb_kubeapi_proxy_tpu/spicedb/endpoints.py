@@ -220,13 +220,33 @@ def create_endpoint(url: str,
                     **kwargs: Any) -> PermissionsEndpoint:
     """Endpoint registry dispatching on URL scheme
     (reference options.go:307-369)."""
+    from urllib.parse import parse_qs
+
     split = urlsplit(url)
     scheme = split.scheme
+    params = parse_qs(split.query)
     if scheme == "embedded":
         return EmbeddedEndpoint.from_bootstrap(bootstrap)
     if scheme == "jax":
         from ..ops.jax_endpoint import JaxEndpoint  # lazy: pulls in jax
-        return JaxEndpoint.from_bootstrap(bootstrap, **kwargs)
+        ep: PermissionsEndpoint = JaxEndpoint.from_bootstrap(bootstrap,
+                                                             **kwargs)
+        # cross-request batched dispatch is on by default for the device
+        # backend (`jax://?dispatch=direct` to bypass); the batch IS the
+        # kernel invocation (SURVEY.md §2 parallelism table)
+        dispatch = (params.get("dispatch") or ["batched"])[0]
+        if dispatch == "batched":
+            from .dispatch import BatchingEndpoint
+            try:
+                max_batch = int((params.get("max_batch") or ["4096"])[0])
+                ep = BatchingEndpoint(ep, max_batch=max_batch)
+            except ValueError as e:
+                raise EndpointConfigError(
+                    f"invalid max_batch in {url!r}: {e}") from e
+        elif dispatch != "direct":
+            raise EndpointConfigError(
+                f"unknown dispatch mode {dispatch!r}; use batched|direct")
+        return ep
     if scheme in ("grpc", "grpcs", "http", "https"):
         raise EndpointConfigError(
             f"remote SpiceDB endpoint {url!r} requires grpcio + authzed client"

@@ -53,8 +53,8 @@ class InstrumentedEndpoint(PermissionsEndpoint):
             ref = weakref.ref(inner)
             for key in stats:
                 registry.gauge(
-                    f"authz_device_graph_{key}_total",
-                    f"jax:// device-graph {key.replace('_', ' ')}",
+                    f"authz_backend_{key}_total",
+                    f"backend counter: {key.replace('_', ' ')}",
                     callback=(lambda k=key: float(
                         (getattr(ref(), "stats", None) or {}).get(k, 0))))
 

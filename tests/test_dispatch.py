@@ -1,0 +1,185 @@
+"""Cross-request batched dispatch tests (SURVEY.md §2 parallelism table):
+concurrent callers fuse into device-sized batches, results stay correct,
+failures are isolated per request, and create_endpoint wires the wrapper
+for jax:// by default."""
+
+import asyncio
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+    EmbeddedEndpoint,
+    create_endpoint,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = """
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+
+
+class CountingEndpoint(EmbeddedEndpoint):
+    """Embedded endpoint that records inner-call batch sizes."""
+
+    def __init__(self, schema):
+        super().__init__(schema)
+        self.bulk_calls = []
+        self.lr_batch_calls = []
+        self.slow = False
+
+    async def check_bulk_permissions(self, reqs):
+        self.bulk_calls.append(len(reqs))
+        if self.slow:
+            await asyncio.sleep(0.01)
+        return await super().check_bulk_permissions(reqs)
+
+    async def lookup_resources_batch(self, resource_type, permission, subjects):
+        self.lr_batch_calls.append(len(subjects))
+        if self.slow:
+            await asyncio.sleep(0.01)
+        return await super().lookup_resources_batch(
+            resource_type, permission, subjects)
+
+
+def make(n_docs=4, users=("alice", "bob")):
+    inner = CountingEndpoint(sch.parse_schema(SCHEMA))
+    rels = []
+    for i in range(n_docs):
+        rels.append(RelationshipUpdate(op=UpdateOp.TOUCH, rel=parse_relationship(
+            f"doc:d{i}#viewer@user:{users[i % len(users)]}")))
+    inner.store.write(rels)
+    return BatchingEndpoint(inner), inner
+
+
+def check(user, doc="d0"):
+    return CheckRequest(resource=ObjectRef("doc", doc), permission="view",
+                        subject=SubjectRef("user", user))
+
+
+def test_concurrent_checks_fuse_into_one_inner_call():
+    ep, inner = make()
+    inner.slow = True
+
+    async def run():
+        # first call occupies the drain loop; the rest accumulate
+        first = asyncio.create_task(ep.check_permission(check("alice", "d0")))
+        await asyncio.sleep(0.002)
+        rest = [asyncio.create_task(ep.check_permission(check(u, d)))
+                for u, d in [("alice", "d2"), ("bob", "d1"), ("bob", "d3"),
+                             ("alice", "d1")]]
+        return [await first] + [await t for t in rest]
+
+    results = asyncio.run(run())
+    assert [r.allowed for r in results] == [True, True, True, True, False]
+    # call 1: the lone first check; call 2: the four accumulated checks fused
+    assert inner.bulk_calls == [1, 4]
+    assert ep.stats["fused_checks"] == 2
+    assert ep.stats["max_fused_batch"] == 4
+
+
+def test_concurrent_lookups_fuse_by_type_permission():
+    ep, inner = make(n_docs=6)
+    inner.slow = True
+
+    async def run():
+        first = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        await asyncio.sleep(0.002)
+        rest = [asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", u)))
+            for u in ("bob", "alice", "bob")]
+        return [sorted(await first)] + [sorted(await t) for t in rest]
+
+    res = asyncio.run(run())
+    assert res[0] == ["d0", "d2", "d4"]
+    assert res[1] == ["d1", "d3", "d5"]
+    assert res[2] == ["d0", "d2", "d4"]
+    assert inner.lr_batch_calls == [1, 3]
+
+
+def test_batch_failure_isolated_per_request():
+    ep, inner = make()
+
+    async def run():
+        good = ep.check_permission(check("alice", "d0"))
+        # unknown definition raises inside the fused call
+        bad = ep.check_permission(CheckRequest(
+            resource=ObjectRef("nosuchtype", "x"), permission="view",
+            subject=SubjectRef("user", "alice")))
+        return await asyncio.gather(good, bad, return_exceptions=True)
+
+    good, bad = asyncio.run(run())
+    assert good.allowed
+    assert isinstance(bad, Exception)
+
+
+def test_bulk_api_preserves_order_and_duplicates():
+    ep, _ = make()
+
+    async def run():
+        return await ep.check_bulk_permissions(
+            [check("alice", "d0"), check("bob", "d0"),
+             check("alice", "d0")])
+
+    res = asyncio.run(run())
+    assert [r.allowed for r in res] == [True, False, True]
+
+
+def test_sequential_calls_have_no_added_latency_path():
+    ep, inner = make()
+
+    async def run():
+        a = await ep.check_permission(check("alice", "d0"))
+        b = await ep.check_permission(check("bob", "d0"))
+        return a, b
+
+    a, b = asyncio.run(run())
+    assert a.allowed and not b.allowed
+    # each sequential call drains immediately (no artificial window)
+    assert inner.bulk_calls == [1, 1]
+
+
+def test_writes_pass_through_and_are_visible():
+    ep, inner = make(n_docs=1)
+
+    async def run():
+        before = await ep.check_permission(check("bob", "d9"))
+        await ep.write_relationships([RelationshipUpdate(
+            op=UpdateOp.TOUCH,
+            rel=parse_relationship("doc:d9#viewer@user:bob"))])
+        after = await ep.check_permission(check("bob", "d9"))
+        return before, after
+
+    before, after = asyncio.run(run())
+    assert not before.allowed and after.allowed
+
+
+def test_create_endpoint_wraps_jax_in_batching():
+    ep = create_endpoint("jax://")
+    assert isinstance(ep, BatchingEndpoint)
+    direct = create_endpoint("jax://?dispatch=direct")
+    assert not isinstance(direct, BatchingEndpoint)
+    custom = create_endpoint("jax://?dispatch=batched&max_batch=128")
+    assert isinstance(custom, BatchingEndpoint)
+    assert custom.max_batch == 128
+    with pytest.raises(Exception):
+        create_endpoint("jax://?dispatch=bogus")
+
+
+def test_stats_merge_inner_backend_counters():
+    ep = create_endpoint("jax://")
+    s = ep.stats
+    assert "drains" in s and "rebuilds" in s

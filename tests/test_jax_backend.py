@@ -317,7 +317,11 @@ class TestJaxEndpointBehavior:
             bootstrap=Bootstrap(
                 schema_text=GROUPS_SCHEMA,
                 relationships_text="namespace:ns#viewer@user:alice\n"))
-        assert isinstance(ep, JaxEndpoint)
+        # jax:// wraps the device endpoint in the cross-request dispatcher
+        # by default (spicedb/dispatch.py)
+        from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+        assert isinstance(ep, BatchingEndpoint)
+        assert isinstance(ep.inner, JaxEndpoint)
 
         async def run():
             r = await ep.check_permission(CheckRequest(

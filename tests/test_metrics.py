@@ -159,8 +159,8 @@ def test_jax_stats_gauges():
     res = asyncio.run(run())
     assert res.allowed
     text = reg.render()
-    assert "authz_device_graph_rebuilds_total 1" in text
-    assert "authz_device_graph_kernel_calls_total 1" in text
+    assert "authz_backend_rebuilds_total 1" in text
+    assert "authz_backend_kernel_calls_total 1" in text
 
 
 # -- proxy /metrics route ----------------------------------------------------
