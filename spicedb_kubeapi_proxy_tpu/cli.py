@@ -278,7 +278,26 @@ def _normalize_argv(argv: list) -> list:
     return out
 
 
+def _sync_jax_platforms() -> None:
+    """Honor JAX_PLATFORMS even when a sitecustomize has already pinned
+    jax.config.jax_platforms to a different backend (the env var alone is
+    ignored once the config value is set)."""
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    try:
+        import jax
+
+        if getattr(jax.config, "jax_platforms", None) != plat:
+            jax.config.update("jax_platforms", plat)
+    except Exception:
+        pass
+
+
 def main(argv: Optional[list] = None) -> int:
+    _sync_jax_platforms()
     parser = build_parser()
     args = parser.parse_args(_normalize_argv(
         list(sys.argv[1:] if argv is None else argv)))

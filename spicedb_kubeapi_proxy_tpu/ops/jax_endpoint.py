@@ -2,25 +2,37 @@
 
 Same host tuple store as `embedded://` (source of truth, watch, durable
 semantics), but CheckPermission / CheckBulkPermissions / LookupResources
-execute on device as batched boolean-SpMV reachability
-(ops/graph_compile.py + ops/spmv.py).  The device graph is a cache:
+execute on device as batched boolean reachability over the compiled
+relation graph (ops/graph_compile.py).  Two interchangeable kernels:
 
-- full (re)builds produce dst-sorted edge arrays (fast segment path);
-- store deltas (dual-writes, watch traffic) are applied incrementally into
-  padded edge-array slack via scatter updates (unsorted segment path) — a
-  rebuild is only forced when a new object id appears or slack runs out;
+- **ell** (default): bit-packed fixed-fanin gather kernel (ops/ell.py) —
+  state is uint32 bitmask words, adjacency is destination-major fixed-width
+  tables with hub rows split into OR-trees; no scatter in the iteration.
+- **segment**: float32 gather + segment_sum kernel (ops/spmv.py) — the
+  straightforward SpMV lowering, kept as a differential/debug fallback and
+  for the edge-sharded multi-chip path (select with
+  SPICEDB_TPU_KERNEL=segment).
+
+The device graph is a cache over the host store:
+
+- full (re)builds lower the current tuple snapshot;
+- store deltas (dual-writes, watch traffic) are applied incrementally —
+  row-slot edits in the ELL tables / padded-slack scatter in the segment
+  edge arrays — and a rebuild is only forced when a new object id or a
+  wildcard appears or slack runs out;
 - relationship expiration is enforced lazily: expired tuples are
   delta-removed before the next query.
 
 Reads are fully consistent w.r.t. the store (reference check.go:41-45 uses
 FullyConsistent): every query first drains pending deltas under the graph
-lock, so the device CSR always reflects the committed store revision.
+lock, so the device graph always reflects the committed store revision.
 """
 
 from __future__ import annotations
 
 import collections
 import heapq
+import os
 import threading
 import time
 from typing import Iterable, Optional
@@ -39,6 +51,7 @@ from ..spicedb.store import TupleStore, Watcher
 from ..spicedb.types import (
     CheckRequest,
     CheckResult,
+    ObjectRef,
     Permissionship,
     Precondition,
     Relationship,
@@ -49,6 +62,7 @@ from ..spicedb.types import (
     WatchUpdate,
     WILDCARD,
 )
+from .ell import EllKernelCache, batch_words, build_tables
 from .graph_compile import GraphProgram, SELF_SLOT, compile_graph
 from .spmv import KernelCache, bucket, pad_edges
 
@@ -56,25 +70,52 @@ _MIN_EDGE_BUCKET = 256
 _MIN_BATCH_BUCKET = 8
 
 
-class _DeviceGraph:
-    """Compiled program + device edge arrays + incremental-update state."""
+def _rel_from_key(key: tuple) -> Relationship:
+    """Reconstruct the identity fields of a relationship from its key
+    (sufficient for edge-endpoint computation)."""
+    return Relationship(resource=ObjectRef(key[0], key[1]), relation=key[2],
+                        subject=SubjectRef(key[3], key[4], key[5]))
 
-    def __init__(self, prog: GraphProgram, capacity: int, sorted_edges: bool,
+
+class _SegmentGraph:
+    """Flat padded edge arrays + gather/segment_sum kernel (ops/spmv.py)."""
+
+    def __init__(self, prog: GraphProgram, edge_endpoints,
                  num_iters: Optional[int] = None):
         self.prog = prog
-        self.capacity = capacity
         self.num_iters = num_iters
+        self._edge_endpoints = edge_endpoints
+        capacity = bucket(max(len(prog.edge_src) * 2, _MIN_EDGE_BUCKET))
         src, dst = pad_edges(prog, capacity)
         self.edge_src = jnp.asarray(src)
         self.edge_dst = jnp.asarray(dst)
-        self.sorted_edges = sorted_edges
+        self.sorted_edges = True
         e = len(prog.edge_src)
         self.free: list[int] = list(range(e, capacity))
         # tuple key -> positions occupied by that tuple's edges
         self.positions: dict[tuple, list] = {}
         self._kernels: dict[bool, KernelCache] = {}
+        self._updates: dict[int, tuple] = {}  # pos -> (src, dst), batched
+        # index tuple keys -> edge positions (edges were emitted in tuple
+        # order then sorted; recover positions by pair matching)
+        self._pos_by_pair: dict[tuple, list] = {}
+        for i, (s, dd) in enumerate(zip(prog.edge_src, prog.edge_dst)):
+            self._pos_by_pair.setdefault((int(s), int(dd)), []).append(i)
 
-    def kernel(self) -> KernelCache:
+    def index_tuples(self, tuples: list) -> None:
+        for rel in tuples:
+            pairs = self._edge_endpoints(self.prog, rel)
+            if not pairs:
+                continue
+            positions = []
+            for pair in pairs:
+                stack = self._pos_by_pair.get(pair)
+                if stack:
+                    positions.append(stack.pop())
+            self.positions[rel.key()] = positions
+        self._pos_by_pair = {}
+
+    def _kernel(self) -> KernelCache:
         key = self.sorted_edges
         k = self._kernels.get(key)
         if k is None:
@@ -83,17 +124,207 @@ class _DeviceGraph:
             self._kernels[key] = k
         return k
 
+    # -- delta application (host side; device flush batched) ----------------
+
+    def remove_key(self, key: tuple) -> bool:
+        for pos in self.positions.pop(key, ()):
+            self._updates[pos] = (self.prog.dead_index, self.prog.dead_index)
+            self.free.append(pos)
+        return True
+
+    def add_rel(self, rel: Relationship) -> bool:
+        key = rel.key()
+        if key in self.positions:
+            return True  # edges already present (re-touch)
+        pairs = self._edge_endpoints(self.prog, rel)
+        if pairs is None:
+            return False
+        positions = []
+        for (s, dd) in pairs:
+            if not self.free:
+                return False
+            pos = self.free.pop()
+            self._updates[pos] = (s, dd)
+            positions.append(pos)
+        self.positions[key] = positions
+        return True
+
+    def flush(self) -> bool:
+        """Push batched host edits to the device arrays.  A position freed
+        and re-allocated within one drain appears once (dict is last-write-
+        wins, matching XLA scatter's undefined duplicate order)."""
+        if not self._updates:
+            return False
+        pos = jnp.asarray(list(self._updates.keys()), jnp.int32)
+        srcs = jnp.asarray([v[0] for v in self._updates.values()], jnp.int32)
+        dsts = jnp.asarray([v[1] for v in self._updates.values()], jnp.int32)
+        self.edge_src = self.edge_src.at[pos].set(srcs)
+        self.edge_dst = self.edge_dst.at[pos].set(dsts)
+        self.sorted_edges = False
+        self._updates = {}
+        return True
+
+    # -- queries ------------------------------------------------------------
+
+    def batch_bucket(self, n: int) -> int:
+        return bucket(max(n, 1), _MIN_BATCH_BUCKET)
+
+    def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+        g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
+        gi = np.zeros(g, np.int32)
+        gc = np.zeros(g, np.int32)
+        gi[: len(gather_idx)] = gather_idx
+        gc[: len(gather_col)] = gather_col
+        return self._kernel().checks(q_arr, gi, gc, self.edge_src,
+                                     self.edge_dst)
+
+    def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
+        return self._kernel().lookup(offset, length, q_arr, self.edge_src,
+                                     self.edge_dst)
+
+
+class _EllGraph:
+    """Bit-packed fixed-fanin tables + gather-only kernel (ops/ell.py).
+
+    Delta edits are positionless: an edge (src -> dst) lives somewhere in
+    dst's root row or its OR-tree, and because every tree node is a
+    monotone OR gate, *any* dead slot in the tree can absorb a new child.
+    Insert/remove walk the tree host-side (O(row fanin), only on writes)
+    and batch row-wise device updates.
+    """
+
+    def __init__(self, prog: GraphProgram, edge_endpoints,
+                 num_iters: Optional[int] = None):
+        self.prog = prog
+        self._edge_endpoints = edge_endpoints
+        t = build_tables(prog)
+        self.host_main = t.idx_main
+        self.host_aux = t.idx_aux
+        self.dev_main = jnp.asarray(t.idx_main)
+        self.dev_aux = jnp.asarray(t.idx_aux)
+        self.kernel = EllKernelCache(prog, n_aux_rows=t.idx_aux.shape[0],
+                                     tree_depth=t.tree_depth,
+                                     num_iters=num_iters)
+        self._dirty_main: set = set()
+        self._dirty_aux: set = set()
+
+    def index_tuples(self, tuples: list) -> None:
+        pass  # positionless — nothing to index
+
+    # -- tree walking --------------------------------------------------------
+
+    def _walk(self, root_row: int, want: int) -> Optional[tuple]:
+        """Find `want` (a state index, or the dead index for a free slot) in
+        root_row's row or its aux subtree; returns (table, row, col)."""
+        n = self.prog.state_size
+        stack = [("m", root_row)]
+        while stack:
+            table, row = stack.pop()
+            arr = self.host_main if table == "m" else self.host_aux
+            for col, v in enumerate(arr[row]):
+                v = int(v)
+                if v == want:
+                    return (table, row, col)
+                if v >= n:  # aux child: descend
+                    stack.append(("a", v - n))
+        return None
+
+    def _set(self, loc: tuple, value: int) -> None:
+        table, row, col = loc
+        if table == "m":
+            self.host_main[row, col] = value
+            self._dirty_main.add(row)
+        else:
+            self.host_aux[row, col] = value
+            self._dirty_aux.add(row)
+
+    # -- delta application ---------------------------------------------------
+
+    def _remove_pairs(self, pairs: list) -> bool:
+        for (s, d) in pairs:
+            loc = self._walk(d, s)
+            if loc is not None:
+                self._set(loc, self.prog.dead_index)
+        return True
+
+    def remove_key(self, key: tuple) -> bool:
+        pairs = self._edge_endpoints(self.prog, _rel_from_key(key))
+        if pairs is None:
+            # endpoints unresolvable means the ids were never compiled; the
+            # tuple can't be in the tables — nothing to remove
+            return True
+        return self._remove_pairs(pairs)
+
+    def add_rel(self, rel: Relationship) -> bool:
+        pairs = self._edge_endpoints(self.prog, rel)
+        if pairs is None:
+            return False
+        dead = self.prog.dead_index
+        for (s, d) in pairs:
+            if self._walk(d, s) is not None:
+                continue  # edge already present (re-touch)
+            loc = self._walk(d, dead)
+            if loc is None:
+                return False  # row and tree full: rebuild grows a level
+            self._set(loc, s)
+        return True
+
+    def flush(self) -> bool:
+        changed = False
+        if self._dirty_main:
+            rows = np.asarray(sorted(self._dirty_main), np.int32)
+            self.dev_main = self.dev_main.at[jnp.asarray(rows)].set(
+                jnp.asarray(self.host_main[rows]))
+            self._dirty_main = set()
+            changed = True
+        if self._dirty_aux:
+            rows = np.asarray(sorted(self._dirty_aux), np.int32)
+            self.dev_aux = self.dev_aux.at[jnp.asarray(rows)].set(
+                jnp.asarray(self.host_aux[rows]))
+            self._dirty_aux = set()
+            changed = True
+        return changed
+
+    # -- queries ------------------------------------------------------------
+
+    def batch_bucket(self, n: int) -> int:
+        return batch_words(n) * 32
+
+    def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+        g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
+        gi = np.zeros(g, np.int32)
+        gc = np.zeros(g, np.int32)
+        gi[: len(gather_idx)] = gather_idx
+        gc[: len(gather_col)] = gather_col
+        n_words = max(1, len(q_arr) // 32)
+        return self.kernel.checks(q_arr, n_words, gi, gc, self.dev_main,
+                                  self.dev_aux)
+
+    def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
+        n_words = max(1, len(q_arr) // 32)
+        return self.kernel.lookup(offset, length, q_arr, n_words,
+                                  self.dev_main, self.dev_aux)
+
+
+_GRAPH_KINDS = {"ell": _EllGraph, "segment": _SegmentGraph}
+
 
 class JaxEndpoint(PermissionsEndpoint):
     def __init__(self, schema: sch.Schema, store: Optional[TupleStore] = None,
-                 num_iters: Optional[int] = None):
+                 num_iters: Optional[int] = None, kernel: Optional[str] = None):
         self.schema = schema
         self.store = store if store is not None else TupleStore()
         # oracle fallback for query endpoints outside the compiled universe
         self._oracle = Evaluator(schema, self.store)
         self._num_iters = num_iters
+        kind = kernel or os.environ.get("SPICEDB_TPU_KERNEL", "ell")
+        if kind not in _GRAPH_KINDS:
+            raise ValueError(f"unknown kernel {kind!r}; "
+                             f"expected one of {sorted(_GRAPH_KINDS)}")
+        self.kernel_kind = kind
+        self._graph_cls = _GRAPH_KINDS[kind]
         self._lock = threading.RLock()
-        self._graph: Optional[_DeviceGraph] = None
+        self._graph = None
         # listener callbacks run while the STORE lock is held; they must
         # never take self._lock (ABBA deadlock with queries that hold
         # self._lock and read the store), so delta intake is a lock-free
@@ -182,24 +413,9 @@ class JaxEndpoint(PermissionsEndpoint):
         tuples = self.store.read(None)
         extra = {t: set(ids) for t, ids in self._known_extra_subjects.items()}
         prog = compile_graph(self.schema, tuples, extra_subject_ids=extra)
-        capacity = bucket(max(len(prog.edge_src) * 2, _MIN_EDGE_BUCKET))
-        graph = _DeviceGraph(prog, capacity, sorted_edges=True,
-                             num_iters=self._num_iters)
-        # index tuple keys -> edge positions (edges were emitted in tuple
-        # order then sorted; recover positions by scanning)
-        pos_by_pair: dict[tuple, list] = {}
-        for i, (s, dd) in enumerate(zip(prog.edge_src, prog.edge_dst)):
-            pos_by_pair.setdefault((int(s), int(dd)), []).append(i)
-        for rel in tuples:
-            pairs = self._edge_endpoints(prog, rel)
-            if not pairs:
-                continue
-            positions = []
-            for pair in pairs:
-                stack = pos_by_pair.get(pair)
-                if stack:
-                    positions.append(stack.pop())
-            graph.positions[rel.key()] = positions
+        graph = self._graph_cls(prog, self._edge_endpoints,
+                                num_iters=self._num_iters)
+        graph.index_tuples(tuples)
         self._reset_expiry(tuples)
         self._graph = graph
         self.stats["rebuilds"] += 1
@@ -242,7 +458,6 @@ class JaxEndpoint(PermissionsEndpoint):
                                 and self._expiry_heap[0][0] <= time.time()):
             return
 
-        updates: list[tuple] = []  # (pos, src, dst)
         needs_rebuild = False
         for batch in batches:
             for u in batch.updates:
@@ -254,29 +469,14 @@ class JaxEndpoint(PermissionsEndpoint):
                         needs_rebuild = True
                         break
                     self._set_expiry(key, None)
-                    for pos in graph.positions.pop(key, ()):
-                        updates.append((pos, graph.prog.dead_index,
-                                        graph.prog.dead_index))
-                        graph.free.append(pos)
-                else:  # TOUCH
-                    self._set_expiry(key, u.rel.expires_at)
-                    if key in graph.positions:
-                        continue  # edges already present; expiry updated above
-                    pairs = self._edge_endpoints(graph.prog, u.rel)
-                    if pairs is None:
+                    if not graph.remove_key(key):
                         needs_rebuild = True
                         break
-                    positions = []
-                    for (s, dd) in pairs:
-                        if not graph.free:
-                            needs_rebuild = True
-                            break
-                        pos = graph.free.pop()
-                        updates.append((pos, s, dd))
-                        positions.append(pos)
-                    if needs_rebuild:
+                else:  # TOUCH
+                    self._set_expiry(key, u.rel.expires_at)
+                    if not graph.add_rel(u.rel):
+                        needs_rebuild = True
                         break
-                    graph.positions[key] = positions
             if needs_rebuild:
                 break
         # expire lazily AFTER batch processing so expirations registered by
@@ -293,36 +493,23 @@ class JaxEndpoint(PermissionsEndpoint):
             if key[4] == WILDCARD:
                 needs_rebuild = True
                 break
-            for pos in graph.positions.pop(key, ()):
-                updates.append((pos, graph.prog.dead_index,
-                                graph.prog.dead_index))
-                graph.free.append(pos)
+            if not graph.remove_key(key):
+                needs_rebuild = True
+                break
 
         if needs_rebuild:
             self._rebuild()
             return
-        if updates:
-            # a position freed and re-allocated within one drain appears
-            # twice; scatter order for duplicate indices is undefined in
-            # XLA, so collapse to last-write-wins first
-            final: dict[int, tuple] = {}
-            for (pos, s_, d_) in updates:
-                final[pos] = (s_, d_)
-            pos = jnp.asarray(list(final.keys()), jnp.int32)
-            srcs = jnp.asarray([v[0] for v in final.values()], jnp.int32)
-            dsts = jnp.asarray([v[1] for v in final.values()], jnp.int32)
-            graph.edge_src = graph.edge_src.at[pos].set(srcs)
-            graph.edge_dst = graph.edge_dst.at[pos].set(dsts)
-            graph.sorted_edges = False
+        if graph.flush():
             self.stats["delta_batches"] += 1
 
-    def _current_graph(self) -> _DeviceGraph:
+    def _current_graph(self):
         self._apply_pending()
         return self._graph
 
     # -- query encoding -----------------------------------------------------
 
-    def _encode_subjects(self, graph: _DeviceGraph, subjects: list) -> tuple:
+    def _encode_subjects(self, graph, subjects: list) -> tuple:
         """Dedupe subjects into query columns; returns (q_idx array,
         col_of_subject dict, unknown set)."""
         cols: dict = {}
@@ -337,7 +524,7 @@ class JaxEndpoint(PermissionsEndpoint):
                 continue
             cols[s] = len(q)
             q.append(idx)
-        b = bucket(max(len(q), 1), _MIN_BATCH_BUCKET)
+        b = graph.batch_bucket(len(q))
         q_arr = np.full(b, graph.prog.dead_index, np.int32)
         q_arr[: len(q)] = q
         return q_arr, cols, unknown
@@ -379,13 +566,7 @@ class JaxEndpoint(PermissionsEndpoint):
                 gather_col.append(cols[r.subject])
                 kernel_rows.append(i)
             if kernel_rows:
-                g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
-                gi = np.zeros(g, np.int32)
-                gc = np.zeros(g, np.int32)
-                gi[: len(gather_idx)] = gather_idx
-                gc[: len(gather_col)] = gather_col
-                out = graph.kernel().checks(q_arr, gi, gc, graph.edge_src,
-                                            graph.edge_dst)
+                out = graph.run_checks(q_arr, gather_idx, gather_col)
                 self.stats["kernel_calls"] += 1
                 for j, row in enumerate(kernel_rows):
                     results[row] = bool(out[j])
@@ -416,8 +597,7 @@ class JaxEndpoint(PermissionsEndpoint):
                 return self._oracle.lookup_resources(resource_type, permission,
                                                      subject)
             col = cols[subject]
-            bitmap = graph.kernel().lookup(rng[0], rng[1], q_arr,
-                                           graph.edge_src, graph.edge_dst)
+            bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
             self.stats["kernel_calls"] += 1
             ids = graph.prog.object_ids[resource_type]
         return [ids[i] for i in np.nonzero(bitmap[:, col])[0]]
@@ -436,18 +616,21 @@ class JaxEndpoint(PermissionsEndpoint):
                 return [self._oracle.lookup_resources(resource_type, permission, s)
                         for s in subjects]
             q_arr, cols, unknown = self._encode_subjects(graph, subjects)
-            bitmap = graph.kernel().lookup(rng[0], rng[1], q_arr,
-                                           graph.edge_src, graph.edge_dst)
+            bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
             self.stats["kernel_calls"] += 1
             ids = graph.prog.object_ids[resource_type]
+            # one pass over the transposed bitmap groups allowed object
+            # indices by query column (vs a nonzero() per subject)
+            by_col, obj = np.nonzero(np.ascontiguousarray(bitmap.T))
+            splits = np.searchsorted(by_col, np.arange(1, len(cols) + 1))
+            per_col = np.split(obj, splits[:-1]) if len(cols) else []
             out = []
             for s in subjects:
                 if s in unknown:
                     out.append(self._oracle.lookup_resources(
                         resource_type, permission, s))
                 else:
-                    out.append([ids[i] for i in
-                                np.nonzero(bitmap[:, cols[s]])[0]])
+                    out.append([ids[i] for i in per_col[cols[s]]])
         return out
 
     async def lookup_resources_batch(self, resource_type: str, permission: str,
@@ -486,7 +669,6 @@ class JaxEndpoint(PermissionsEndpoint):
                     changed = True
             if changed:
                 self._graph = None  # force rebuild on next query
-
     def force_rebuild(self) -> None:
         with self._lock:
             self._rebuild()

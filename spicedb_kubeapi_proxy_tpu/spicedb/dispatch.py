@@ -78,56 +78,51 @@ class BatchingEndpoint(PermissionsEndpoint):
                 if rest:
                     self._lr_queue.setdefault(key, []).extend(rest)
 
-    async def _run_checks(self, batch: list) -> None:
-        reqs = [r for r, _ in batch]
-        self._stats["fused_checks"] += 1
+    async def _run_fused(self, waiters: list, stat: str, fused_call,
+                         single_call) -> None:
+        """One fused inner call for `waiters` ([(item, Future)]); on failure,
+        retry members individually (concurrently — a poison request must not
+        serialize the drain loop) so it can't fail unrelated co-batched
+        callers."""
+        items = [it for it, _ in waiters]
+        self._stats[stat] += 1
         self._stats["max_fused_batch"] = max(self._stats["max_fused_batch"],
-                                            len(reqs))
+                                            len(items))
         try:
-            results = await self.inner.check_bulk_permissions(reqs)
+            results = await fused_call(items)
         except Exception:
-            for req, fut in batch:  # isolate the poison request
+            async def retry_one(item, fut):
                 if fut.done():
-                    continue
+                    return
                 try:
-                    res = await self.inner.check_permission(req)
+                    res = await single_call(item)
                 except Exception as e:
                     if not fut.done():  # caller may cancel during the await
                         fut.set_exception(e)
                 else:
                     if not fut.done():
                         fut.set_result(res)
-            return
-        for (_, fut), res in zip(batch, results):
-            if not fut.done():
-                fut.set_result(res)
 
-    async def _run_lookups(self, key: tuple, waiters: list) -> None:
-        resource_type, permission = key
-        subjects = [s for s, _ in waiters]
-        self._stats["fused_lookups"] += 1
-        self._stats["max_fused_batch"] = max(self._stats["max_fused_batch"],
-                                            len(subjects))
-        try:
-            results = await self.inner.lookup_resources_batch(
-                resource_type, permission, subjects)
-        except Exception:
-            for subject, fut in waiters:
-                if fut.done():
-                    continue
-                try:
-                    res = await self.inner.lookup_resources(
-                        resource_type, permission, subject)
-                except Exception as e:
-                    if not fut.done():  # caller may cancel during the await
-                        fut.set_exception(e)
-                else:
-                    if not fut.done():
-                        fut.set_result(res)
+            await asyncio.gather(*[retry_one(it, f) for it, f in waiters])
             return
         for (_, fut), res in zip(waiters, results):
             if not fut.done():
                 fut.set_result(res)
+
+    async def _run_checks(self, batch: list) -> None:
+        await self._run_fused(
+            batch, "fused_checks",
+            self.inner.check_bulk_permissions,
+            self.inner.check_permission)
+
+    async def _run_lookups(self, key: tuple, waiters: list) -> None:
+        resource_type, permission = key
+        await self._run_fused(
+            waiters, "fused_lookups",
+            lambda subjects: self.inner.lookup_resources_batch(
+                resource_type, permission, subjects),
+            lambda subject: self.inner.lookup_resources(
+                resource_type, permission, subject))
 
     # -- batched verbs -------------------------------------------------------
 

@@ -28,6 +28,14 @@ from spicedb_kubeapi_proxy_tpu.spicedb.types import (
 )
 
 
+@pytest.fixture(autouse=True, params=["ell", "segment"])
+def kernel_kind(request, monkeypatch):
+    """Run every differential scenario against BOTH device kernels: the
+    bit-packed fixed-fanin default and the segment_sum fallback."""
+    monkeypatch.setenv("SPICEDB_TPU_KERNEL", request.param)
+    return request.param
+
+
 def touch(*rels):
     return [RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(r)) for r in rels]
 
@@ -420,3 +428,40 @@ class TestReviewRegressions:
         assert not errors, errors
         # converge: final state must agree
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+
+
+class TestHubTreeSplit:
+    """Destinations whose in-degree exceeds the ELL main-row fanin are split
+    into OR-tree aux nodes (ops/ell.py); these scenarios force that path and
+    keep exercising it through incremental writes/deletes into the hub."""
+
+    def test_large_group_membership(self):
+        rels = [f"group:eng#member@user:u{i}" for i in range(300)]
+        rels += ["namespace:ns#viewer@group:eng#member"]
+        jx, oracle = make_pair(GROUPS_SCHEMA, rels)
+        assert_agreement(jx, oracle, "namespace", "view",
+                         users("u0", "u7", "u123", "u299", "outsider"))
+
+    def test_delta_insert_and_remove_in_hub(self):
+        rels = [f"group:eng#member@user:u{i}" for i in range(300)]
+        rels += ["namespace:ns#viewer@group:eng#member"]
+        jx, oracle = make_pair(GROUPS_SCHEMA, rels)
+        assert_agreement(jx, oracle, "namespace", "view", users("u5"))
+        # insert into the full hub (aux tree absorbs the new child or the
+        # endpoint rebuilds; either way results must match the oracle)
+        jx.store.write(touch("group:eng#member@user:newcomer"))
+        assert_agreement(jx, oracle, "namespace", "view",
+                         users("newcomer", "u5"))
+        # remove a member buried in the tree
+        jx.store.write(delete("group:eng#member@user:u123"))
+        assert_agreement(jx, oracle, "namespace", "view",
+                         users("u123", "u5", "newcomer"))
+
+    def test_nested_hubs(self):
+        rels = [f"group:g0#member@user:u{i}" for i in range(60)]
+        rels += [f"group:g1#member@group:g0#member"]
+        rels += [f"group:g1#member@user:v{i}" for i in range(60)]
+        rels += ["namespace:ns#viewer@group:g1#member"]
+        jx, oracle = make_pair(GROUPS_SCHEMA, rels)
+        assert_agreement(jx, oracle, "namespace", "view",
+                         users("u3", "v59", "nobody"))
