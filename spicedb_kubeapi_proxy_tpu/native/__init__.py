@@ -1,0 +1,67 @@
+"""Native (C++) components, built on demand with the system toolchain.
+
+The extension is compiled lazily with g++ the first time it's needed and
+cached next to its source; any environment without a compiler (or with
+SPICEDB_TPU_NO_NATIVE=1) transparently falls back to the pure-Python
+implementations, so the native layer is a pure accelerator, never a
+requirement.  Differential tests assert native/Python parity.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "fastparse.cpp")
+_SO = os.path.join(
+    _DIR, f"_fastparse{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}")
+
+_lock = threading.Lock()
+_module = None
+_tried = False
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths()["include"]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           f"-I{include}", _SRC, "-o", _SO]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if proc.returncode != 0:
+        sys.stderr.write(f"native build failed (falling back to python): "
+                         f"{proc.stderr[-2000:]}\n")
+        return False
+    return True
+
+
+def load() -> Optional[object]:
+    """The compiled _fastparse module, or None (pure-Python fallback)."""
+    global _module, _tried
+    with _lock:
+        if _module is not None or _tried:
+            return _module
+        _tried = True
+        if os.environ.get("SPICEDB_TPU_NO_NATIVE"):
+            return None
+        try:
+            needs_build = (not os.path.exists(_SO)
+                           or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+            if needs_build and not _build():
+                return None
+            spec = importlib.util.spec_from_file_location(
+                "spicedb_kubeapi_proxy_tpu.native._fastparse", _SO)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _module = mod
+        except Exception as e:  # any load failure -> python fallback
+            sys.stderr.write(f"native load failed (falling back): {e}\n")
+            _module = None
+        return _module

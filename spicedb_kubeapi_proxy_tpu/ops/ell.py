@@ -80,35 +80,48 @@ class EllTables:
 
 def build_tables(prog: GraphProgram) -> EllTables:
     """Group the program's (src, dst) edge list destination-major into
-    fixed-fanin tables, tree-splitting hubs."""
+    fixed-fanin tables, tree-splitting hubs.
+
+    Vectorized: one stable sort by destination, then per-slot scatter for
+    the (overwhelmingly common) small rows; only hub destinations fall to
+    a Python loop."""
     n = prog.state_size
     dead = prog.dead_index
-    by_dst: dict[int, list] = {}
-    for s, d in zip(prog.edge_src, prog.edge_dst):
-        by_dst.setdefault(int(d), []).append(int(s))
-
     idx_main = np.full((n, K_MAIN), dead, np.int32)
     aux_rows: list[np.ndarray] = []
     tree_depth = 0
+    e = len(prog.edge_src)
+    if e:
+        order = np.argsort(prog.edge_dst, kind="stable")
+        sdst = prog.edge_dst[order]
+        ssrc = prog.edge_src[order]
+        starts = np.concatenate(
+            [[0], np.nonzero(np.diff(sdst))[0] + 1])
+        counts = np.diff(np.concatenate([starts, [e]]))
+        gdst = sdst[starts]
+        # rank of each edge within its destination group
+        rank = np.arange(e) - np.repeat(starts, counts)
+        small = counts <= K_MAIN
+        small_edges = np.repeat(small, counts)
+        idx_main[sdst[small_edges], rank[small_edges]] = ssrc[small_edges]
 
-    def new_aux(children: list) -> int:
-        row = np.full(K_AUX, dead, np.int32)
-        row[: len(children)] = children
-        aux_rows.append(row)
-        return n + len(aux_rows) - 1
+        def new_aux(children: np.ndarray) -> int:
+            row = np.full(K_AUX, dead, np.int32)
+            row[: len(children)] = children
+            aux_rows.append(row)
+            return n + len(aux_rows) - 1
 
-    for dst, srcs in by_dst.items():
-        if len(srcs) <= K_MAIN:
-            idx_main[dst, : len(srcs)] = srcs
-            continue
-        children = srcs
-        depth = 0
-        while len(children) > K_MAIN:
-            children = [new_aux(children[i: i + K_AUX])
-                        for i in range(0, len(children), K_AUX)]
-            depth += 1
-        idx_main[dst, : len(children)] = children
-        tree_depth = max(tree_depth, depth)
+        for g in np.nonzero(~small)[0]:
+            lo = int(starts[g])
+            children = ssrc[lo: lo + int(counts[g])]
+            depth = 0
+            while len(children) > K_MAIN:
+                children = np.asarray(
+                    [new_aux(children[i: i + K_AUX])
+                     for i in range(0, len(children), K_AUX)], np.int32)
+                depth += 1
+            idx_main[int(gdst[g]), : len(children)] = children
+            tree_depth = max(tree_depth, depth)
 
     if aux_rows:
         idx_aux = np.stack(aux_rows).astype(np.int32)

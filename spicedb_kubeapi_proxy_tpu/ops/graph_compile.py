@@ -139,6 +139,111 @@ class GraphProgram:
         return self.state_index(subject_type, slot, subject_id)
 
 
+def _assign_slots(prog: GraphProgram, schema: sch.Schema) -> tuple:
+    """Slot layout + arrow bookkeeping shared by both compilers; returns
+    (arrow_slots, arrows_by_left)."""
+    offset = 0
+    arrow_slots: dict[tuple, str] = {}  # (type, perm, occurrence) -> slot name
+
+    def add_slot(t: str, slot: str) -> None:
+        nonlocal offset
+        prog.slot_offsets[(t, slot)] = offset
+        offset += prog.num_objects[t]
+
+    for t, d in schema.definitions.items():
+        add_slot(t, SELF_SLOT)
+        for r in d.relations:
+            add_slot(t, r)
+        for p in d.permissions:
+            add_slot(t, p)
+        # aux slots for arrows, one per occurrence
+        for p, expr in d.permissions.items():
+            for k, arrow in enumerate(_find_arrows(expr)):
+                slot = f"__arrow__:{p}:{k}"
+                arrow_slots[(t, p, k)] = slot
+                add_slot(t, slot)
+    prog.state_size = offset + 1  # trailing dead index
+
+    # arrow tuple-edge construction needs, per (type, left-relation), the
+    # list of (perm, occurrence, target) arrows reading it
+    arrows_by_left: dict[tuple, list] = {}
+    for t, d in schema.definitions.items():
+        for p, expr in d.permissions.items():
+            for k, arrow in enumerate(_find_arrows(expr)):
+                arrows_by_left.setdefault((t, arrow.left), []).append(
+                    (p, k, arrow.target))
+                prog.arrow_specs.setdefault((t, arrow.left), []).append(
+                    (p, k, arrow.target, arrow_slots[(t, p, k)]))
+    return arrow_slots, arrows_by_left
+
+
+def _emit_tuple_edges(prog: GraphProgram, schema: sch.Schema,
+                      arrow_slots: dict, arrows_by_left: dict, rel,
+                      srcs: list, dsts: list, wildcard_map: dict) -> None:
+    """Per-tuple edge emission (object path; also used for overlay tuples
+    on top of a columnar base)."""
+    rt = rel.resource.type
+    if rt not in schema.definitions:
+        return
+    d = schema.definitions[rt]
+    if rel.relation not in d.relations:
+        return  # tuples on undefined relations are unreachable
+    dst = prog.state_index(rt, rel.relation, rel.resource.id)
+    st, sid, srel = rel.subject.type, rel.subject.id, rel.subject.relation
+    if sid == WILDCARD:
+        if dst is not None:
+            wildcard_map.setdefault(st, []).append(dst)
+    else:
+        src = (prog.state_index(st, srel, sid) if srel
+               else prog.state_index(st, SELF_SLOT, sid))
+        if src is not None and dst is not None:
+            srcs.append(src)
+            dsts.append(dst)
+    # arrow edges ride the same tuples (direct subjects only)
+    for (p, k, target) in arrows_by_left.get((rt, rel.relation), ()):
+        if sid == WILDCARD or srel:
+            continue
+        target_def = schema.definitions.get(st)
+        if target_def is None or not target_def.has_relation_or_permission(target):
+            continue
+        src = prog.state_index(st, target, sid)
+        aux = prog.state_index(rt, arrow_slots[(rt, p, k)], rel.resource.id)
+        if src is not None and aux is not None:
+            srcs.append(src)
+            dsts.append(aux)
+
+
+def _finalize_program(prog: GraphProgram, schema: sch.Schema,
+                      src_arr: np.ndarray, dst_arr: np.ndarray,
+                      wildcard_map: dict, arrow_slots: dict) -> GraphProgram:
+    """Sort edges, materialize wildcard terms and the permission program."""
+    if len(src_arr):
+        order = np.argsort(dst_arr, kind="stable")
+        prog.edge_src = np.ascontiguousarray(src_arr[order])
+        prog.edge_dst = np.ascontiguousarray(dst_arr[order])
+
+    for st, indices in wildcard_map.items():
+        rng = prog.slot_range(st, SELF_SLOT)
+        if rng is None:
+            continue
+        prog.wildcard_terms.append(WildcardTerm(
+            self_offset=rng[0], self_length=rng[1],
+            mask_indices=tuple(sorted(set(int(i) for i in indices)))))
+
+    # permission program (topo order within each type)
+    for t, d in schema.definitions.items():
+        order = _topo_permissions(d)
+        for p in order:
+            expr = d.permissions[p]
+            off, n = prog.slot_range(t, p)
+            compiled = _compile_expr(prog, schema, t, p, expr, arrow_slots,
+                                     counter=[0])
+            prog.perm_ops.append(PermOp(offset=off, length=n, expr=compiled))
+
+    prog.suggested_iterations = max(2, schema.max_rewrite_depth() + 2)
+    return prog
+
+
 def compile_graph(schema: sch.Schema, tuples: list,
                   extra_subject_ids: Optional[dict] = None) -> GraphProgram:
     """Build a GraphProgram from a schema and a tuple snapshot.
@@ -167,104 +272,174 @@ def compile_graph(schema: sch.Schema, tuples: list,
         prog.object_index[t] = {oid: i for i, oid in enumerate(ordered)}
         prog.num_objects[t] = len(ordered)
 
-    # -- assign slot offsets -----------------------------------------------
-    offset = 0
-    arrow_slots: dict[tuple, str] = {}  # (type, perm, occurrence) -> slot name
+    arrow_slots, arrows_by_left = _assign_slots(prog, schema)
 
-    def add_slot(t: str, slot: str) -> None:
-        nonlocal offset
-        prog.slot_offsets[(t, slot)] = offset
-        offset += prog.num_objects[t]
-
-    for t, d in schema.definitions.items():
-        add_slot(t, SELF_SLOT)
-        for r in d.relations:
-            add_slot(t, r)
-        for p in d.permissions:
-            add_slot(t, p)
-        # aux slots for arrows, one per occurrence
-        for p, expr in d.permissions.items():
-            for k, arrow in enumerate(_find_arrows(expr)):
-                slot = f"__arrow__:{p}:{k}"
-                arrow_slots[(t, p, k)] = slot
-                add_slot(t, slot)
-    prog.state_size = offset + 1  # trailing dead index
-
-    # -- edges --------------------------------------------------------------
     srcs: list[int] = []
     dsts: list[int] = []
     wildcard_map: dict[str, list] = {}  # subject type -> [state indices]
-
-    # arrow tuple-edge construction needs, per (type, left-relation), the list
-    # of (perm, occurrence, target) arrows reading it
-    arrows_by_left: dict[tuple, list] = {}
-    for t, d in schema.definitions.items():
-        for p, expr in d.permissions.items():
-            for k, arrow in enumerate(_find_arrows(expr)):
-                arrows_by_left.setdefault((t, arrow.left), []).append(
-                    (p, k, arrow.target))
-                prog.arrow_specs.setdefault((t, arrow.left), []).append(
-                    (p, k, arrow.target, arrow_slots[(t, p, k)]))
-
     for rel in tuples:
-        rt = rel.resource.type
-        if rt not in schema.definitions:
-            continue
-        d = schema.definitions[rt]
-        if rel.relation not in d.relations:
-            continue  # tuples on undefined relations are unreachable
-        dst = prog.state_index(rt, rel.relation, rel.resource.id)
-        st, sid, srel = rel.subject.type, rel.subject.id, rel.subject.relation
-        if sid == WILDCARD:
-            if dst is not None:
-                wildcard_map.setdefault(st, []).append(dst)
+        _emit_tuple_edges(prog, schema, arrow_slots, arrows_by_left, rel,
+                          srcs, dsts, wildcard_map)
+
+    return _finalize_program(prog, schema,
+                             np.asarray(srcs, np.int32),
+                             np.asarray(dsts, np.int32),
+                             wildcard_map, arrow_slots)
+
+
+def compile_graph_columnar(schema: sch.Schema, snap, rows: np.ndarray,
+                           overlay: list = (),
+                           extra_subject_ids: Optional[dict] = None
+                           ) -> GraphProgram:
+    """Vectorized compile from a columnar snapshot (spicedb/columnar.py).
+
+    Produces a GraphProgram identical (up to intra-destination edge order)
+    to `compile_graph` over the equivalent materialized tuples: the same
+    object universes/slot layout, the same edge multiset, wildcard terms,
+    and permission program.  `rows` selects the live base rows; `overlay`
+    is the (small) list of post-bootstrap Relationship objects, emitted via
+    the per-tuple path on top.
+    """
+    pool = snap.pool
+    n_pool = len(pool)
+    rtype = snap.rtype[rows]
+    rid = snap.rid[rows]
+    rel_c = snap.rel[rows]
+    stype = snap.stype[rows]
+    sid = snap.sid[rows]
+    srel = snap.srel[rows]
+    wc_ord = snap.ordinal(WILDCARD)
+
+    # -- universes (vectorized per type) ------------------------------------
+    prog = GraphProgram(state_size=0, edge_src=np.zeros(0, np.int32),
+                        edge_dst=np.zeros(0, np.int32))
+    # ord -> local index, per type (pool-backed ids; extras live in dicts)
+    local_of_ord: dict[str, np.ndarray] = {}
+    for t in schema.definitions:
+        t_ord = snap.ordinal(t)
+        if t_ord >= 0:
+            res = rid[rtype == t_ord]
+            sub = sid[(stype == t_ord) & (sid != wc_ord)]
+            ords = np.unique(np.concatenate([res, sub])) if (len(res) or len(sub)) \
+                else np.zeros(0, np.int32)
         else:
-            src = (prog.state_index(st, srel, sid) if srel
-                   else prog.state_index(st, SELF_SLOT, sid))
-            if src is not None and dst is not None:
-                srcs.append(src)
-                dsts.append(dst)
-        # arrow edges ride the same tuples (direct subjects only)
-        for (p, k, target) in arrows_by_left.get((rt, rel.relation), ()):
-            if sid == WILDCARD or srel:
-                continue
-            target_def = schema.definitions.get(st)
-            if target_def is None or not target_def.has_relation_or_permission(target):
-                continue
-            src = prog.state_index(st, target, sid)
-            aux = prog.state_index(rt, arrow_slots[(rt, p, k)], rel.resource.id)
-            if src is not None and aux is not None:
-                srcs.append(src)
-                dsts.append(aux)
+            ords = np.zeros(0, np.int32)
+        id_strings = [pool[o] for o in ords]
+        extras: set = set()
+        if extra_subject_ids and t in extra_subject_ids:
+            extras.update(extra_subject_ids[t])
+        for r in overlay:
+            if r.resource.type == t:
+                extras.add(r.resource.id)
+            if r.subject.type == t and r.subject.id != WILDCARD:
+                extras.add(r.subject.id)
+        extras.difference_update(id_strings)
+        # numpy string sort for the (large) pool-backed id set; the (few)
+        # extras are merged through a second vectorized sort
+        arr = np.asarray(id_strings, dtype=str) if id_strings else \
+            np.zeros(0, dtype="U1")
+        order = np.argsort(arr, kind="stable")
+        lo = np.full(n_pool, -1, np.int32)
+        if extras:
+            ex = np.asarray(sorted(extras), dtype=str)
+            merged = np.concatenate([arr[order], ex]) if len(arr) else ex
+            m_order = np.argsort(merged, kind="stable")
+            ordered = merged[m_order].tolist()
+            inv = np.empty(len(m_order), np.int32)
+            inv[m_order] = np.arange(len(m_order), dtype=np.int32)
+            if len(ords):
+                lo[ords[order]] = inv[: len(arr)]
+        else:
+            ordered = arr[order].tolist()
+            if len(ords):
+                lo[ords[order]] = np.arange(len(order), dtype=np.int32)
+        prog.object_ids[t] = ordered
+        prog.object_index[t] = {oid: i for i, oid in enumerate(ordered)}
+        prog.num_objects[t] = len(ordered)
+        local_of_ord[t] = lo
 
-    if srcs:
-        src_arr = np.asarray(srcs, np.int32)
-        dst_arr = np.asarray(dsts, np.int32)
-        order = np.argsort(dst_arr, kind="stable")
-        prog.edge_src = src_arr[order]
-        prog.edge_dst = dst_arr[order]
+    arrow_slots, arrows_by_left = _assign_slots(prog, schema)
 
-    # -- wildcard terms -----------------------------------------------------
-    for st, indices in wildcard_map.items():
-        rng = prog.slot_range(st, SELF_SLOT)
-        if rng is None:
+    # -- edges (grouped by (rtype, rel, stype, srel), vectorized per group) --
+    src_parts: list[np.ndarray] = []
+    dst_parts: list[np.ndarray] = []
+    wildcard_map: dict[str, list] = {}
+
+    wc_rows = np.nonzero(sid == wc_ord)[0] if wc_ord >= 0 else ()
+    for i in wc_rows:
+        t = pool[rtype[i]]
+        d = schema.definitions.get(t)
+        if d is None or pool[rel_c[i]] not in d.relations:
             continue
-        prog.wildcard_terms.append(WildcardTerm(
-            self_offset=rng[0], self_length=rng[1],
-            mask_indices=tuple(sorted(set(indices)))))
+        off = prog.slot_offsets.get((t, pool[rel_c[i]]))
+        loc = local_of_ord[t][rid[i]] if t in local_of_ord else -1
+        if off is not None and loc >= 0:
+            wildcard_map.setdefault(pool[stype[i]], []).append(int(off + loc))
 
-    # -- permission program (topo order within each type) -------------------
-    for t, d in schema.definitions.items():
-        order = _topo_permissions(d)
-        for p in order:
-            expr = d.permissions[p]
-            off, n = prog.slot_range(t, p)
-            compiled = _compile_expr(prog, schema, t, p, expr, arrow_slots,
-                                     counter=[0])
-            prog.perm_ops.append(PermOp(offset=off, length=n, expr=compiled))
+    direct = np.nonzero(sid != wc_ord)[0] if wc_ord >= 0 else \
+        np.arange(len(rows))
+    if len(direct):
+        g_rt, g_rl = rtype[direct], rel_c[direct]
+        g_st, g_sr = stype[direct], srel[direct]
+        order = np.lexsort((g_sr, g_st, g_rl, g_rt))
+        srt, srl = g_rt[order], g_rl[order]
+        sst, ssr = g_st[order], g_sr[order]
+        change = np.nonzero((np.diff(srt) != 0) | (np.diff(srl) != 0)
+                            | (np.diff(sst) != 0) | (np.diff(ssr) != 0))[0] + 1
+        bounds = np.concatenate([[0], change, [len(order)]])
+        for gi in range(len(bounds) - 1):
+            lo_b, hi_b = int(bounds[gi]), int(bounds[gi + 1])
+            if lo_b == hi_b:
+                continue
+            t = pool[srt[lo_b]]
+            relation = pool[srl[lo_b]]
+            st = pool[sst[lo_b]]
+            sr = pool[ssr[lo_b]]
+            d = schema.definitions.get(t)
+            if d is None or relation not in d.relations:
+                continue
+            rows_g = direct[order[lo_b:hi_b]]
+            dst_off = prog.slot_offsets[(t, relation)]
+            dst_loc = local_of_ord[t][rid[rows_g]]
+            dst_state = (dst_off + dst_loc).astype(np.int32)
+            # direct/userset edge
+            src_slot = prog.slot_offsets.get((st, sr if sr else SELF_SLOT))
+            if src_slot is not None and st in local_of_ord:
+                src_loc = local_of_ord[st][sid[rows_g]]
+                ok = (src_loc >= 0) & (dst_loc >= 0)
+                src_parts.append((src_slot + src_loc[ok]).astype(np.int32))
+                dst_parts.append(dst_state[ok])
+            # arrow edges (direct subjects only)
+            if not sr:
+                for (p, k, target) in arrows_by_left.get((t, relation), ()):
+                    target_def = schema.definitions.get(st)
+                    if (target_def is None
+                            or not target_def.has_relation_or_permission(target)):
+                        continue
+                    a_src_off = prog.slot_offsets.get((st, target))
+                    a_dst_off = prog.slot_offsets.get(
+                        (t, arrow_slots[(t, p, k)]))
+                    if a_src_off is None or a_dst_off is None:
+                        continue
+                    src_loc = local_of_ord[st][sid[rows_g]]
+                    ok = (src_loc >= 0) & (dst_loc >= 0)
+                    src_parts.append((a_src_off + src_loc[ok]).astype(np.int32))
+                    dst_parts.append((a_dst_off + dst_loc[ok]).astype(np.int32))
 
-    prog.suggested_iterations = max(2, schema.max_rewrite_depth() + 2)
-    return prog
+    # overlay tuples via the per-tuple path
+    srcs_o: list[int] = []
+    dsts_o: list[int] = []
+    for r in overlay:
+        _emit_tuple_edges(prog, schema, arrow_slots, arrows_by_left, r,
+                          srcs_o, dsts_o, wildcard_map)
+    if srcs_o:
+        src_parts.append(np.asarray(srcs_o, np.int32))
+        dst_parts.append(np.asarray(dsts_o, np.int32))
+
+    src_arr = np.concatenate(src_parts) if src_parts else np.zeros(0, np.int32)
+    dst_arr = np.concatenate(dst_parts) if dst_parts else np.zeros(0, np.int32)
+    return _finalize_program(prog, schema, src_arr, dst_arr,
+                             wildcard_map, arrow_slots)
 
 
 def _find_arrows(expr: sch.Expr) -> list:

@@ -63,7 +63,8 @@ from ..spicedb.types import (
     WILDCARD,
 )
 from .ell import EllKernelCache, batch_words, build_tables
-from .graph_compile import GraphProgram, SELF_SLOT, compile_graph
+from .graph_compile import (GraphProgram, SELF_SLOT, compile_graph,
+                            compile_graph_columnar)
 from .spmv import KernelCache, bucket, pad_edges
 
 _MIN_EDGE_BUCKET = 256
@@ -352,10 +353,9 @@ class JaxEndpoint(PermissionsEndpoint):
             schema_text = bootstrap.schema_text
             rel_text = bootstrap.relationships_text
         ep = cls(sch.parse_schema(schema_text), **kwargs)
-        bs = Bootstrap(schema_text=schema_text, relationships_text=rel_text)
-        rels = bs.relationships()
-        if rels:
-            ep.store.bulk_load(rels)
+        if rel_text.strip():
+            # columnar bulk path (native parser when available)
+            ep.store.bulk_load_text(rel_text)
         return ep
 
     # -- delta intake -------------------------------------------------------
@@ -410,15 +410,41 @@ class JaxEndpoint(PermissionsEndpoint):
         # are subsumed by it
         self._drain_pending()
         self._graph_invalid = False
-        tuples = self.store.read(None)
         extra = {t: set(ids) for t, ids in self._known_extra_subjects.items()}
-        prog = compile_graph(self.schema, tuples, extra_subject_ids=extra)
-        graph = self._graph_cls(prog, self._edge_endpoints,
-                                num_iters=self._num_iters)
-        graph.index_tuples(tuples)
-        self._reset_expiry(tuples)
+        view = self.store.columnar_view() if self._graph_cls is _EllGraph \
+            else None
+        if view is not None:
+            # vectorized compile straight off the store's columnar base —
+            # no per-tuple object materialization (the ELL graph is
+            # positionless, so nothing needs the tuple list)
+            snap, rows, overlay = view
+            prog = compile_graph_columnar(self.schema, snap, rows, overlay,
+                                          extra_subject_ids=extra)
+            graph = self._graph_cls(prog, self._edge_endpoints,
+                                    num_iters=self._num_iters)
+            self._reset_expiry_columnar(snap, rows, overlay)
+        else:
+            tuples = self.store.read(None)
+            prog = compile_graph(self.schema, tuples, extra_subject_ids=extra)
+            graph = self._graph_cls(prog, self._edge_endpoints,
+                                    num_iters=self._num_iters)
+            graph.index_tuples(tuples)
+            self._reset_expiry(tuples)
         self._graph = graph
         self.stats["rebuilds"] += 1
+
+    def _reset_expiry_columnar(self, snap, rows, overlay) -> None:
+        self._expiry_heap = []
+        self._expiry_meta = {}
+        exp = snap.expiry[rows]
+        for i in np.nonzero(~np.isnan(exp))[0]:
+            key = snap.key_of(int(rows[i]))
+            self._expiry_meta[key] = float(exp[i])
+            heapq.heappush(self._expiry_heap, (float(exp[i]), key))
+        for rel in overlay:
+            if rel.expires_at is not None:
+                self._expiry_meta[rel.key()] = rel.expires_at
+                heapq.heappush(self._expiry_heap, (rel.expires_at, rel.key()))
 
     def _reset_expiry(self, tuples: list) -> None:
         self._expiry_heap = []

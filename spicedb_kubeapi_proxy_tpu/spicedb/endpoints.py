@@ -169,10 +169,9 @@ class EmbeddedEndpoint(PermissionsEndpoint):
             schema_text = bootstrap.schema_text
             rel_text = bootstrap.relationships_text
         endpoint = cls(sch.parse_schema(schema_text))
-        bs = Bootstrap(schema_text=schema_text, relationships_text=rel_text)
-        rels = bs.relationships()
-        if rels:
-            endpoint.store.bulk_load(rels)
+        if rel_text.strip():
+            # columnar bulk path (native parser when available)
+            endpoint.store.bulk_load_text(rel_text)
         return endpoint
 
     # -- verbs --------------------------------------------------------------

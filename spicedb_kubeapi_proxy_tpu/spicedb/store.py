@@ -15,9 +15,12 @@ from __future__ import annotations
 
 import threading
 import time
+
+import numpy as np
 from dataclasses import dataclass, field, replace
 from typing import Callable, Iterable, Optional
 
+from .columnar import BaseLayer, ColumnarSnapshot
 from .types import (
     AlreadyExistsError,
     ObjectRef,
@@ -93,6 +96,9 @@ class TupleStore:
         self._clock = clock
         # (resource_type, relation) -> {resource_id -> {subject_key -> _Entry}}
         self._by_relation: dict = {}
+        # optional immutable columnar bootstrap layer (bulk_load_text);
+        # overlay writes shadow base rows via its dead mask
+        self._base: Optional[BaseLayer] = None
         self._revision = 0
         self._watchers: list[Watcher] = []
         # delta listeners get every committed batch synchronously under the
@@ -116,6 +122,10 @@ class TupleStore:
         now = self._clock()
         out = []
         with self._lock:
+            if self._base is not None:
+                snap = self._base.snap
+                out.extend(snap.relationship(int(i))
+                           for i in self._base.matching_rows(flt, now))
             for (rtype, relation), by_id in self._by_relation.items():
                 if flt is not None and flt.resource_type and rtype != flt.resource_type:
                     continue
@@ -134,31 +144,62 @@ class TupleStore:
     def subjects_for(self, resource: ObjectRef, relation: str) -> list:
         """Live subjects of (resource, relation) — evaluator hot path."""
         now = self._clock()
+        out = []
         with self._lock:
+            base = self._base
+            if base is not None:
+                snap = base.snap
+                pool = snap.pool
+                for row in base.rows_for_resource(resource.type, relation,
+                                                  resource.id):
+                    if base.row_live(int(row), now):
+                        out.append(SubjectRef(pool[snap.stype[row]],
+                                              pool[snap.sid[row]],
+                                              pool[snap.srel[row]]))
             by_id = self._by_relation.get((resource.type, relation))
-            if not by_id:
-                return []
-            subjects = by_id.get(resource.id)
-            if not subjects:
-                return []
-            return [e.rel.subject for e in subjects.values()
-                    if not e.rel.expired(now)]
+            subjects = by_id.get(resource.id) if by_id else None
+            if subjects:
+                out.extend(e.rel.subject for e in subjects.values()
+                           if not e.rel.expired(now))
+        return out
 
     def resources_with_relation(self, resource_type: str, relation: str) -> list:
         """Live resource ids having any tuple for (type, relation)."""
         now = self._clock()
+        out = []
+        seen = set()
         with self._lock:
+            base = self._base
+            if base is not None:
+                snap = base.snap
+                rows = base.rows_for(resource_type, relation)
+                if len(rows):
+                    live = rows[base.live_mask(now)[rows]]
+                    for o in np.unique(snap.rid[live]):
+                        rid = snap.pool[o]
+                        seen.add(rid)
+                        out.append(rid)
             by_id = self._by_relation.get((resource_type, relation))
-            if not by_id:
-                return []
-            return [rid for rid, subjects in by_id.items()
-                    if any(not e.rel.expired(now) for e in subjects.values())]
+            if by_id:
+                for rid, subjects in by_id.items():
+                    if rid not in seen and any(
+                            not e.rel.expired(now) for e in subjects.values()):
+                        out.append(rid)
+        return out
 
     def object_ids_of_type(self, resource_type: str) -> list:
         """All ids appearing as a resource of the given type (live tuples)."""
         now = self._clock()
         ids = set()
         with self._lock:
+            base = self._base
+            if base is not None:
+                snap = base.snap
+                t = snap.ordinal(resource_type)
+                if t >= 0:
+                    live = base.live_mask(now) & (snap.rtype == t)
+                    ids.update(snap.pool[o]
+                               for o in np.unique(snap.rid[live]))
             for (rtype, _), by_id in self._by_relation.items():
                 if rtype != resource_type:
                     continue
@@ -170,9 +211,7 @@ class TupleStore:
     def has_exact(self, rel: Relationship) -> bool:
         now = self._clock()
         with self._lock:
-            by_id = self._by_relation.get((rel.resource.type, rel.relation), {})
-            entry = by_id.get(rel.resource.id, {}).get(rel.subject)
-            return entry is not None and not entry.rel.expired(now)
+            return self._live_entry(rel, now) is not None
 
     def count(self) -> int:
         return len(self.read())
@@ -257,9 +296,48 @@ class TupleStore:
         """Test helper (mirrors the reference e2e DeleteAllTuples util)."""
         with self._lock:
             self._by_relation.clear()
+            self._base = None
             self._revision += 1
             for fn in list(self._reset_listeners):
                 fn()
+
+    # -- columnar bulk path -------------------------------------------------
+
+    def bulk_load_snapshot(self, snap: ColumnarSnapshot) -> int:
+        """Adopt a columnar snapshot as the store's base layer without
+        materializing per-tuple objects (the fast bootstrap path; reference
+        seeds bootstrap data straight into the datastore, spicedb.go:63-67).
+        Requires an empty store; otherwise falls back to object inserts.
+        One revision, no watch events (like bulk_load)."""
+        with self._lock:
+            if self._by_relation or self._base is not None:
+                return self.bulk_load(snap.relationship(i)
+                                      for i in range(len(snap)))
+            self._revision += 1
+            self._base = BaseLayer(snap, self._revision)
+            for fn in list(self._reset_listeners):
+                fn()
+            return self._revision
+
+    def bulk_load_text(self, text: str) -> int:
+        """Parse + adopt relationship text via the native loader."""
+        return self.bulk_load_snapshot(ColumnarSnapshot.from_text(text))
+
+    def columnar_view(self) -> Optional[tuple]:
+        """(snapshot, live base row indices, overlay relationships) for the
+        vectorized graph compiler, or None when no base layer exists.  Call
+        under no lock; takes the store lock itself."""
+        now = self._clock()
+        with self._lock:
+            if self._base is None:
+                return None
+            rows = self._base.live_rows(now)
+            overlay = []
+            for by_id in self._by_relation.values():
+                for subjects in by_id.values():
+                    overlay.extend(e.rel for e in subjects.values()
+                                   if not e.rel.expired(now))
+            return self._base.snap, rows, overlay
 
     # -- watch --------------------------------------------------------------
 
@@ -292,11 +370,23 @@ class TupleStore:
     def _live_entry(self, rel: Relationship, now: float) -> Optional[_Entry]:
         by_id = self._by_relation.get((rel.resource.type, rel.relation), {})
         entry = by_id.get(rel.resource.id, {}).get(rel.subject)
-        if entry is None or entry.rel.expired(now):
-            return None
-        return entry
+        if entry is not None:
+            return None if entry.rel.expired(now) else entry
+        base = self._base
+        if base is not None:
+            row = base.find_row(rel.key())
+            if row >= 0 and base.row_live(row, now):
+                return _Entry(rel=base.snap.relationship(row),
+                              revision=base.revision)
+        return None
 
     def _put(self, rel: Relationship, rev: int) -> None:
+        base = self._base
+        if base is not None:
+            # overlay shadows the base copy (keeps iteration duplicate-free)
+            row = base.find_row(rel.key())
+            if row >= 0:
+                base.dead[row] = True
         key = (rel.resource.type, rel.relation)
         by_id = self._by_relation.setdefault(key, {})
         subjects = by_id.setdefault(rel.resource.id, {})
@@ -305,17 +395,21 @@ class TupleStore:
     def _remove(self, rel: Relationship) -> bool:
         key = (rel.resource.type, rel.relation)
         by_id = self._by_relation.get(key)
-        if not by_id:
-            return False
-        subjects = by_id.get(rel.resource.id)
-        if not subjects or rel.subject not in subjects:
-            return False
-        del subjects[rel.subject]
-        if not subjects:
-            del by_id[rel.resource.id]
-        if not by_id:
-            del self._by_relation[key]
-        return True
+        subjects = by_id.get(rel.resource.id) if by_id else None
+        if subjects and rel.subject in subjects:
+            del subjects[rel.subject]
+            if not subjects:
+                del by_id[rel.resource.id]
+            if not by_id:
+                del self._by_relation[key]
+            return True
+        base = self._base
+        if base is not None:
+            row = base.find_row(rel.key())
+            if row >= 0 and not base.dead[row]:
+                base.dead[row] = True
+                return True
+        return False
 
     def _check_preconditions(self, preconditions: list) -> None:
         for p in preconditions:
