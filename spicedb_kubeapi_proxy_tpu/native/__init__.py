@@ -29,17 +29,28 @@ _tried = False
 
 def _build() -> bool:
     include = sysconfig.get_paths()["include"]
+    # compile to a process-unique temp path and rename into place so that
+    # concurrent builders (pytest-xdist, bench + server) can't dlopen a
+    # half-written file — rename on the same filesystem is atomic
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           f"-I{include}", _SRC, "-o", _SO]
+           f"-I{include}", _SRC, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            sys.stderr.write(f"native build failed (falling back to python): "
+                             f"{proc.stderr[-2000:]}\n")
+            return False
+        os.replace(tmp, _SO)
+        return True
     except (OSError, subprocess.TimeoutExpired):
         return False
-    if proc.returncode != 0:
-        sys.stderr.write(f"native build failed (falling back to python): "
-                         f"{proc.stderr[-2000:]}\n")
-        return False
-    return True
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def load() -> Optional[object]:

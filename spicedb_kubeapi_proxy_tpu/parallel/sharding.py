@@ -141,3 +141,200 @@ class ShardedKernel:
         out = np.asarray(self._checks(q, jnp.asarray(gi), jnp.asarray(gc),
                                       edge_src, edge_dst))
         return out[: len(gather_idx)]
+
+
+# -- packed (ELL) sharded kernel ---------------------------------------------
+
+def _ceil_mult(n: int, m: int) -> int:
+    return ((max(n, 1) + m - 1) // m) * m
+
+
+class ShardedEllKernel:
+    """Multi-chip variant of the bit-packed fixed-fanin kernel (ops/ell.py).
+
+    Sharding layout over the 2D (data x graph) mesh:
+
+    - `data` axis — the packed WORD axis: each chip owns W/n_data uint32
+      words (32 query columns per word).  Pure throughput parallelism for
+      concurrent list requests; zero communication.
+    - `graph` axis — table ROWS: each chip owns a contiguous block of the
+      main/aux gather tables and computes the one-step closure for its
+      rows; blocks are reassembled with a tiled `all_gather` over ICI each
+      iteration (the packed state is replicated along `graph`, so the
+      gather payload is N x W/n_data words).
+
+    Main rows are padded to a multiple of n_graph, which shifts the aux
+    block's global offset — aux references in both tables are remapped from
+    state_size to the padded offset at construction.  Padding rows read the
+    dead index and stay zero.  Wildcards/permission ops run replicated per
+    shard on the gathered full state (tiny elementwise work).
+    """
+
+    def __init__(self, prog: GraphProgram, mesh: Mesh,
+                 num_iters: Optional[int] = None):
+        from ..ops.ell import K_AUX, K_MAIN, build_tables
+        from ..ops.ell import MAX_ITERATIONS as ELL_MAX
+
+        self.prog = prog
+        self.mesh = mesh
+        t = build_tables(prog)
+        n = prog.state_size
+        dead = prog.dead_index
+        n_graph = mesh.shape["graph"]
+        self.n_pad = _ceil_mult(n, n_graph)
+        a = t.idx_aux.shape[0]
+        self.a_pad = _ceil_mult(max(a, 1), n_graph)
+        main = np.full((self.n_pad, K_MAIN), dead, np.int32)
+        main[:n] = t.idx_main
+        aux = np.full((self.a_pad, K_AUX), dead, np.int32)
+        aux[:a] = t.idx_aux
+        if self.n_pad != n:
+            # remap aux references past the padded main block
+            main[main >= n] += self.n_pad - n
+            aux[aux >= n] += self.n_pad - n
+        base = num_iters or ELL_MAX
+        self.num_iters = base * (1 + t.tree_depth)
+        row_spec = NamedSharding(mesh, P("graph", None))
+        self.idx_main = jax.device_put(main, row_spec)
+        self.idx_aux = jax.device_put(aux, row_spec)
+        self._jits: dict = {}
+
+    # -- the sharded program -------------------------------------------------
+
+    def _evaluate_shard_fn(self):
+        from ..ops.ell import (K_AUX, K_MAIN, _apply_perm_expr_packed)
+
+        prog = self.prog
+        n_pad = self.n_pad
+        dead = prog.dead_index
+        perm_ops = tuple(prog.perm_ops)
+        wc_masks = []
+        for term in prog.wildcard_terms:
+            m = np.zeros((n_pad, 1), np.uint32)
+            m[np.asarray(term.mask_indices, np.int64)] = np.uint32(0xFFFFFFFF)
+            wc_masks.append((term, jnp.asarray(m)))
+        num_iters = self.num_iters
+
+        def shard_fn(q_local, main_local, aux_local):
+            wl = q_local.shape[0] // 32
+            cols = jnp.arange(q_local.shape[0])
+            word = cols // 32
+            bit = (cols % 32).astype(jnp.uint32)
+            x0_main = jnp.zeros((n_pad, wl), jnp.uint32)
+            x0_main = x0_main.at[q_local, word].add(jnp.uint32(1) << bit)
+            x0_main = x0_main.at[dead].set(np.uint32(0))
+            x0_aux = jnp.zeros((self.a_pad, wl), jnp.uint32)
+
+            def step(x_main, x_aux):
+                x = jnp.concatenate([x_main, x_aux], axis=0)
+                y_main_l = x[main_local[:, 0]]
+                for k in range(1, K_MAIN):
+                    y_main_l = y_main_l | x[main_local[:, k]]
+                y_aux_l = x[aux_local[:, 0]]
+                for k in range(1, K_AUX):
+                    y_aux_l = y_aux_l | x[aux_local[:, k]]
+                # reassemble row blocks across the graph axis (tiled ICI
+                # all-gather; payload is rows x local words)
+                y_main = jax.lax.all_gather(y_main_l, "graph", axis=0,
+                                            tiled=True)
+                y_aux = jax.lax.all_gather(y_aux_l, "graph", axis=0,
+                                           tiled=True)
+                for term, mask in wc_masks:
+                    live = jax.lax.dynamic_slice_in_dim(
+                        y_main | x0_main, term.self_offset, term.self_length,
+                        axis=0)
+                    any_live = jax.lax.reduce(
+                        live, np.uint32(0), jax.lax.bitwise_or, (0,))[None, :]
+                    y_main = y_main | (mask & any_live)
+                x1 = y_main | x0_main
+                for op in perm_ops:
+                    vec = _apply_perm_expr_packed(op.expr, x1)
+                    seed = jax.lax.dynamic_slice_in_dim(
+                        x0_main, op.offset, op.length, axis=0)
+                    x1 = jax.lax.dynamic_update_slice_in_dim(
+                        x1, vec | seed, op.offset, axis=0)
+                x1 = x1.at[dead].set(np.uint32(0))
+                return x1, y_aux
+
+            def cond(state):
+                _, _, changed, i = state
+                return jnp.logical_and(changed, i < num_iters)
+
+            def body(state):
+                x_main, x_aux, _, i = state
+                x1_main, x1_aux = step(x_main, x_aux)
+                changed = jnp.any(x1_main != x_main) | jnp.any(x1_aux != x_aux)
+                changed = jax.lax.pmax(changed.astype(jnp.int32),
+                                       ("data", "graph")) > 0
+                return (x1_main, x1_aux, changed, i + 1)
+
+            x_main, _, _, _ = jax.lax.while_loop(
+                cond, body, (x0_main, x0_aux, jnp.bool_(True), jnp.int32(0)))
+            return x_main
+
+        return jax.shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(P("data"), P("graph", None), P("graph", None)),
+            out_specs=P(None, "data"),
+            check_vma=False,  # state is replicated along `graph` by design
+        )
+
+    def _fns(self) -> tuple:
+        if not self._jits:
+            evaluate = self._evaluate_shard_fn()
+
+            def run_lookup(slot_offset, slot_length, q, idx_main, idx_aux):
+                x = evaluate(q, idx_main, idx_aux)
+                return jax.lax.dynamic_slice_in_dim(
+                    x, slot_offset, slot_length, axis=0)
+
+            def run_checks(q, gather_idx, gather_word, gather_bit,
+                           idx_main, idx_aux):
+                x = evaluate(q, idx_main, idx_aux)
+                return (x[gather_idx, gather_word] >> gather_bit) & jnp.uint32(1)
+
+            self._jits = (jax.jit(run_lookup, static_argnums=(0, 1)),
+                          jax.jit(run_checks))
+        return self._jits
+
+    # -- host-facing ---------------------------------------------------------
+
+    def _pad_q(self, q_idx: np.ndarray) -> np.ndarray:
+        from ..ops.ell import batch_words
+
+        n_data = self.mesh.shape["data"]
+        w = batch_words(len(q_idx), minimum=n_data)
+        if w % n_data:
+            w += n_data - (w % n_data)
+        out = np.full(w * 32, self.prog.dead_index, np.int32)
+        out[: len(q_idx)] = q_idx
+        return out
+
+    def lookup(self, slot_offset: int, slot_length: int,
+               q_idx: np.ndarray) -> np.ndarray:
+        """bool [slot_length, B] allowed bitmap over the real batch."""
+        run_lookup, _ = self._fns()
+        q = jax.device_put(self._pad_q(np.asarray(q_idx, np.int32)),
+                           NamedSharding(self.mesh, P("data")))
+        packed = np.ascontiguousarray(
+            run_lookup(slot_offset, slot_length, q, self.idx_main,
+                       self.idx_aux))
+        bits = np.unpackbits(packed.view(np.uint8).reshape(slot_length, -1),
+                             axis=1, bitorder="little").astype(bool)
+        return bits[:, : len(q_idx)]
+
+    def checks(self, q_idx: np.ndarray, gather_idx: np.ndarray,
+               gather_col: np.ndarray) -> np.ndarray:
+        run_lookup, run_checks = self._fns()
+        q = jax.device_put(self._pad_q(np.asarray(q_idx, np.int32)),
+                           NamedSharding(self.mesh, P("data")))
+        g = bucket(max(len(gather_idx), 1), 8)
+        gi = np.zeros(g, np.int32)
+        gcol = np.zeros(g, np.int64)
+        gi[: len(gather_idx)] = gather_idx
+        gcol[: len(gather_col)] = gather_col
+        out = np.asarray(run_checks(
+            q, jnp.asarray(gi), jnp.asarray(gcol // 32),
+            jnp.asarray((gcol % 32).astype(np.uint32)),
+            self.idx_main, self.idx_aux))
+        return (out[: len(gather_idx)] != 0)

@@ -82,7 +82,13 @@ class ColumnarSnapshot:
 
     @classmethod
     def _from_text_py(cls, text: str) -> "ColumnarSnapshot":
-        """Pure-Python mirror of native/fastparse.cpp."""
+        """Pure-Python mirror of native/fastparse.cpp.
+
+        The bulk-text grammar is deliberately ASCII-strict so both
+        implementations agree bit-for-bit: lines split on '\\n' only,
+        surrounding whitespace is ASCII whitespace, and expiration floats
+        reject Python-only forms (underscores) and C-only forms (hex).
+        """
         pool: list = []
         index: dict = {}
 
@@ -94,18 +100,22 @@ class ColumnarSnapshot:
                 pool.append(s)
             return i
 
+        ascii_ws = " \t\r\v\f\n"
         cols: list[list[int]] = [[] for _ in range(6)]
         expiry: list[float] = []
-        for lineno, raw in enumerate(text.splitlines(), 1):
-            line = raw.strip()
+        for lineno, raw in enumerate(text.split("\n"), 1):
+            line = raw.strip(ascii_ws)
             if not line or line.startswith("#"):
                 continue
             exp = float("nan")
             if line.endswith("]"):
                 lb = line.rfind("[expiration:")
                 if lb != -1:
+                    num = line[lb + 12: -1].strip(ascii_ws)
                     try:
-                        exp = float(line[lb + 12: -1])
+                        if "_" in num:
+                            raise ValueError(num)
+                        exp = float(num)
                     except ValueError:
                         raise ValueError(f"line {lineno}: bad expiration: {line!r}")
                     line = line[:lb]

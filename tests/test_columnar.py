@@ -243,3 +243,28 @@ class TestColumnarCompilerDifferential:
         p1 = compile_graph(schema, tuples)
         p2 = compile_graph_columnar(schema, snap, keep, [])
         assert_programs_equal(p1, p2)
+
+
+class TestAsciiStrictParity:
+    """The bulk-text grammar is ASCII-strict so native/Python agree
+    bit-for-bit on exotic inputs (underscored floats, unicode whitespace,
+    unicode line separators)."""
+
+    @pytest.mark.parametrize("name,parse", parsers())
+    def test_underscored_float_rejected(self, name, parse):
+        with pytest.raises(ValueError):
+            parse("x:y#z@w:v[expiration:1_5]")
+
+    @pytest.mark.parametrize("name,parse", parsers())
+    def test_unicode_whitespace_not_stripped(self, name, parse):
+        # U+00A0 is not ASCII whitespace: it stays part of the type field
+        snap = parse(" x:y#z@w:v")
+        assert snap.relationship(0).resource.type == " x"
+
+    @pytest.mark.parametrize("name,parse", parsers())
+    def test_unicode_line_separator_not_a_newline(self, name, parse):
+        # U+2028 does not split lines in the bulk grammar -> one tuple with
+        # the separator embedded in the subject id
+        snap = parse("a:b#r@u:one more")
+        assert len(snap) == 1
+        assert snap.relationship(0).subject.id == "one more"
