@@ -247,8 +247,26 @@ def create_endpoint(url: str,
                 f"unknown dispatch mode {dispatch!r}; use batched|direct")
         return ep
     if scheme in ("grpc", "grpcs", "http", "https"):
-        raise EndpointConfigError(
-            f"remote SpiceDB endpoint {url!r} requires grpcio + authzed client"
-            " bindings, which are not bundled in this environment; use"
-            " embedded:// or jax://")
+        # remote permissions service over gRPC (reference options.go:331-368:
+        # TLS by default, bearer token, optional CA; `grpc`/`http` schemes or
+        # insecure=True select plaintext)
+        try:
+            from .grpc_remote import RemoteEndpoint
+        except ImportError as e:
+            raise EndpointConfigError(
+                f"remote endpoint {url!r} requires grpcio: {e}") from e
+        target = split.netloc or split.path
+        if not target:
+            raise EndpointConfigError(f"remote endpoint {url!r} has no host")
+        insecure = (scheme in ("grpc", "http")
+                    or bool(kwargs.get("insecure")))
+        ca_pem = None
+        ca_path = kwargs.get("ca_path") or ""
+        if ca_path:
+            with open(ca_path, "rb") as f:
+                ca_pem = f.read()
+        return RemoteEndpoint(target, token=kwargs.get("token", ""),
+                              insecure=insecure, ca_pem=ca_pem,
+                              skip_verify=bool(kwargs.get("skip_verify")
+                                               or kwargs.get("skip_verify_ca")))
     raise EndpointConfigError(f"unsupported spicedb endpoint scheme {scheme!r}")

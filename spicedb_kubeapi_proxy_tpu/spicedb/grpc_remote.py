@@ -1,0 +1,378 @@
+"""Remote permissions endpoint over gRPC + the standalone authz server.
+
+Mirrors the reference's remote-SpiceDB mode (options.go:331-368: TLS or
+insecure channel, bearer-token credentials) and adds the inverse: a gRPC
+*server* exposing any local endpoint — including the `jax://` TPU backend
+wrapped in the cross-request batching dispatcher — so multiple proxy
+instances can share one TPU-backed authorization service over the network
+(`python -m spicedb_kubeapi_proxy_tpu.permsd`). Method paths and message
+encodings follow authzed.api.v1 (spicedb/wire.py; wire compatibility with
+a real SpiceDB is best-effort in this offline environment — client and
+server here are round-trip tested against each other).
+
+Verbs (SURVEY.md §5): CheckPermission, CheckBulkPermissions,
+LookupResources (server-stream), ReadRelationships (server-stream),
+WriteRelationships, DeleteRelationships, Watch (server-stream).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Iterable, Optional
+
+import grpc
+import grpc.aio
+
+from . import wire
+from .endpoints import PermissionsEndpoint
+from .types import (
+    AlreadyExistsError,
+    CheckRequest,
+    CheckResult,
+    Precondition,
+    PreconditionFailedError,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SubjectRef,
+    WatchUpdate,
+)
+
+_PERMS = "/authzed.api.v1.PermissionsService/"
+_WATCH = "/authzed.api.v1.WatchService/Watch"
+
+_identity = lambda b: b  # noqa: E731 — payloads are already bytes
+
+
+class RemoteEndpointError(Exception):
+    def __init__(self, code, details: str):
+        self.code = code
+        super().__init__(f"remote endpoint error {code}: {details}")
+
+
+def _map_rpc_error(e: grpc.RpcError) -> Exception:
+    code = e.code() if callable(getattr(e, "code", None)) else None
+    details = e.details() if callable(getattr(e, "details", None)) else str(e)
+    if code == grpc.StatusCode.ALREADY_EXISTS:
+        return AlreadyExistsError(details)
+    return RemoteEndpointError(code, details or "")
+
+
+class _RemoteWatcher:
+    """Adapter: a background sync-gRPC Watch stream feeding the same
+    poll()/close() surface as store.Watcher (consumed via run_in_executor
+    by authz/watch.py)."""
+
+    def __init__(self, target: str, object_types: Optional[list],
+                 channel_factory):
+        self._events: list = []
+        self._cond = threading.Condition()
+        self.closed = False
+        self._channel = channel_factory()
+        self._thread = threading.Thread(
+            target=self._run, args=(object_types,), daemon=True)
+        self._thread.start()
+
+    def _run(self, object_types) -> None:
+        try:
+            call = self._channel.unary_stream(
+                _WATCH, request_serializer=_identity,
+                response_deserializer=_identity,
+            )(wire.enc_watch_request(object_types))
+            for payload in call:
+                revision, updates = wire.dec_watch_response(payload)
+                if not updates:
+                    continue
+                with self._cond:
+                    self._events.append(WatchUpdate(updates=tuple(updates),
+                                                    revision=revision))
+                    self._cond.notify_all()
+        except grpc.RpcError:
+            pass  # channel closed / server gone: surface as closed watcher
+        finally:
+            with self._cond:
+                self.closed = True
+                self._cond.notify_all()
+
+    def poll(self, timeout: Optional[float] = None) -> Optional[WatchUpdate]:
+        with self._cond:
+            if not self._events and not self.closed:
+                self._cond.wait(timeout)
+            if self._events:
+                return self._events.pop(0)
+            return None
+
+    def close(self) -> None:
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+        self._channel.close()
+
+
+class RemoteEndpoint(PermissionsEndpoint):
+    """gRPC client for a remote permissions service (reference
+    options.go:331-368 channel semantics: `grpcs`/`https` or `--spicedb-
+    insecure` plaintext, bearer token metadata, optional custom CA)."""
+
+    def __init__(self, target: str, token: str = "", insecure: bool = False,
+                 ca_pem: Optional[bytes] = None, skip_verify: bool = False):
+        self.target = target
+        self.token = token
+        self.insecure = insecure
+        self.ca_pem = ca_pem
+        self.skip_verify = skip_verify
+        self._aio_channel: Optional[grpc.aio.Channel] = None
+        self._lock = threading.Lock()
+
+    # -- channels -----------------------------------------------------------
+
+    def _metadata(self) -> list:
+        return ([("authorization", f"Bearer {self.token}")]
+                if self.token else [])
+
+    def _channel(self) -> grpc.aio.Channel:
+        if self._aio_channel is None:
+            with self._lock:
+                if self._aio_channel is None:
+                    if self.insecure:
+                        self._aio_channel = grpc.aio.insecure_channel(self.target)
+                    else:
+                        creds = grpc.ssl_channel_credentials(
+                            root_certificates=self.ca_pem)
+                        self._aio_channel = grpc.aio.secure_channel(
+                            self.target, creds)
+        return self._aio_channel
+
+    def _sync_channel(self):
+        if self.insecure:
+            return grpc.insecure_channel(self.target)
+        return grpc.secure_channel(
+            self.target, grpc.ssl_channel_credentials(
+                root_certificates=self.ca_pem))
+
+    async def _unary(self, method: str, payload: bytes) -> bytes:
+        fn = self._channel().unary_unary(
+            _PERMS + method, request_serializer=_identity,
+            response_deserializer=_identity)
+        try:
+            return await fn(payload, metadata=self._metadata())
+        except grpc.RpcError as e:
+            raise _map_rpc_error(e) from e
+
+    async def _stream(self, method: str, payload: bytes) -> list:
+        fn = self._channel().unary_stream(
+            _PERMS + method, request_serializer=_identity,
+            response_deserializer=_identity)
+        out = []
+        try:
+            async for chunk in fn(payload, metadata=self._metadata()):
+                out.append(chunk)
+        except grpc.aio.AioRpcError as e:
+            raise _map_rpc_error(e) from e
+        return out
+
+    # -- verbs --------------------------------------------------------------
+
+    async def check_permission(self, req: CheckRequest) -> CheckResult:
+        payload = await self._unary("CheckPermission",
+                                    wire.enc_check_request(req))
+        return wire.dec_check_response(payload)
+
+    async def check_bulk_permissions(self, reqs: list) -> list:
+        if not reqs:
+            return []
+        payload = await self._unary("CheckBulkPermissions",
+                                    wire.enc_bulk_request(reqs))
+        return wire.dec_bulk_response(payload)
+
+    async def lookup_resources(self, resource_type: str, permission: str,
+                               subject: SubjectRef) -> list:
+        chunks = await self._stream(
+            "LookupResources",
+            wire.enc_lookup_request(resource_type, permission, subject))
+        out = []
+        for c in chunks:
+            rid, ship = wire.dec_lookup_response(c)
+            out.append(rid)
+        return out
+
+    async def read_relationships(self, flt: Optional[RelationshipFilter]) -> list:
+        chunks = await self._stream("ReadRelationships",
+                                    wire.enc_read_request(flt))
+        return [wire.dec_read_response(c) for c in chunks]
+
+    async def write_relationships(self, updates: Iterable[RelationshipUpdate],
+                                  preconditions: Iterable[Precondition] = ()) -> int:
+        payload = await self._unary(
+            "WriteRelationships",
+            wire.enc_write_request(list(updates), list(preconditions)))
+        return wire.dec_write_response(payload)
+
+    async def delete_relationships(self, flt: RelationshipFilter,
+                                   preconditions: Iterable[Precondition] = ()) -> int:
+        payload = await self._unary(
+            "DeleteRelationships",
+            wire.enc_delete_request(flt, list(preconditions)))
+        return wire.dec_delete_response(payload)
+
+    def watch(self, object_types: Optional[Iterable[str]] = None):
+        return _RemoteWatcher(self.target,
+                              list(object_types) if object_types else None,
+                              self._sync_channel)
+
+    async def close(self) -> None:
+        if self._aio_channel is not None:
+            await self._aio_channel.close()
+
+
+# -- server ------------------------------------------------------------------
+
+
+class _BearerInterceptor(grpc.aio.ServerInterceptor):
+    def __init__(self, token: str):
+        self._want = f"Bearer {token}"
+
+        async def deny(ignored_request, context):
+            await context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                                "invalid or missing bearer token")
+
+        self._deny = grpc.unary_unary_rpc_method_handler(
+            deny, request_deserializer=_identity,
+            response_serializer=_identity)
+
+    async def intercept_service(self, continuation, handler_call_details):
+        for k, v in handler_call_details.invocation_metadata or ():
+            if k == "authorization" and v == self._want:
+                return await continuation(handler_call_details)
+        return self._deny
+
+
+class PermissionsGrpcServer:
+    """Serves any PermissionsEndpoint over gRPC (the remote half of the
+    endpoint-plugin seam). With a `jax://` + BatchingEndpoint backend this
+    is a network-shared TPU authorization service: concurrent RPCs from
+    many proxies fuse into device-sized kernel batches server-side."""
+
+    def __init__(self, endpoint: PermissionsEndpoint, token: str = "",
+                 tls_cert: Optional[bytes] = None,
+                 tls_key: Optional[bytes] = None):
+        self.endpoint = endpoint
+        self._token = token
+        self._tls = (tls_cert, tls_key) if tls_cert and tls_key else None
+        self._server: Optional[grpc.aio.Server] = None
+        self.port: Optional[int] = None
+
+    # -- handlers -----------------------------------------------------------
+
+    def _handlers(self) -> dict:
+        ep = self.endpoint
+
+        async def check(request: bytes, context) -> bytes:
+            try:
+                res = await ep.check_permission(wire.dec_check_request(request))
+            except Exception as e:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            return wire.enc_check_response(res)
+
+        async def bulk(request: bytes, context) -> bytes:
+            reqs = wire.dec_bulk_request(request)
+            try:
+                results = await ep.check_bulk_permissions(reqs)
+            except Exception as e:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            rev = max((r.checked_at for r in results), default=0)
+            return wire.enc_bulk_response(rev, results)
+
+        async def lookup(request: bytes, context):
+            rtype, perm, subject = wire.dec_lookup_request(request)
+            try:
+                ids = await ep.lookup_resources(rtype, perm, subject)
+            except Exception as e:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+                return
+            for rid in ids:
+                yield wire.enc_lookup_response(0, rid)
+
+        async def read(request: bytes, context):
+            flt = wire.dec_read_request(request)
+            rels = await ep.read_relationships(flt)
+            for rel in rels:
+                yield wire.enc_read_response(0, rel)
+
+        async def write(request: bytes, context) -> bytes:
+            updates, preconditions = wire.dec_write_request(request)
+            try:
+                rev = await ep.write_relationships(updates, preconditions)
+            except AlreadyExistsError as e:
+                await context.abort(grpc.StatusCode.ALREADY_EXISTS, str(e))
+            except PreconditionFailedError as e:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            except Exception as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return wire.enc_write_response(rev)
+
+        async def delete(request: bytes, context) -> bytes:
+            flt, preconditions = wire.dec_delete_request(request)
+            try:
+                rev = await ep.delete_relationships(flt, preconditions)
+            except PreconditionFailedError as e:
+                await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            except Exception as e:
+                await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return wire.enc_delete_response(rev)
+
+        async def watch(request: bytes, context):
+            object_types = wire.dec_watch_request(request)
+            watcher = self.endpoint.watch(object_types)
+            loop = asyncio.get_running_loop()
+            try:
+                while True:
+                    update = await loop.run_in_executor(None, watcher.poll, 0.5)
+                    if update is None:
+                        if watcher.closed or context.cancelled():
+                            return
+                        continue
+                    yield wire.enc_watch_response(update.revision,
+                                                  list(update.updates))
+            finally:
+                watcher.close()
+
+        u = grpc.unary_unary_rpc_method_handler
+        s = grpc.unary_stream_rpc_method_handler
+        kw = dict(request_deserializer=_identity, response_serializer=_identity)
+        return {
+            _PERMS + "CheckPermission": u(check, **kw),
+            _PERMS + "CheckBulkPermissions": u(bulk, **kw),
+            _PERMS + "LookupResources": s(lookup, **kw),
+            _PERMS + "ReadRelationships": s(read, **kw),
+            _PERMS + "WriteRelationships": u(write, **kw),
+            _PERMS + "DeleteRelationships": u(delete, **kw),
+            _WATCH: s(watch, **kw),
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self, address: str = "127.0.0.1:0") -> int:
+        interceptors = ([_BearerInterceptor(self._token)]
+                        if self._token else [])
+        server = grpc.aio.server(interceptors=interceptors)
+        handlers = self._handlers()
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                return handlers.get(handler_call_details.method)
+
+        server.add_generic_rpc_handlers((_Generic(),))
+        if self._tls:
+            creds = grpc.ssl_server_credentials([(self._tls[1], self._tls[0])])
+            self.port = server.add_secure_port(address, creds)
+        else:
+            self.port = server.add_insecure_port(address)
+        await server.start()
+        self._server = server
+        return self.port
+
+    async def stop(self, grace: float = 1.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None
