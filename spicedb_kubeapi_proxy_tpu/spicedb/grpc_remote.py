@@ -130,6 +130,22 @@ class RemoteEndpoint(PermissionsEndpoint):
         return ([("authorization", f"Bearer {self.token}")]
                 if self.token else [])
 
+    def _root_certs(self) -> Optional[bytes]:
+        """CA bundle for TLS channels. With skip_verify (reference
+        options.go:349-355 `WithInsecureSkipVerify`), gRPC-python offers no
+        direct "don't verify" knob, so we fetch the server's own certificate
+        and pin it as the trust root — accepting whatever cert the server
+        presents, which is the skip-verify semantic for self-signed servers."""
+        if self.ca_pem is not None:
+            return self.ca_pem
+        if not self.skip_verify:
+            return None
+        import ssl
+        host, _, port = self.target.rpartition(":")
+        pem = ssl.get_server_certificate((host or self.target,
+                                          int(port) if port else 443))
+        return pem.encode()
+
     def _channel(self) -> grpc.aio.Channel:
         if self._aio_channel is None:
             with self._lock:
@@ -138,7 +154,7 @@ class RemoteEndpoint(PermissionsEndpoint):
                         self._aio_channel = grpc.aio.insecure_channel(self.target)
                     else:
                         creds = grpc.ssl_channel_credentials(
-                            root_certificates=self.ca_pem)
+                            root_certificates=self._root_certs())
                         self._aio_channel = grpc.aio.secure_channel(
                             self.target, creds)
         return self._aio_channel
@@ -148,7 +164,7 @@ class RemoteEndpoint(PermissionsEndpoint):
             return grpc.insecure_channel(self.target)
         return grpc.secure_channel(
             self.target, grpc.ssl_channel_credentials(
-                root_certificates=self.ca_pem))
+                root_certificates=self._root_certs()))
 
     async def _unary(self, method: str, payload: bytes) -> bytes:
         fn = self._channel().unary_unary(
@@ -230,21 +246,41 @@ class RemoteEndpoint(PermissionsEndpoint):
 
 class _BearerInterceptor(grpc.aio.ServerInterceptor):
     def __init__(self, token: str):
-        self._want = f"Bearer {token}"
+        self._want = f"Bearer {token}".encode()
+
+    def _authed(self, handler_call_details) -> bool:
+        import hmac
+        for k, v in handler_call_details.invocation_metadata or ():
+            if k == "authorization":
+                got = v.encode() if isinstance(v, str) else v
+                if hmac.compare_digest(got, self._want):
+                    return True
+        return False
+
+    async def intercept_service(self, continuation, handler_call_details):
+        handler = await continuation(handler_call_details)
+        if handler is None or self._authed(handler_call_details):
+            return handler
 
         async def deny(ignored_request, context):
             await context.abort(grpc.StatusCode.UNAUTHENTICATED,
                                 "invalid or missing bearer token")
 
-        self._deny = grpc.unary_unary_rpc_method_handler(
+        async def deny_stream(ignored_request, context):
+            await context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                                "invalid or missing bearer token")
+            yield  # pragma: no cover - abort raises before any yield
+
+        # Deny with a handler matching the method's streaming shape so
+        # server-streaming verbs (Watch) get a clean UNAUTHENTICATED
+        # rather than a handler-type mismatch.
+        if handler.response_streaming:
+            return grpc.unary_stream_rpc_method_handler(
+                deny_stream, request_deserializer=_identity,
+                response_serializer=_identity)
+        return grpc.unary_unary_rpc_method_handler(
             deny, request_deserializer=_identity,
             response_serializer=_identity)
-
-    async def intercept_service(self, continuation, handler_call_details):
-        for k, v in handler_call_details.invocation_metadata or ():
-            if k == "authorization" and v == self._want:
-                return await continuation(handler_call_details)
-        return self._deny
 
 
 class PermissionsGrpcServer:

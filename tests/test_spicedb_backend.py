@@ -444,8 +444,9 @@ class TestEmbeddedEndpoint:
     def test_create_endpoint_dispatch(self):
         ep = create_endpoint("embedded://")
         assert isinstance(ep, EmbeddedEndpoint)
-        with pytest.raises(EndpointConfigError, match="grpcio"):
-            create_endpoint("grpc://localhost:50051")
+        from spicedb_kubeapi_proxy_tpu.spicedb.grpc_remote import RemoteEndpoint
+        remote = create_endpoint("grpc://localhost:50051")
+        assert isinstance(remote, RemoteEndpoint)
         with pytest.raises(EndpointConfigError, match="unsupported"):
             create_endpoint("carrier-pigeon://x")
 
