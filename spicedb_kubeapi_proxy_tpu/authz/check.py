@@ -8,8 +8,6 @@ be HAS_PERMISSION.
 
 from __future__ import annotations
 
-import asyncio
-
 from ..rules.engine import ResolveInput
 from ..spicedb.endpoints import PermissionsEndpoint
 from ..spicedb.types import CheckRequest, ObjectRef, SubjectRef
@@ -43,17 +41,14 @@ async def check_relationships(endpoint: PermissionsEndpoint, resolved_rels: list
 
 async def _run_exprs(endpoint: PermissionsEndpoint, rules_list: list,
                      input: ResolveInput, attr: str, check_type: str) -> None:
-    async def one(expr):
-        resolved = expr.generate_relationships(input)
-        await check_relationships(endpoint, resolved, check_type)
-
-    tasks = [one(c) for r in rules_list for c in getattr(r, attr)]
-    if not tasks:
-        return
-    results = await asyncio.gather(*tasks, return_exceptions=True)
-    for res in results:
-        if isinstance(res, BaseException):
-            raise res
+    # All templates across all matched rules resolve first, then fold into
+    # ONE CheckBulkPermissions call for the whole request (reference
+    # check.go:23-48 collects every checkRel before the single bulk RPC).
+    resolved = [rel
+                for r in rules_list
+                for expr in getattr(r, attr)
+                for rel in expr.generate_relationships(input)]
+    await check_relationships(endpoint, resolved, check_type)
 
 
 async def run_all_matching_checks(endpoint: PermissionsEndpoint,

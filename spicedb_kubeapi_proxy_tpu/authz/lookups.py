@@ -72,16 +72,18 @@ def extract_namespaced_name(filter: ResolvedPreFilter, input: ResolveInput,
 async def run_lookup_resources(endpoint: PermissionsEndpoint,
                                filter: ResolvedPreFilter,
                                input: ResolveInput) -> PrefilterResult:
-    """LR + per-result extraction (reference lookups.go:43-136)."""
+    """LR + per-result extraction (reference lookups.go:43-136).
+
+    Drains the endpoint's id stream incrementally so NamespacedName
+    extraction overlaps the remaining transfer (reference drains the gRPC
+    server-stream the same way, lookups.go:74-135)."""
     if filter.rel.resource_id != MATCHING_ID_FIELD_VALUE:
         raise PreFilterError("preFilter called with non-$ resource ID")
-    ids = await endpoint.lookup_resources(
-        filter.rel.resource_type,
-        filter.rel.resource_relation,
-        SubjectRef(filter.rel.subject_type, filter.rel.subject_id,
-                   filter.rel.subject_relation),
-    )
     result = PrefilterResult()
-    for rid in ids:
+    async for rid in endpoint.lookup_resources_stream(
+            filter.rel.resource_type,
+            filter.rel.resource_relation,
+            SubjectRef(filter.rel.subject_type, filter.rel.subject_id,
+                       filter.rel.subject_relation)):
         result.allowed.add(extract_namespaced_name(filter, input, rid))
     return result

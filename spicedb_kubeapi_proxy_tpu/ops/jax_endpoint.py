@@ -70,6 +70,15 @@ from .spmv import KernelCache, bucket, pad_edges
 _MIN_EDGE_BUCKET = 256
 _MIN_BATCH_BUCKET = 8
 
+# One synthetic zero-tuple subject per type is compiled into every graph:
+# a subject that appears in no tuple can differ from any other zero-tuple
+# subject of its type only through wildcard terms, which key on the subject
+# TYPE — so every unknown query subject maps onto its type's phantom column
+# instead of falling back to the recursive host oracle (the round-1
+# "oracle cliff": multi-second LR per first-contact user).  The id contains
+# NUL, which can never appear in a stored relationship id.
+PHANTOM_ID = "\x00__phantom__"
+
 
 def _rel_from_key(key: tuple) -> Relationship:
     """Reconstruct the identity fields of a relationship from its key
@@ -307,12 +316,65 @@ class _EllGraph:
                                   self.dev_main, self.dev_aux)
 
 
+class _ShardedEllGraph(_EllGraph):
+    """Multi-chip ELL graph: same positionless host tables and tree-walk
+    delta edits as _EllGraph, but the device tables are row-sharded over a
+    2D (data x graph) mesh and queries run through
+    parallel.sharding.ShardedEllKernel (word-sharded batch x row-sharded
+    one-step closure with per-iteration all_gather over ICI).  This puts
+    the sharded kernels behind the same endpoint drain/lock machinery as
+    the single-chip path (SURVEY.md §7 step 7); the reference counterpart
+    is SpiceDB's internal dispatch distribution
+    (reference pkg/spicedb/spicedb.go:31-47)."""
+
+    def __init__(self, prog: GraphProgram, edge_endpoints, mesh,
+                 num_iters: Optional[int] = None):
+        from ..parallel.sharding import ShardedEllKernel
+        from .ell import build_tables as _build
+
+        self.prog = prog
+        self._edge_endpoints = edge_endpoints
+        t = _build(prog)
+        self.host_main = t.idx_main
+        self.host_aux = t.idx_aux
+        self.kernel = ShardedEllKernel(prog, mesh, num_iters=num_iters,
+                                       tables=t)
+        self._dirty_main: set = set()
+        self._dirty_aux: set = set()
+
+    def flush(self) -> bool:
+        changed = False
+        if self._dirty_main:
+            rows = np.asarray(sorted(self._dirty_main), np.int32)
+            self.kernel.update_main_rows(rows, self.host_main[rows])
+            self._dirty_main = set()
+            changed = True
+        if self._dirty_aux:
+            rows = np.asarray(sorted(self._dirty_aux), np.int32)
+            self.kernel.update_aux_rows(rows, self.host_aux[rows])
+            self._dirty_aux = set()
+            changed = True
+        return changed
+
+    def batch_bucket(self, n: int) -> int:
+        return self.kernel.padded_batch_words(n) * 32
+
+    def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+        return self.kernel.checks(np.asarray(q_arr, np.int32),
+                                  np.asarray(gather_idx, np.int32),
+                                  np.asarray(gather_col, np.int64))
+
+    def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
+        return self.kernel.lookup(offset, length, np.asarray(q_arr, np.int32))
+
+
 _GRAPH_KINDS = {"ell": _EllGraph, "segment": _SegmentGraph}
 
 
 class JaxEndpoint(PermissionsEndpoint):
     def __init__(self, schema: sch.Schema, store: Optional[TupleStore] = None,
-                 num_iters: Optional[int] = None, kernel: Optional[str] = None):
+                 num_iters: Optional[int] = None, kernel: Optional[str] = None,
+                 mesh=None):
         self.schema = schema
         self.store = store if store is not None else TupleStore()
         # oracle fallback for query endpoints outside the compiled universe
@@ -322,6 +384,9 @@ class JaxEndpoint(PermissionsEndpoint):
         if kind not in _GRAPH_KINDS:
             raise ValueError(f"unknown kernel {kind!r}; "
                              f"expected one of {sorted(_GRAPH_KINDS)}")
+        if mesh is not None and kind != "ell":
+            raise ValueError("mesh sharding requires the ell kernel")
+        self.mesh = mesh
         self.kernel_kind = kind
         self._graph_cls = _GRAPH_KINDS[kind]
         self._lock = threading.RLock()
@@ -405,14 +470,23 @@ class JaxEndpoint(PermissionsEndpoint):
             out.append((asrc, adst))
         return out
 
+    def _make_graph(self, prog: GraphProgram):
+        if self.mesh is not None:
+            return _ShardedEllGraph(prog, self._edge_endpoints, self.mesh,
+                                    num_iters=self._num_iters)
+        return self._graph_cls(prog, self._edge_endpoints,
+                               num_iters=self._num_iters)
+
     def _rebuild(self) -> None:
         # a rebuild reflects the current store snapshot; any queued deltas
         # are subsumed by it
         self._drain_pending()
         self._graph_invalid = False
         extra = {t: set(ids) for t, ids in self._known_extra_subjects.items()}
+        for t in self.schema.definitions:
+            extra.setdefault(t, set()).add(PHANTOM_ID)
         view = self.store.columnar_view() if self._graph_cls is _EllGraph \
-            else None
+            or self.mesh is not None else None
         if view is not None:
             # vectorized compile straight off the store's columnar base —
             # no per-tuple object materialization (the ELL graph is
@@ -420,14 +494,12 @@ class JaxEndpoint(PermissionsEndpoint):
             snap, rows, overlay = view
             prog = compile_graph_columnar(self.schema, snap, rows, overlay,
                                           extra_subject_ids=extra)
-            graph = self._graph_cls(prog, self._edge_endpoints,
-                                    num_iters=self._num_iters)
+            graph = self._make_graph(prog)
             self._reset_expiry_columnar(snap, rows, overlay)
         else:
             tuples = self.store.read(None)
             prog = compile_graph(self.schema, tuples, extra_subject_ids=extra)
-            graph = self._graph_cls(prog, self._edge_endpoints,
-                                    num_iters=self._num_iters)
+            graph = self._make_graph(prog)
             graph.index_tuples(tuples)
             self._reset_expiry(tuples)
         self._graph = graph
@@ -537,16 +609,31 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def _encode_subjects(self, graph, subjects: list) -> tuple:
         """Dedupe subjects into query columns; returns (q_idx array,
-        col_of_subject dict, unknown set)."""
+        col_of_subject dict, unknown set).  Subjects outside the compiled
+        id universe share their type's phantom column (zero tuples ⇒ only
+        wildcard terms can grant, and those key on the type); `unknown` is
+        left only for subjects whose (type, relation) has no slot at all —
+        schema errors the oracle reproduces exactly."""
         cols: dict = {}
         q: list[int] = []
         unknown: set = set()
+        phantom_cols: dict = {}  # (type, relation) -> column
         for s in subjects:
             if s in cols or s in unknown:
                 continue
             idx = graph.prog.subject_index(s.type, s.id, s.relation)
             if idx is None:
-                unknown.add(s)
+                pk = (s.type, s.relation)
+                col = phantom_cols.get(pk)
+                if col is not None:
+                    cols[s] = col
+                    continue
+                pidx = graph.prog.subject_index(s.type, PHANTOM_ID, s.relation)
+                if pidx is None:
+                    unknown.add(s)
+                    continue
+                phantom_cols[pk] = cols[s] = len(q)
+                q.append(pidx)
                 continue
             cols[s] = len(q)
             q.append(idx)
@@ -626,11 +713,30 @@ class JaxEndpoint(PermissionsEndpoint):
             bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
             self.stats["kernel_calls"] += 1
             ids = graph.prog.object_ids[resource_type]
-        return [ids[i] for i in np.nonzero(bitmap[:, col])[0]]
+            # the phantom is part of every type's universe; never emit it
+            ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
+        return [ids[i] for i in np.nonzero(bitmap[:, col])[0] if i != ph]
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
         return self._lookup_sync(resource_type, permission, subject)
+
+    async def lookup_resources_stream(self, resource_type: str,
+                                      permission: str, subject: SubjectRef):
+        """Chunked id stream: the kernel runs off-loop (the event loop stays
+        responsive during device execution) and the id list yields in chunks
+        so consumers' per-id extraction interleaves with other work — the
+        device analog of draining the reference's LR server-stream
+        (lookups.go:74-135)."""
+        import asyncio
+        loop = asyncio.get_running_loop()
+        ids = await loop.run_in_executor(None, self._lookup_sync,
+                                         resource_type, permission, subject)
+        chunk = 4096
+        for i in range(0, len(ids), chunk):
+            for rid in ids[i: i + chunk]:
+                yield rid
+            await asyncio.sleep(0)
 
     def _lookup_batch_sync(self, resource_type: str, permission: str,
                            subjects: list) -> list:
@@ -645,18 +751,25 @@ class JaxEndpoint(PermissionsEndpoint):
             bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
             self.stats["kernel_calls"] += 1
             ids = graph.prog.object_ids[resource_type]
+            ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
             # one pass over the transposed bitmap groups allowed object
             # indices by query column (vs a nonzero() per subject)
             by_col, obj = np.nonzero(np.ascontiguousarray(bitmap.T))
             splits = np.searchsorted(by_col, np.arange(1, len(cols) + 1))
             per_col = np.split(obj, splits[:-1]) if len(cols) else []
+            per_col_ids: dict = {}  # column -> id list (columns are shared)
             out = []
             for s in subjects:
                 if s in unknown:
                     out.append(self._oracle.lookup_resources(
                         resource_type, permission, s))
-                else:
-                    out.append([ids[i] for i in per_col[cols[s]]])
+                    continue
+                col = cols[s]
+                lst = per_col_ids.get(col)
+                if lst is None:
+                    lst = per_col_ids[col] = \
+                        [ids[i] for i in per_col[col] if i != ph]
+                out.append(lst)
         return out
 
     async def lookup_resources_batch(self, resource_type: str, permission: str,

@@ -171,13 +171,13 @@ class ShardedEllKernel:
     """
 
     def __init__(self, prog: GraphProgram, mesh: Mesh,
-                 num_iters: Optional[int] = None):
+                 num_iters: Optional[int] = None, tables=None):
         from ..ops.ell import K_AUX, K_MAIN, build_tables
         from ..ops.ell import MAX_ITERATIONS as ELL_MAX
 
         self.prog = prog
         self.mesh = mesh
-        t = build_tables(prog)
+        t = tables if tables is not None else build_tables(prog)
         n = prog.state_size
         dead = prog.dead_index
         n_graph = mesh.shape["graph"]
@@ -194,10 +194,35 @@ class ShardedEllKernel:
             aux[aux >= n] += self.n_pad - n
         base = num_iters or ELL_MAX
         self.num_iters = base * (1 + t.tree_depth)
-        row_spec = NamedSharding(mesh, P("graph", None))
-        self.idx_main = jax.device_put(main, row_spec)
-        self.idx_aux = jax.device_put(aux, row_spec)
+        self._row_spec = NamedSharding(mesh, P("graph", None))
+        self.idx_main = jax.device_put(main, self._row_spec)
+        self.idx_aux = jax.device_put(aux, self._row_spec)
         self._jits: dict = {}
+
+    # -- incremental row updates ---------------------------------------------
+
+    def remap_values(self, vals: np.ndarray) -> np.ndarray:
+        """Shift aux references for the padded main block (host tables are
+        unpadded; device tables pad main rows to a multiple of n_graph)."""
+        n = self.prog.state_size
+        if self.n_pad != n:
+            vals = vals.copy()
+            vals[vals >= n] += self.n_pad - n
+        return vals
+
+    def _scatter_rows(self, arr, rows: np.ndarray, vals: np.ndarray):
+        out = arr.at[jnp.asarray(rows)].set(jnp.asarray(vals))
+        # keep the row sharding stable regardless of what the scatter's
+        # output sharding propagation decided
+        return jax.device_put(out, self._row_spec)
+
+    def update_main_rows(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        self.idx_main = self._scatter_rows(self.idx_main, rows,
+                                           self.remap_values(vals))
+
+    def update_aux_rows(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        self.idx_aux = self._scatter_rows(self.idx_aux, rows,
+                                          self.remap_values(vals))
 
     # -- the sharded program -------------------------------------------------
 
@@ -299,13 +324,20 @@ class ShardedEllKernel:
 
     # -- host-facing ---------------------------------------------------------
 
-    def _pad_q(self, q_idx: np.ndarray) -> np.ndarray:
+    def padded_batch_words(self, batch: int) -> int:
+        """uint32 word count for a `batch`-column query: a multiple of the
+        data-axis size so every chip gets whole words.  The single source of
+        the padding formula (the endpoint's batch_bucket calls this too)."""
         from ..ops.ell import batch_words
 
         n_data = self.mesh.shape["data"]
-        w = batch_words(len(q_idx), minimum=n_data)
+        w = batch_words(batch, minimum=n_data)
         if w % n_data:
             w += n_data - (w % n_data)
+        return w
+
+    def _pad_q(self, q_idx: np.ndarray) -> np.ndarray:
+        w = self.padded_batch_words(len(q_idx))
         out = np.full(w * 32, self.prog.dead_index, np.int32)
         out[: len(q_idx)] = q_idx
         return out

@@ -56,8 +56,25 @@ class PermissionsEndpoint:
         return [await self.lookup_resources(resource_type, permission, s)
                 for s in subjects]
 
+    async def lookup_resources_stream(self, resource_type: str,
+                                      permission: str, subject: SubjectRef):
+        """Async iterator of allowed resource ids (the reference drains the
+        LookupResources gRPC server-stream incrementally, lookups.go:74-135,
+        so per-result extraction overlaps transfer).  Default: wrap the
+        materialized list; `grpc://` overrides with the real stream and
+        `jax://` yields device->host chunks."""
+        for rid in await self.lookup_resources(resource_type, permission,
+                                               subject):
+            yield rid
+
     async def read_relationships(self, flt: RelationshipFilter) -> list:
         raise NotImplementedError
+
+    async def read_relationships_stream(self, flt: RelationshipFilter):
+        """Async iterator of relationships (reference activity.go:160-172
+        drains a server-stream).  Default wraps the materialized list."""
+        for rel in await self.read_relationships(flt):
+            yield rel
 
     async def write_relationships(self, updates: Iterable[RelationshipUpdate],
                                   preconditions: Iterable[Precondition] = ()) -> int:
@@ -228,6 +245,26 @@ def create_endpoint(url: str,
         return EmbeddedEndpoint.from_bootstrap(bootstrap)
     if scheme == "jax":
         from ..ops.jax_endpoint import JaxEndpoint  # lazy: pulls in jax
+        # multi-chip: `jax://?mesh=auto` shards the graph over all local
+        # devices (2D data x graph mesh); `mesh=DxG` fixes the axis split.
+        # Single-device processes fall back to the single-chip kernels.
+        mesh_param = (params.get("mesh") or [""])[0]
+        if mesh_param and "mesh" not in kwargs:
+            import jax
+
+            from ..parallel.sharding import make_mesh
+            if mesh_param == "auto":
+                if len(jax.devices()) > 1:
+                    kwargs["mesh"] = make_mesh()
+            else:
+                try:
+                    data_s, _, graph_s = mesh_param.partition("x")
+                    kwargs["mesh"] = make_mesh(data=int(data_s),
+                                               graph=int(graph_s))
+                except ValueError as e:
+                    raise EndpointConfigError(
+                        f"invalid mesh {mesh_param!r} in {url!r}: {e}"
+                    ) from e
         ep: PermissionsEndpoint = JaxEndpoint.from_bootstrap(bootstrap,
                                                              **kwargs)
         # cross-request batched dispatch is on by default for the device

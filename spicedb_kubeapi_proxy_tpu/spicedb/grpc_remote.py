@@ -121,6 +121,7 @@ class RemoteEndpoint(PermissionsEndpoint):
         self.insecure = insecure
         self.ca_pem = ca_pem
         self.skip_verify = skip_verify
+        self._pinned: Optional[tuple] = None  # (pem, channel options) cache
         self._aio_channel: Optional[grpc.aio.Channel] = None
         self._lock = threading.Lock()
 
@@ -130,21 +131,47 @@ class RemoteEndpoint(PermissionsEndpoint):
         return ([("authorization", f"Bearer {self.token}")]
                 if self.token else [])
 
-    def _root_certs(self) -> Optional[bytes]:
-        """CA bundle for TLS channels. With skip_verify (reference
-        options.go:349-355 `WithInsecureSkipVerify`), gRPC-python offers no
-        direct "don't verify" knob, so we fetch the server's own certificate
-        and pin it as the trust root — accepting whatever cert the server
-        presents, which is the skip-verify semantic for self-signed servers."""
-        if self.ca_pem is not None:
-            return self.ca_pem
-        if not self.skip_verify:
-            return None
-        import ssl
-        host, _, port = self.target.rpartition(":")
-        pem = ssl.get_server_certificate((host or self.target,
-                                          int(port) if port else 443))
-        return pem.encode()
+    def _pin_server_cert(self) -> tuple:
+        """skip_verify support (reference options.go:349-355
+        `WithInsecureSkipVerify`): gRPC-python has no "don't verify" knob,
+        so fetch the server's own certificate once (bounded 10s timeout,
+        cached), pin it as the trust root, and override the TLS target name
+        with the certificate's own subject so hostname verification passes
+        for IP dials / SAN mismatches.  Returns (pem bytes, channel options).
+        """
+        if self._pinned is None:
+            import ssl
+            import tempfile
+            host, _, port = self.target.rpartition(":")
+            if not port.isdigit():
+                host, port = self.target, "443"
+            pem = ssl.get_server_certificate((host, int(port)), timeout=10.0)
+            options = []
+            try:
+                with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
+                    f.write(pem)
+                    f.flush()
+                    decoded = ssl._ssl._test_decode_cert(f.name)
+                names = [v for k, v in decoded.get("subjectAltName", ())
+                         if k == "DNS"]
+                for field in decoded.get("subject", ()):
+                    for k, v in field:
+                        if k == "commonName":
+                            names.append(v)
+                if names and names[0] != host:
+                    options = [("grpc.ssl_target_name_override", names[0])]
+            except Exception:
+                pass  # no name override; pinning alone may still suffice
+            self._pinned = (pem.encode(), options)
+        return self._pinned
+
+    def _creds(self) -> tuple:
+        """(channel credentials, channel options) for TLS channels."""
+        if self.ca_pem is not None or not self.skip_verify:
+            return grpc.ssl_channel_credentials(
+                root_certificates=self.ca_pem), []
+        pem, options = self._pin_server_cert()
+        return grpc.ssl_channel_credentials(root_certificates=pem), options
 
     def _channel(self) -> grpc.aio.Channel:
         if self._aio_channel is None:
@@ -153,18 +180,16 @@ class RemoteEndpoint(PermissionsEndpoint):
                     if self.insecure:
                         self._aio_channel = grpc.aio.insecure_channel(self.target)
                     else:
-                        creds = grpc.ssl_channel_credentials(
-                            root_certificates=self._root_certs())
+                        creds, options = self._creds()
                         self._aio_channel = grpc.aio.secure_channel(
-                            self.target, creds)
+                            self.target, creds, options=options)
         return self._aio_channel
 
     def _sync_channel(self):
         if self.insecure:
             return grpc.insecure_channel(self.target)
-        return grpc.secure_channel(
-            self.target, grpc.ssl_channel_credentials(
-                root_certificates=self._root_certs()))
+        creds, options = self._creds()
+        return grpc.secure_channel(self.target, creds, options=options)
 
     async def _unary(self, method: str, payload: bytes) -> bytes:
         fn = self._channel().unary_unary(
@@ -175,17 +200,16 @@ class RemoteEndpoint(PermissionsEndpoint):
         except grpc.RpcError as e:
             raise _map_rpc_error(e) from e
 
-    async def _stream(self, method: str, payload: bytes) -> list:
+    async def _unary_stream(self, method: str, payload: bytes):
+        """Open a server-stream and yield raw frames as they arrive."""
         fn = self._channel().unary_stream(
             _PERMS + method, request_serializer=_identity,
             response_deserializer=_identity)
-        out = []
         try:
             async for chunk in fn(payload, metadata=self._metadata()):
-                out.append(chunk)
-        except grpc.aio.AioRpcError as e:
+                yield chunk
+        except grpc.RpcError as e:
             raise _map_rpc_error(e) from e
-        return out
 
     # -- verbs --------------------------------------------------------------
 
@@ -203,19 +227,25 @@ class RemoteEndpoint(PermissionsEndpoint):
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
-        chunks = await self._stream(
-            "LookupResources",
-            wire.enc_lookup_request(resource_type, permission, subject))
-        out = []
-        for c in chunks:
-            rid, ship = wire.dec_lookup_response(c)
-            out.append(rid)
-        return out
+        return [rid async for rid in self.lookup_resources_stream(
+            resource_type, permission, subject)]
+
+    async def lookup_resources_stream(self, resource_type: str,
+                                      permission: str, subject: SubjectRef):
+        """True incremental drain of the LookupResources server-stream
+        (reference lookups.go:74-135): ids yield as frames arrive."""
+        payload = wire.enc_lookup_request(resource_type, permission, subject)
+        async for chunk in self._unary_stream("LookupResources", payload):
+            rid, ship = wire.dec_lookup_response(chunk)
+            yield rid
 
     async def read_relationships(self, flt: Optional[RelationshipFilter]) -> list:
-        chunks = await self._stream("ReadRelationships",
-                                    wire.enc_read_request(flt))
-        return [wire.dec_read_response(c) for c in chunks]
+        return [rel async for rel in self.read_relationships_stream(flt)]
+
+    async def read_relationships_stream(self, flt: Optional[RelationshipFilter]):
+        async for chunk in self._unary_stream("ReadRelationships",
+                                              wire.enc_read_request(flt)):
+            yield wire.dec_read_response(chunk)
 
     async def write_relationships(self, updates: Iterable[RelationshipUpdate],
                                   preconditions: Iterable[Precondition] = ()) -> int:

@@ -465,3 +465,74 @@ class TestHubTreeSplit:
         jx, oracle = make_pair(GROUPS_SCHEMA, rels)
         assert_agreement(jx, oracle, "namespace", "view",
                          users("u3", "v59", "nobody"))
+
+
+class TestPhantomSubjects:
+    """Subjects outside the compiled universe map onto their type's phantom
+    column (zero tuples ⇒ only wildcard terms can grant), so first-contact
+    users never fall back to the recursive host oracle — the round-1 cliff."""
+
+    class _NoOracle:
+        def check(self, *a, **k):
+            raise AssertionError("oracle fallback used for in-schema subject")
+
+        def lookup_resources(self, *a, **k):
+            raise AssertionError("oracle fallback used for in-schema subject")
+
+    def test_unknown_subjects_stay_on_kernel(self):
+        jx, oracle = make_pair(WILDCARD_SCHEMA, [
+            "doc:readme#viewer@user:*",
+            "doc:secret#editor@user:alice",
+        ])
+        # answers must match the oracle...
+        assert_agreement(jx, oracle, "doc", "view",
+                         users("stranger1", "stranger2"))
+        # ...and must come from the kernel, not the recursive fallback
+        jx._oracle = self._NoOracle()
+        assert_agreement(jx, oracle, "doc", "view",
+                         users("stranger3", "stranger4"))
+
+    def test_unknown_userset_subjects(self):
+        jx, oracle = make_pair(GROUPS_SCHEMA, [
+            "namespace:ns#viewer@group:eng#member",
+            "group:eng#member@user:alice",
+        ])
+        jx._oracle = self._NoOracle()
+        async def run():
+            # unknown group userset: no members, wildcards don't apply
+            got = await jx.lookup_resources(
+                "namespace", "view", SubjectRef("group", "ghosts", "member"))
+            assert got == []
+            res = await jx.check_permission(CheckRequest(
+                ObjectRef("namespace", "ns"), "view",
+                SubjectRef("group", "ghosts", "member")))
+            assert not res.allowed
+        asyncio.run(run())
+
+    def test_phantom_never_leaks_from_lookup(self):
+        # subject relation on the SAME type as the listed resource: the
+        # phantom's own relation slot goes live, but the phantom id must
+        # never appear in LookupResources output
+        jx, oracle = make_pair(GROUPS_SCHEMA, [
+            "namespace:ns#viewer@user:alice",
+        ])
+        async def run():
+            got = await jx.lookup_resources(
+                "namespace", "view", SubjectRef("namespace", "nope", "viewer"))
+            assert got == []
+            batch = await jx.lookup_resources_batch(
+                "namespace", "view",
+                [SubjectRef("namespace", "nope", "viewer"),
+                 SubjectRef("user", "alice")])
+            assert batch[0] == []
+            assert batch[1] == ["ns"]
+        asyncio.run(run())
+
+    def test_batch_shares_phantom_column(self):
+        jx, oracle = make_pair(WILDCARD_SCHEMA, ["doc:d#viewer@user:*"])
+        jx._oracle = self._NoOracle()
+        async def run():
+            subs = [SubjectRef("user", f"stranger{i}") for i in range(40)]
+            out = await jx.lookup_resources_batch("doc", "view", subs)
+            assert all(x == ["d"] for x in out)
+        asyncio.run(run())

@@ -1,0 +1,190 @@
+"""The sharded kernels behind the `jax://` endpoint (SURVEY.md §7 step 7).
+
+Round-1 left the sharded kernels reachable only from raw tests; these
+scenarios drive them through the full JaxEndpoint machinery — create_endpoint
+URL parsing, the delta drain/lock path, expiration, and the phantom-subject
+column — on the virtual 8-device CPU mesh (conftest.py).  Counterpart of the
+reference's dispatch-distributed graph walk (pkg/spicedb/spicedb.go:31-47).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import (JaxEndpoint,
+                                                        _ShardedEllGraph)
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (Bootstrap,
+                                                         EndpointConfigError,
+                                                         create_endpoint)
+from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    Relationship,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = """
+definition user {}
+definition group {
+  relation member: user | group#member
+}
+definition namespace {
+  relation viewer: user | group#member | user:*
+  relation creator: user
+  permission view = viewer + creator
+}
+"""
+
+
+def touch(*rels):
+    return [RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(r))
+            for r in rels]
+
+
+def delete(*rels):
+    return [RelationshipUpdate(UpdateOp.DELETE, parse_relationship(r))
+            for r in rels]
+
+
+def make_sharded(rels, mesh="2x4"):
+    ep = create_endpoint(f"jax://?mesh={mesh}&dispatch=direct",
+                         Bootstrap(schema_text=SCHEMA))
+    if rels:
+        ep.store.write(touch(*rels))
+    oracle = Evaluator(ep.schema, ep.store)
+    return ep, oracle
+
+
+def assert_agreement(ep, oracle, subjects, resource_type="namespace",
+                     permission="view"):
+    ids = ep.store.object_ids_of_type(resource_type)
+
+    async def run():
+        for s in subjects:
+            want = sorted(oracle.lookup_resources(resource_type, permission, s))
+            got = sorted(await ep.lookup_resources(resource_type, permission, s))
+            assert got == want, f"LR mismatch for {s}: {got} != {want}"
+            reqs = [CheckRequest(ObjectRef(resource_type, oid), permission, s)
+                    for oid in ids]
+            if reqs:
+                results = await ep.check_bulk_permissions(reqs)
+                for oid, res in zip(ids, results):
+                    want_one = oracle.check(ObjectRef(resource_type, oid),
+                                            permission, s)
+                    assert res.allowed == want_one, (
+                        f"check mismatch {oid}@{s}")
+    asyncio.run(run())
+
+
+def users(*names):
+    return [SubjectRef("user", n) for n in names]
+
+
+class TestShardedEndpoint:
+    def test_mesh_url_selects_sharded_graph(self):
+        ep, _ = make_sharded(["namespace:ns#viewer@user:alice"])
+        asyncio.run(ep.lookup_resources("namespace", "view",
+                                        SubjectRef("user", "alice")))
+        assert isinstance(ep._graph, _ShardedEllGraph)
+        assert ep.mesh.shape == {"data": 2, "graph": 4}
+
+    def test_mesh_auto_uses_all_devices(self):
+        ep = create_endpoint("jax://?mesh=auto&dispatch=direct",
+                             Bootstrap(schema_text=SCHEMA))
+        assert ep.mesh is not None and ep.mesh.size == 8
+
+    def test_invalid_mesh_rejected(self):
+        with pytest.raises(EndpointConfigError, match="mesh"):
+            create_endpoint("jax://?mesh=banana", Bootstrap(schema_text=SCHEMA))
+        with pytest.raises(ValueError, match="mesh"):
+            create_endpoint("jax://?mesh=3x3", Bootstrap(schema_text=SCHEMA))
+
+    def test_basic_agreement(self):
+        ep, oracle = make_sharded([
+            "group:eng#member@user:alice",
+            "group:ops#member@group:eng#member",
+            "namespace:ns1#viewer@group:ops#member",
+            "namespace:ns2#creator@user:bob",
+            "namespace:ns3#viewer@user:*",
+        ])
+        assert_agreement(ep, oracle,
+                         users("alice", "bob", "stranger"))
+
+    def test_incremental_deltas_on_sharded_tables(self):
+        ep, oracle = make_sharded([
+            "namespace:ns1#viewer@user:alice",
+            "namespace:ns2#viewer@user:bob",
+        ])
+        assert_agreement(ep, oracle, users("alice", "bob"))
+        rebuilds = ep.stats["rebuilds"]
+        # in-universe edits ride the incremental row-update path
+        ep.store.write(touch("namespace:ns1#viewer@user:bob"))
+        ep.store.write(delete("namespace:ns2#viewer@user:bob"))
+        assert_agreement(ep, oracle, users("alice", "bob"))
+        assert ep.stats["rebuilds"] == rebuilds
+        assert ep.stats["delta_batches"] > 0
+        # new object id forces a rebuild, sharded again
+        ep.store.write(touch("namespace:brand-new#viewer@user:alice"))
+        assert_agreement(ep, oracle, users("alice", "bob"))
+        assert isinstance(ep._graph, _ShardedEllGraph)
+        assert ep.stats["rebuilds"] == rebuilds + 1
+
+    def test_hub_tree_deltas_sharded(self):
+        rels = [f"group:eng#member@user:u{i}" for i in range(120)]
+        rels += ["namespace:ns#viewer@group:eng#member"]
+        ep, oracle = make_sharded(rels)
+        assert_agreement(ep, oracle, users("u0", "u77", "u119"))
+        rebuilds = ep.stats["rebuilds"]
+        ep.store.write(delete("group:eng#member@user:u77"))
+        assert_agreement(ep, oracle, users("u0", "u77", "u119"))
+        assert ep.stats["rebuilds"] == rebuilds
+
+    def test_expiration_on_sharded_path(self):
+        ep, oracle = make_sharded([])
+        ep.store.write([RelationshipUpdate(UpdateOp.TOUCH, Relationship(
+            resource=ObjectRef("namespace", "ns"), relation="viewer",
+            subject=SubjectRef("user", "alice"),
+            expires_at=time.time() + 0.3))])
+        ep.store.write(touch("namespace:ns#viewer@user:bob"))
+        assert_agreement(ep, oracle, users("alice", "bob"))
+        time.sleep(0.35)
+        got = asyncio.run(ep.lookup_resources("namespace", "view",
+                                              SubjectRef("user", "alice")))
+        assert got == []
+        assert_agreement(ep, oracle, users("alice", "bob"))
+
+    def test_phantom_subjects_sharded(self):
+        ep, oracle = make_sharded([
+            "namespace:open#viewer@user:*",
+            "namespace:closed#viewer@user:alice",
+        ])
+
+        class _NoOracle:
+            def __getattr__(self, name):
+                raise AssertionError("oracle fallback on sharded path")
+
+        ep._oracle = _NoOracle()
+
+        async def run():
+            subs = [SubjectRef("user", f"new{i}") for i in range(50)]
+            out = await ep.lookup_resources_batch("namespace", "view", subs)
+            assert all(x == ["open"] for x in out)
+        asyncio.run(run())
+
+    def test_large_batch_spans_data_axis(self):
+        rels = [f"namespace:ns{i % 7}#viewer@user:u{i}" for i in range(300)]
+        ep, oracle = make_sharded(rels)
+        subs = [SubjectRef("user", f"u{i}") for i in range(300)]
+
+        async def run():
+            got = await ep.lookup_resources_batch("namespace", "view", subs)
+            for s, g in zip(subs, got):
+                assert sorted(g) == sorted(oracle.lookup_resources(
+                    "namespace", "view", s))
+        asyncio.run(run())

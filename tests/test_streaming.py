@@ -1,0 +1,176 @@
+"""Bulk-check folding + streaming verbs (reference check.go:23-48,
+lookups.go:74-135, activity.go:160-172).
+
+- ALL check templates for a request fold into ONE CheckBulkPermissions call
+  (round-1 issued one bulk RPC per check-expr).
+- lookup_resources_stream / read_relationships_stream yield incrementally;
+  the prefilter drains the stream so extraction overlaps transfer.
+"""
+
+import asyncio
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.authz.check import (UnauthorizedError,
+                                                   run_all_matching_checks)
+from spicedb_kubeapi_proxy_tpu.authz.lookups import run_lookup_resources
+from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+from spicedb_kubeapi_proxy_tpu.rules.engine import (ResolvedPreFilter,
+                                                    compile_template_expression)
+from spicedb_kubeapi_proxy_tpu.rules.relstring import ResolvedRel
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+
+def rrel(s: str) -> ResolvedRel:
+    """`type:id#rel@stype:sid` -> ResolvedRel (literal templates)."""
+    res, _, sub = s.partition("@")
+    rt, _, rest = res.partition(":")
+    rid, _, rrl = rest.partition("#")
+    st, _, sid = sub.partition(":")
+    return ResolvedRel(resource_type=rt, resource_id=rid,
+                       resource_relation=rrl, subject_type=st, subject_id=sid)
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation viewer: user
+  permission view = viewer
+}
+definition pod {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+
+
+class CountingEndpoint(EmbeddedEndpoint):
+    def __init__(self, schema):
+        super().__init__(schema)
+        self.bulk_calls = 0
+        self.lr_calls = 0
+        self.stream_calls = 0
+
+    async def check_bulk_permissions(self, reqs):
+        self.bulk_calls += 1
+        return await super().check_bulk_permissions(reqs)
+
+    async def lookup_resources(self, resource_type, permission, subject):
+        self.lr_calls += 1
+        return await super().lookup_resources(resource_type, permission,
+                                              subject)
+
+    async def lookup_resources_stream(self, resource_type, permission,
+                                      subject):
+        self.stream_calls += 1
+        async for rid in super().lookup_resources_stream(
+                resource_type, permission, subject):
+            yield rid
+
+
+def make_counting(rels):
+    ep = CountingEndpoint(sch.parse_schema(SCHEMA))
+    ep.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(r))
+                    for r in rels])
+    return ep
+
+
+class _FakeExpr:
+    def __init__(self, *rels):
+        self._rels = [rrel(r) for r in rels]
+
+    def generate_relationships(self, input):
+        return self._rels
+
+
+class _FakeRule:
+    def __init__(self, *exprs):
+        self.checks = list(exprs)
+        self.post_checks = []
+
+
+class TestBulkCheckFolding:
+    def test_single_bulk_call_across_rules_and_exprs(self):
+        ep = make_counting([
+            "namespace:ns1#viewer@user:alice",
+            "pod:p1#viewer@user:alice",
+            "pod:p2#viewer@user:alice",
+        ])
+        rules = [
+            _FakeRule(_FakeExpr("namespace:ns1#view@user:alice"),
+                      _FakeExpr("pod:p1#view@user:alice")),
+            _FakeRule(_FakeExpr("pod:p2#view@user:alice")),
+        ]
+        asyncio.run(run_all_matching_checks(ep, rules, input=None))
+        assert ep.bulk_calls == 1  # reference check.go:23-48: ONE bulk RPC
+
+    def test_any_failure_unauthorized(self):
+        ep = make_counting(["namespace:ns1#viewer@user:alice"])
+        rules = [
+            _FakeRule(_FakeExpr("namespace:ns1#view@user:alice"),
+                      _FakeExpr("pod:p1#view@user:alice")),
+        ]
+        with pytest.raises(UnauthorizedError):
+            asyncio.run(run_all_matching_checks(ep, rules, input=None))
+        assert ep.bulk_calls == 1
+
+    def test_no_templates_no_rpc(self):
+        ep = make_counting([])
+        asyncio.run(run_all_matching_checks(ep, [_FakeRule()], input=None))
+        assert ep.bulk_calls == 0
+
+
+class TestStreamingLookup:
+    def test_default_stream_matches_list(self):
+        ep = make_counting([f"pod:p{i}#viewer@user:alice" for i in range(10)])
+
+        async def run():
+            sub = SubjectRef("user", "alice")
+            want = await ep.lookup_resources("pod", "view", sub)
+            got = [r async for r in ep.lookup_resources_stream(
+                "pod", "view", sub)]
+            assert sorted(got) == sorted(want)
+        asyncio.run(run())
+
+    def test_jax_stream_matches_list_and_chunks(self):
+        ep = JaxEndpoint(sch.parse_schema(SCHEMA))
+        ep.store.bulk_load([parse_relationship(
+            f"pod:p{i:05d}#viewer@user:alice") for i in range(5000)])
+
+        async def run():
+            sub = SubjectRef("user", "alice")
+            got = [r async for r in ep.lookup_resources_stream(
+                "pod", "view", sub)]
+            want = await ep.lookup_resources("pod", "view", sub)
+            assert got == want and len(got) == 5000
+        asyncio.run(run())
+
+    def test_prefilter_drains_stream(self):
+        ep = make_counting([f"pod:ns/p{i}#viewer@user:alice" for i in range(4)])
+        flt = ResolvedPreFilter(
+            rel=rrel("pod:$#view@user:alice"),
+            name_from_object_id=compile_template_expression(
+                '{{split_name(resourceId)}}'),
+            namespace_from_object_id=compile_template_expression(
+                '{{split_namespace(resourceId)}}'),
+        )
+
+        async def run():
+            res = await run_lookup_resources(ep, flt, input=None)
+            assert res.allowed == {("ns", f"p{i}") for i in range(4)}
+        asyncio.run(run())
+        assert ep.stream_calls == 1
+
+    def test_read_relationships_stream(self):
+        ep = make_counting([f"pod:p{i}#viewer@user:alice" for i in range(6)])
+
+        async def run():
+            rels = [r async for r in ep.read_relationships_stream(None)]
+            assert len(rels) == 6
+        asyncio.run(run())
