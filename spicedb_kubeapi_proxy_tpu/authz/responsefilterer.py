@@ -121,8 +121,18 @@ class StandardResponseFilterer(ResponseFilterer):
         if 400 <= resp.status <= 599:
             return
 
-        # a Table request short-circuits GVK handling (tables are JSON)
+        from ..proxy import k8sproto
+
+        # a Table request short-circuits GVK handling
         if "as=Table" in req.headers.get("Accept", ""):
+            if k8sproto.is_k8s_proto(resp.body):
+                try:
+                    body = self._filter_table_proto(resp.body, result)
+                except k8sproto.K8sProtoError as e:
+                    raise FilterError(
+                        f"error decoding protobuf table: {e}") from e
+                self._write_resp(resp, body, None)
+                return
             try:
                 body, err = self._filter_table(resp.body, result)
             except ValueError as e:
@@ -133,9 +143,12 @@ class StandardResponseFilterer(ResponseFilterer):
         content_type = resp.headers.get("Content-Type", "application/json")
         media = content_type.split(";")[0].strip()
         if "json" not in media:
-            # the reference rejects proto-encoded bodies for unrecognized
-            # types (responsefilterer.go:278-280); this build negotiates
-            # JSON everywhere, so any non-JSON body is unsupported
+            if k8sproto.is_k8s_proto(resp.body):
+                # negotiated protobuf body: filter at the wire level
+                # (reference responsefilterer.go:241-301; unparseable
+                # bodies reject like unrecognized-GVK proto at 278-280)
+                await self._filter_proto(resp, info, result)
+                return
             gvk = await self._gvk(info)
             raise FilterError(
                 f"unsupported media type {media} for gvk {gvk}")
@@ -160,6 +173,36 @@ class StandardResponseFilterer(ResponseFilterer):
                 info.api_group, info.api_version, info.resource)
         except NoKindMatchError as e:
             raise FilterError(str(e)) from e
+
+    async def _filter_proto(self, resp: Response, info: RequestInfo,
+                            result: PrefilterResult) -> None:
+        """Filter a `k8s\\x00`-enveloped protobuf list/object body by
+        wire-level splicing (proxy/k8sproto.py)."""
+        from ..proxy import k8sproto
+
+        try:
+            api_version, kind, raw, ct = k8sproto.decode_unknown(resp.body)
+            if len(info.parts) == 1 and kind.endswith("List"):
+                filtered = k8sproto.filter_list_raw(raw, result.is_allowed)
+                body = k8sproto.encode_unknown(api_version, kind, filtered, ct)
+                self._write_resp(resp, body, None)
+            else:
+                namespace, name = k8sproto.object_meta(raw)
+                if result.is_allowed(namespace, name):
+                    self._write_resp(resp, resp.body, None)
+                else:
+                    self._write_resp(resp, b"", FilterError("unauthorized"))
+        except k8sproto.K8sProtoError as e:
+            raise FilterError(
+                f"unable to filter protobuf body for gvk "
+                f"{await self._gvk(info)}: {e}") from e
+
+    def _filter_table_proto(self, body: bytes, result: PrefilterResult) -> bytes:
+        from ..proxy import k8sproto
+
+        api_version, kind, raw, ct = k8sproto.decode_unknown(body)
+        filtered = k8sproto.filter_table_raw(raw, result.is_allowed)
+        return k8sproto.encode_unknown(api_version, kind, filtered, ct)
 
     def _filter_table(self, body: bytes, result: PrefilterResult) -> tuple:
         table = json.loads(body)

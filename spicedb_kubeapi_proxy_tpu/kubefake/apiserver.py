@@ -246,6 +246,18 @@ class FakeKubeApiServer:
     def _wants_table(req: Request) -> bool:
         return "as=Table" in req.headers.get("Accept", "")
 
+    @staticmethod
+    def _wants_proto(req: Request) -> bool:
+        return "application/vnd.kubernetes.protobuf" in \
+            req.headers.get("Accept", "")
+
+    @staticmethod
+    def _proto_response(body: bytes) -> Response:
+        resp = Response(status=200, body=body)
+        resp.headers.set("Content-Type", "application/vnd.kubernetes.protobuf")
+        resp.headers.set("Content-Length", str(len(body)))
+        return resp
+
     def _to_table(self, t: ResourceType, items: list) -> dict:
         rows = []
         for obj in items:
@@ -279,6 +291,17 @@ class FakeKubeApiServer:
                          selector, o.get("metadata", {}).get("labels") or {})]
         if self._wants_table(req):
             return json_response(200, self._to_table(t, items))
+        if self._wants_proto(req):
+            # serve the k8s protobuf envelope (magic + runtime.Unknown);
+            # items carry ObjectMeta only — enough for filtering, which
+            # reads nothing else
+            from ..proxy import k8sproto
+            encoded = [k8sproto.encode_object(
+                t.group_version, t.kind,
+                o.get("metadata", {}).get("name", ""),
+                o.get("metadata", {}).get("namespace", "")) for o in items]
+            return self._proto_response(
+                k8sproto.encode_list(t.group_version, t.list_kind, encoded))
         return json_response(200, {
             "kind": t.list_kind, "apiVersion": t.group_version,
             "metadata": {"resourceVersion": str(self._rv)},
@@ -330,6 +353,15 @@ class FakeKubeApiServer:
             obj = copy.deepcopy(obj)
         if self._wants_table(req):
             return json_response(200, self._to_table(t, [obj]))
+        if self._wants_proto(req):
+            from ..proxy import k8sproto
+            meta = obj.get("metadata", {})
+            raw = k8sproto.encode_object(t.group_version, t.kind,
+                                         meta.get("name", ""),
+                                         meta.get("namespace", ""))
+            return self._proto_response(k8sproto.encode_unknown(
+                t.group_version, t.kind, raw,
+                "application/vnd.kubernetes.protobuf"))
         return json_response(200, obj)
 
     async def _create(self, req: Request, t: ResourceType, key: tuple,

@@ -202,9 +202,14 @@ def rbac_deny(n_pods: int = 10_000, n_users: int = 2_000,
 def multitenant_1m(n_tenants: int = 100, n_users: int = 50_000,
                    n_groups: int = 2_000, n_namespaces: int = 2_000,
                    n_pods: int = 200_000, n_tuples: int = 1_000_000,
-                   seed: int = 4) -> Workload:
+                   cold_subjects: float = 0.0, seed: int = 4) -> Workload:
     """Config 5: ~1M-tuple multi-tenant graph; subjects for 256 concurrent
-    list requests."""
+    list requests.
+
+    `cold_subjects` is the fraction of QUERY subjects that appear in no
+    tuple at all (first-contact users): they exercise the phantom-column
+    path instead of the compiled per-user columns (round-1 VERDICT item 7
+    demanded a no-cliff bench for this)."""
     rng = random.Random(seed)
     rels = set()
     for u in range(n_users):
@@ -227,11 +232,16 @@ def multitenant_1m(n_tenants: int = 100, n_users: int = 50_000,
     while len(rels) < n_tuples:
         p = rng.randrange(n_pods)
         rels.add(f"pod:ns{p % n_namespaces}/p{p}#viewer@user:u{rng.randrange(n_users)}")
+    subjects = [f"u{i}" for i in range(n_users)]
+    if cold_subjects > 0:
+        n_cold = int(len(subjects) * cold_subjects)
+        subjects[:n_cold] = [f"cold{i}" for i in range(n_cold)]
+        rng.shuffle(subjects)
     return Workload(
         name="multitenant-1m",
         schema_text=MULTITENANT_SCHEMA,
         relationships=sorted(rels),
-        subjects=[f"u{i}" for i in range(n_users)],
+        subjects=subjects,
         resource_type="pod",
         permission="view",
         expected_objects=n_pods,

@@ -182,6 +182,12 @@ def _emit_tuple_edges(prog: GraphProgram, schema: sch.Schema,
                       srcs: list, dsts: list, wildcard_map: dict) -> None:
     """Per-tuple edge emission (object path; also used for overlay tuples
     on top of a columnar base)."""
+    if getattr(rel, "caveat", None) is not None:
+        # caveated tuples are host-evaluated residuals: queries on any
+        # (type, permission) whose closure can traverse them route to the
+        # oracle (caveat_affected_pairs); the device graph holds only
+        # definite edges
+        return
     rt = rel.resource.type
     if rt not in schema.definitions:
         return
@@ -440,6 +446,55 @@ def compile_graph_columnar(schema: sch.Schema, snap, rows: np.ndarray,
     dst_arr = np.concatenate(dst_parts) if dst_parts else np.zeros(0, np.int32)
     return _finalize_program(prog, schema, src_arr, dst_arr,
                              wildcard_map, arrow_slots)
+
+
+def caveat_affected_pairs(schema: sch.Schema, caveated_rels: set) -> set:
+    """All (type, relation-or-permission) pairs whose evaluation could
+    traverse a relation in `caveated_rels` ({(type, relation)} pairs that
+    hold >=1 live caveated tuple).  Queries on these pairs are routed to
+    the host oracle (tri-state Kleene evaluation); everything else stays on
+    the kernel.  Static over the schema, so it is a superset of the truly
+    affected queries — correct, and empty when no caveated tuples exist."""
+    affected = set(caveated_rels)
+
+    def expr_affected(t: str, d: sch.Definition, e: sch.Expr) -> bool:
+        if isinstance(e, sch.Nil):
+            return False
+        if isinstance(e, sch.RelRef):
+            return (t, e.name) in affected
+        if isinstance(e, sch.Arrow):
+            if (t, e.left) in affected:
+                return True
+            for ref in d.relations.get(e.left, ()):
+                if (ref.type, e.target) in affected:
+                    return True
+            return False
+        if isinstance(e, (sch.Union, sch.Intersection)):
+            return any(expr_affected(t, d, c) for c in e.children)
+        if isinstance(e, sch.Exclusion):
+            return (expr_affected(t, d, e.base)
+                    or expr_affected(t, d, e.subtract))
+        raise SchemaError(f"unknown expression {e!r}")
+
+    changed = True
+    while changed:
+        changed = False
+        for t, d in schema.definitions.items():
+            for r, refs in d.relations.items():
+                if (t, r) in affected:
+                    continue
+                for ref in refs:
+                    if ref.relation and (ref.type, ref.relation) in affected:
+                        affected.add((t, r))
+                        changed = True
+                        break
+            for p, expr in d.permissions.items():
+                if (t, p) in affected:
+                    continue
+                if expr_affected(t, d, expr):
+                    affected.add((t, p))
+                    changed = True
+    return affected
 
 
 def _find_arrows(expr: sch.Expr) -> list:

@@ -63,8 +63,8 @@ from ..spicedb.types import (
     WILDCARD,
 )
 from .ell import EllKernelCache, batch_words, build_tables
-from .graph_compile import (GraphProgram, SELF_SLOT, compile_graph,
-                            compile_graph_columnar)
+from .graph_compile import (GraphProgram, SELF_SLOT, caveat_affected_pairs,
+                            compile_graph, compile_graph_columnar)
 from .spmv import KernelCache, bucket, pad_edges
 
 _MIN_EDGE_BUCKET = 256
@@ -402,7 +402,14 @@ class JaxEndpoint(PermissionsEndpoint):
         # map are stale and skipped (lazy deletion)
         self._expiry_meta: dict = {}
         self._known_extra_subjects: dict[str, set] = {}
-        self.stats = {"rebuilds": 0, "delta_batches": 0, "kernel_calls": 0}
+        # caveat residuals (SURVEY.md hard part (c)): caveated tuples never
+        # enter the device graph; queries on (type, permission) pairs whose
+        # closure could traverse one are host-evaluated (tri-state oracle)
+        self._caveated_pairs: set = set()
+        self._caveat_affected: set = set()
+        self._caveated_keys: set = set()
+        self.stats = {"rebuilds": 0, "delta_batches": 0, "kernel_calls": 0,
+                      "oracle_residual_checks": 0}
         self.store.add_delta_listener(self._on_delta)
         self.store.add_reset_listener(self._on_reset)
 
@@ -482,6 +489,12 @@ class JaxEndpoint(PermissionsEndpoint):
         # are subsumed by it
         self._drain_pending()
         self._graph_invalid = False
+        self._caveated_pairs = self.store.caveated_relation_pairs()
+        self._caveat_affected = (
+            caveat_affected_pairs(self.schema, self._caveated_pairs)
+            if self._caveated_pairs else set())
+        self._caveated_keys = (self.store.caveated_keys()
+                               if self._caveated_pairs else set())
         extra = {t: set(ids) for t, ids in self._known_extra_subjects.items()}
         for t in self.schema.definitions:
             extra.setdefault(t, set()).add(PHANTOM_ID)
@@ -567,11 +580,29 @@ class JaxEndpoint(PermissionsEndpoint):
                         needs_rebuild = True
                         break
                     self._set_expiry(key, None)
+                    if key in self._caveated_keys:
+                        # caveated tuples never entered the device graph
+                        self._caveated_keys.discard(key)
+                        continue
                     if not graph.remove_key(key):
                         needs_rebuild = True
                         break
-                else:  # TOUCH
+                elif u.rel.caveat is not None:  # TOUCH, caveated
+                    pair = (u.rel.resource.type, u.rel.relation)
+                    if pair not in self._caveated_pairs:
+                        # first caveat on this relation: the affected-pair
+                        # closure changes — recompute via rebuild
+                        needs_rebuild = True
+                        break
                     self._set_expiry(key, u.rel.expires_at)
+                    # a previously-definite tuple may have been replaced by
+                    # a caveated one: its device edges must go
+                    if key not in self._caveated_keys:
+                        graph.remove_key(key)
+                        self._caveated_keys.add(key)
+                else:  # TOUCH, definite
+                    self._set_expiry(key, u.rel.expires_at)
+                    self._caveated_keys.discard(key)
                     if not graph.add_rel(u.rel):
                         needs_rebuild = True
                         break
@@ -588,6 +619,9 @@ class JaxEndpoint(PermissionsEndpoint):
             if self._expiry_meta.get(key) != exp:
                 continue
             del self._expiry_meta[key]
+            if key in self._caveated_keys:
+                self._caveated_keys.discard(key)
+                continue  # was never in the device graph
             if key[4] == WILDCARD:
                 needs_rebuild = True
                 break
@@ -644,6 +678,10 @@ class JaxEndpoint(PermissionsEndpoint):
 
     # -- verbs --------------------------------------------------------------
 
+    _TRISTATE = {0: Permissionship.NO_PERMISSION,
+                 1: Permissionship.CONDITIONAL_PERMISSION,
+                 2: Permissionship.HAS_PERMISSION}
+
     def _check_batch_sync(self, reqs: list) -> list:
         with self._lock:
             # capture the revision BEFORE draining deltas so checked_at is
@@ -656,13 +694,19 @@ class JaxEndpoint(PermissionsEndpoint):
             gather_idx: list[int] = []
             gather_col: list[int] = []
             kernel_rows: list[int] = []  # positions in reqs served by kernel
-            results: list[Optional[bool]] = [None] * len(reqs)
+            results: list[Optional[int]] = [None] * len(reqs)  # tri-state
             for i, r in enumerate(reqs):
+                if (r.resource.type, r.permission) in self._caveat_affected:
+                    # caveat residual: host tri-state evaluation
+                    results[i] = self._oracle.check3(r.resource, r.permission,
+                                                     r.subject)
+                    self.stats["oracle_residual_checks"] += 1
+                    continue
                 if r.subject in unknown:
-                    # outside the compiled universe: oracle fallback (only
-                    # wildcard-derived permissions can apply)
-                    results[i] = self._oracle.check(r.resource, r.permission,
-                                                    r.subject)
+                    # no slot for (type, relation) at all: oracle reproduces
+                    # the schema error/edge semantics
+                    results[i] = self._oracle.check3(r.resource, r.permission,
+                                                     r.subject)
                     continue
                 state_idx = graph.prog.state_index(
                     r.resource.type, r.permission, r.resource.id)
@@ -670,10 +714,10 @@ class JaxEndpoint(PermissionsEndpoint):
                     d = self.schema.definitions.get(r.resource.type)
                     if d is None or not d.has_relation_or_permission(r.permission):
                         # surface schema errors like the oracle does
-                        results[i] = self._oracle.check(
+                        results[i] = self._oracle.check3(
                             r.resource, r.permission, r.subject)
                     else:
-                        results[i] = False  # unknown object: no tuples
+                        results[i] = 0  # unknown object: no tuples
                     continue
                 gather_idx.append(state_idx)
                 gather_col.append(cols[r.subject])
@@ -682,11 +726,9 @@ class JaxEndpoint(PermissionsEndpoint):
                 out = graph.run_checks(q_arr, gather_idx, gather_col)
                 self.stats["kernel_calls"] += 1
                 for j, row in enumerate(kernel_rows):
-                    results[row] = bool(out[j])
-        return [CheckResult(
-            permissionship=(Permissionship.HAS_PERMISSION if r
-                            else Permissionship.NO_PERMISSION),
-            checked_at=rev) for r in results]
+                    results[row] = 2 if out[j] else 0
+        return [CheckResult(permissionship=self._TRISTATE[r], checked_at=rev)
+                for r in results]
 
     async def check_permission(self, req: CheckRequest) -> CheckResult:
         return self._check_batch_sync([req])[0]
@@ -701,6 +743,11 @@ class JaxEndpoint(PermissionsEndpoint):
         self.schema.definition(resource_type)  # raises like the oracle
         with self._lock:
             graph = self._current_graph()
+            if (resource_type, permission) in self._caveat_affected:
+                # caveat residual: the oracle already skips CONDITIONAL
+                # results (reference lookups.go:85-88)
+                return self._oracle.lookup_resources(resource_type,
+                                                     permission, subject)
             rng = graph.prog.slot_range(resource_type, permission)
             if rng is None:
                 return self._oracle.lookup_resources(resource_type, permission,
@@ -743,6 +790,10 @@ class JaxEndpoint(PermissionsEndpoint):
         self.schema.definition(resource_type)
         with self._lock:
             graph = self._current_graph()
+            if (resource_type, permission) in self._caveat_affected:
+                return [self._oracle.lookup_resources(resource_type,
+                                                      permission, s)
+                        for s in subjects]
             rng = graph.prog.slot_range(resource_type, permission)
             if rng is None:
                 return [self._oracle.lookup_resources(resource_type, permission, s)

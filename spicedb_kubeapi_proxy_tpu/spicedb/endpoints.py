@@ -193,11 +193,15 @@ class EmbeddedEndpoint(PermissionsEndpoint):
 
     # -- verbs --------------------------------------------------------------
 
+    _TRISTATE = {0: Permissionship.NO_PERMISSION,
+                 1: Permissionship.CONDITIONAL_PERMISSION,
+                 2: Permissionship.HAS_PERMISSION}
+
     def _check_sync(self, req: CheckRequest) -> CheckResult:
-        allowed = self.evaluator.check(req.resource, req.permission, req.subject)
+        value = self.evaluator.check3(req.resource, req.permission,
+                                      req.subject)
         return CheckResult(
-            permissionship=(Permissionship.HAS_PERMISSION if allowed
-                            else Permissionship.NO_PERMISSION),
+            permissionship=self._TRISTATE[value],
             checked_at=self.store.revision,
         )
 

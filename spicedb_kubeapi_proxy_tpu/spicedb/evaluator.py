@@ -28,6 +28,11 @@ from .types import (
 
 MAX_DEPTH = 50
 
+# Three-valued (Kleene) permission logic, ordered so that AND=min, OR=max,
+# NOT(x)=YES-x.  MAYBE arises only from caveated tuples whose context is
+# insufficient to decide them (CONDITIONAL_PERMISSION on the wire).
+NO, MAYBE, YES = 0, 1, 2
+
 
 @dataclass
 class _Ctx:
@@ -54,20 +59,27 @@ class Evaluator:
 
     def check(self, resource: ObjectRef, permission: str,
               subject: SubjectRef) -> bool:
-        """Does `subject` have `permission` on `resource`?"""
+        """Does `subject` definitely have `permission` on `resource`?"""
+        return self._check(resource, permission, subject, 0, _Ctx()) == YES
+
+    def check3(self, resource: ObjectRef, permission: str,
+               subject: SubjectRef) -> int:
+        """Tri-state check: NO / MAYBE (caveat undecided) / YES."""
         return self._check(resource, permission, subject, 0, _Ctx())
 
     def lookup_resources(self, resource_type: str, permission: str,
                          subject: SubjectRef) -> list:
-        """All object ids of `resource_type` on which `subject` has
-        `permission`.  Candidates are objects appearing as a resource in any
-        live tuple (an object with no tuples is unreachable)."""
+        """All object ids of `resource_type` on which `subject` DEFINITELY
+        has `permission` — conditional (caveated) results are skipped,
+        matching the reference's LR handling (pkg/authz/lookups.go:85-88).
+        Candidates are objects appearing as a resource in any live tuple
+        (an object with no tuples is unreachable)."""
         self.schema.definition(resource_type)  # validate type exists
         out = []
         ctx = _Ctx()  # memo shared across candidates — same store snapshot
         for rid in self.store.object_ids_of_type(resource_type):
             if self._check(ObjectRef(resource_type, rid), permission, subject,
-                           0, ctx):
+                           0, ctx) == YES:
                 out.append(rid)
         return out
 
@@ -82,14 +94,27 @@ class Evaluator:
         out = []
         for sid in sorted(candidates):
             if self._check(resource, permission, SubjectRef(subject_type, sid),
-                           0, _Ctx()):
+                           0, _Ctx()) == YES:
                 out.append(sid)
         return out
 
     # -- evaluation ---------------------------------------------------------
 
+    def _caveat_value(self, caveat) -> int:
+        """YES/NO when the tuple's context decides its caveat; MAYBE when
+        parameters are missing (CONDITIONAL on the wire)."""
+        if caveat is None:
+            return YES
+        c = self.schema.caveats.get(caveat.name)
+        if c is None:
+            raise SchemaError(f"caveat `{caveat.name}` not found")
+        out = c.evaluate(caveat.context())
+        if out is None:
+            return MAYBE
+        return YES if out else NO
+
     def _check(self, resource: ObjectRef, name: str, subject: SubjectRef,
-               depth: int, ctx: _Ctx) -> bool:
+               depth: int, ctx: _Ctx) -> int:
         if depth > self.max_depth:
             raise MaxDepthExceededError(
                 f"max dispatch depth {self.max_depth} exceeded checking"
@@ -99,7 +124,7 @@ class Evaluator:
             return ctx.memo[key]
         if key in ctx.stack:
             ctx.hits.add(key)
-            return False  # cycle: revisiting the same node adds nothing new
+            return NO  # cycle: revisiting the same node adds nothing new
         ctx.stack.add(key)
         try:
             d = self.schema.definition(resource.type)
@@ -119,60 +144,82 @@ class Evaluator:
         return result
 
     def _check_relation(self, resource: ObjectRef, relation: str,
-                        subject: SubjectRef, depth: int, ctx: _Ctx) -> bool:
-        found = False
-        for ts in self.store.subjects_for(resource, relation):
+                        subject: SubjectRef, depth: int, ctx: _Ctx) -> int:
+        best = NO
+        for ts, caveat in self.store.subject_entries_for(resource, relation):
+            cv = self._caveat_value(caveat)
+            if cv == NO:
+                continue
             if not ts.relation:
                 # direct subject; wildcard matches any direct subject of type
                 if ts.id == WILDCARD:
                     if ts.type == subject.type and not subject.relation:
-                        found = True
-                        break
-                    continue
-                if ts == subject:
-                    found = True
-                    break
+                        best = max(best, cv)
+                else:
+                    if ts == subject:
+                        best = max(best, cv)
             else:
                 # userset subject: exact match, or expand recursively
                 if (ts.type == subject.type and ts.id == subject.id
                         and ts.relation == subject.relation):
-                    found = True
-                    break
-                if self._check(ObjectRef(ts.type, ts.id), ts.relation,
-                               subject, depth + 1, ctx):
-                    found = True
-                    break
-        return found
+                    best = max(best, cv)
+                else:
+                    best = max(best, min(cv, self._check(
+                        ObjectRef(ts.type, ts.id), ts.relation, subject,
+                        depth + 1, ctx)))
+            if best == YES:
+                break
+        return best
 
     def _eval_expr(self, d: sch.Definition, resource: ObjectRef, expr: sch.Expr,
-                   subject: SubjectRef, depth: int, ctx: _Ctx) -> bool:
+                   subject: SubjectRef, depth: int, ctx: _Ctx) -> int:
         if isinstance(expr, sch.Nil):
-            return False
+            return NO
         if isinstance(expr, sch.RelRef):
             return self._check(resource, expr.name, subject, depth + 1, ctx)
         if isinstance(expr, sch.Arrow):
             # walk subject objects of the left relation; wildcard and userset
-            # subjects are not traversed by arrows
-            for ts in self.store.subjects_for(resource, expr.left):
+            # subjects are not traversed by arrows.  A caveated left tuple
+            # caps the branch at its caveat value (AND in Kleene logic).
+            best = NO
+            for ts, caveat in self.store.subject_entries_for(resource,
+                                                             expr.left):
                 if ts.id == WILDCARD or ts.relation:
+                    continue
+                cv = self._caveat_value(caveat)
+                if cv == NO:
                     continue
                 target_def = self.schema.definitions.get(ts.type)
                 if (target_def is None
                         or not target_def.has_relation_or_permission(expr.target)):
                     continue
-                if self._check(ObjectRef(ts.type, ts.id), expr.target, subject,
-                               depth + 1, ctx):
-                    return True
-            return False
+                best = max(best, min(cv, self._check(
+                    ObjectRef(ts.type, ts.id), expr.target, subject,
+                    depth + 1, ctx)))
+                if best == YES:
+                    break
+            return best
         if isinstance(expr, sch.Union):
-            return any(self._eval_expr(d, resource, c, subject, depth, ctx)
-                       for c in expr.children)
+            best = NO
+            for c in expr.children:
+                best = max(best,
+                           self._eval_expr(d, resource, c, subject, depth, ctx))
+                if best == YES:
+                    break
+            return best
         if isinstance(expr, sch.Intersection):
-            return all(self._eval_expr(d, resource, c, subject, depth, ctx)
-                       for c in expr.children)
+            worst = YES
+            for c in expr.children:
+                worst = min(worst,
+                            self._eval_expr(d, resource, c, subject, depth, ctx))
+                if worst == NO:
+                    break
+            return worst
         if isinstance(expr, sch.Exclusion):
-            if not self._eval_expr(d, resource, expr.base, subject, depth, ctx):
-                return False
-            return not self._eval_expr(d, resource, expr.subtract, subject,
-                                       depth, ctx)
+            base = self._eval_expr(d, resource, expr.base, subject, depth, ctx)
+            if base == NO:
+                return NO
+            sub = self._eval_expr(d, resource, expr.subtract, subject, depth,
+                                  ctx)
+            return min(base, YES - sub)
         raise SchemaError(f"unknown expression node {expr!r}")

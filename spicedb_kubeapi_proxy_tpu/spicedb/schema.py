@@ -83,8 +83,40 @@ class Definition:
 
 
 @dataclass
+class Caveat:
+    """`caveat name(param type, ...) { cel-expression }`.  The body is a CEL
+    expression evaluated against the merged tuple/request context; a tuple
+    carrying this caveat grants CONDITIONAL permission until the context
+    decides it (the reference proxy skips CONDITIONAL LookupResources
+    results, pkg/authz/lookups.go:85-88)."""
+    name: str
+    params: tuple          # ((param name, type source text), ...)
+    body_src: str          # raw CEL source between the braces
+
+    def __post_init__(self):
+        self._prog = None
+
+    def evaluate(self, context: dict) -> Optional[bool]:
+        """True/False when decidable with `context`; None (CONDITIONAL)
+        when required parameters are missing."""
+        missing = [n for (n, _) in self.params if n not in context]
+        if missing:
+            return None
+        if self._prog is None:
+            from ..rules import cel  # lazy: schema is imported by rules
+            self._prog = cel.compile_expression(self.body_src)
+        out = self._prog.eval(dict(context))
+        if not isinstance(out, bool):
+            from .types import SchemaError as _SE
+            raise _SE(f"caveat {self.name!r} returned {type(out).__name__},"
+                      f" expected bool")
+        return out
+
+
+@dataclass
 class Schema:
     definitions: dict = field(default_factory=dict)  # name -> Definition
+    caveats: dict = field(default_factory=dict)      # name -> Caveat
     uses: tuple = ()
 
     def definition(self, type_name: str) -> Definition:
@@ -147,7 +179,8 @@ class Schema:
 # Lexer
 # ---------------------------------------------------------------------------
 
-_PUNCT = ["->", "{", "}", "(", ")", ":", "#", "|", "+", "&", "-", "=", ";", ",", "*", "/"]
+_PUNCT = ["->", "{", "}", "(", ")", ":", "#", "|", "+", "&", "-", "=", ";",
+          ",", "*", "/", "<", ">"]
 
 
 def _tokenize(src: str) -> list:
@@ -210,8 +243,9 @@ def _tokenize(src: str) -> list:
 
 
 class _P:
-    def __init__(self, toks: list):
+    def __init__(self, toks: list, src: str = ""):
         self.toks = toks
+        self.src = src
         self.i = 0
 
     def peek(self):
@@ -270,38 +304,61 @@ class _P:
                 schema.definitions[d.name] = d
                 continue
             if k == "ident" and v == "caveat":
-                self._skip_caveat()
+                c = self.parse_caveat()
+                if c.name in schema.caveats:
+                    raise SchemaError(f"duplicate caveat {c.name!r}")
+                schema.caveats[c.name] = c
                 continue
             raise SchemaError(f"unexpected token {v!r} at offset {pos}")
         schema.uses = tuple(uses)
         _validate(schema)
         return schema
 
-    def _skip_caveat(self):
-        # `caveat name(params) { expr }` — parsed and ignored (caveats are
-        # out of scope; the reference's LR path skips conditional results).
+    def parse_caveat(self) -> Caveat:
+        """`caveat name(param type, ...) { cel-expression }`."""
         self.next()  # 'caveat'
-        self.expect_ident("caveat name")
+        name = self.expect_ident("caveat name")
         self.expect_punct("(")
-        depth = 1
-        while depth:
-            k, v, pos = self.next()
-            if k == "eof":
-                raise SchemaError("unterminated caveat parameter list")
-            if v == "(":
-                depth += 1
-            elif v == ")":
-                depth -= 1
+        params = []
+        while not self.eat(")"):
+            pname = self.expect_ident("caveat parameter name")
+            # the type is a free-form token run (`int`, `list<string>`,
+            # `map<any>`, ...) up to `,` or `)`
+            type_parts = []
+            depth = 0
+            while True:
+                k, v, pos = self.peek()
+                if k == "eof":
+                    raise SchemaError("unterminated caveat parameter list")
+                if depth == 0 and k == "punct" and v in (",", ")"):
+                    break
+                if k == "punct" and v == "<":
+                    depth += 1
+                elif k == "punct" and v == ">":
+                    depth -= 1
+                type_parts.append(v)
+                self.next()
+            if not type_parts:
+                raise SchemaError(
+                    f"caveat parameter {pname!r} missing a type")
+            params.append((pname, "".join(type_parts)))
+            self.eat(",")
+        k, v, start = self.peek()
         self.expect_punct("{")
         depth = 1
+        end = start
         while depth:
-            k, v, pos = self.next()
+            k, v, end = self.next()
             if k == "eof":
                 raise SchemaError("unterminated caveat body")
             if v == "{":
                 depth += 1
             elif v == "}":
                 depth -= 1
+        body = self.src[start + 1: end].strip() if self.src else ""
+        if not body:
+            raise SchemaError(f"caveat {name!r} has an empty body")
+        return Caveat(name=name, params=tuple(params), body_src=body)
 
     def parse_definition(self) -> Definition:
         self.next()  # 'definition'
@@ -351,6 +408,14 @@ class _P:
             if k == "ident" and v == "with":
                 self.next()
                 traits.append(self.expect_ident("trait name"))
+                # `with caveat_name and expiration` continuation
+                while True:
+                    k2, v2, _ = self.peek()
+                    if k2 == "ident" and v2 == "and":
+                        self.next()
+                        traits.append(self.expect_ident("trait name"))
+                    else:
+                        break
             else:
                 break
         return TypeRef(type=t, relation=relation, wildcard=wildcard,
@@ -415,6 +480,11 @@ def _validate(schema: Schema) -> None:
                     raise SchemaError(
                         f"{d.name}#{rel_name}: {ref.type!r} has no relation"
                         f" or permission {ref.relation!r}")
+                for trait in ref.traits:
+                    if trait != "expiration" and trait not in schema.caveats:
+                        raise SchemaError(
+                            f"{d.name}#{rel_name}: unknown trait/caveat"
+                            f" {trait!r}")
         for perm_name, expr in d.permissions.items():
             _validate_expr(schema, d, perm_name, expr)
 
@@ -445,4 +515,4 @@ def _validate_expr(schema: Schema, d: Definition, perm: str, e: Expr) -> None:
 
 
 def parse_schema(src: str) -> Schema:
-    return _P(_tokenize(src)).parse_schema()
+    return _P(_tokenize(src), src).parse_schema()

@@ -163,6 +163,62 @@ class TupleStore:
                            if not e.rel.expired(now))
         return out
 
+    def subject_entries_for(self, resource: ObjectRef, relation: str) -> list:
+        """Live (subject, caveat) pairs of (resource, relation).  The
+        columnar base layer never carries caveats (caveated tuples always
+        take the object path, see bulk_load_text), so base rows pair with
+        None."""
+        now = self._clock()
+        out = []
+        with self._lock:
+            base = self._base
+            if base is not None:
+                snap = base.snap
+                pool = snap.pool
+                for row in base.rows_for_resource(resource.type, relation,
+                                                  resource.id):
+                    if base.row_live(int(row), now):
+                        out.append((SubjectRef(pool[snap.stype[row]],
+                                               pool[snap.sid[row]],
+                                               pool[snap.srel[row]]), None))
+            by_id = self._by_relation.get((resource.type, relation))
+            subjects = by_id.get(resource.id) if by_id else None
+            if subjects:
+                out.extend((e.rel.subject, e.rel.caveat)
+                           for e in subjects.values()
+                           if not e.rel.expired(now))
+        return out
+
+    def caveated_relation_pairs(self) -> set:
+        """(resource_type, relation) pairs currently holding >=1 live
+        caveated tuple (jax:// uses this to route affected permissions to
+        the host evaluator)."""
+        now = self._clock()
+        out = set()
+        with self._lock:
+            for (rtype, relation), by_id in self._by_relation.items():
+                if (rtype, relation) in out:
+                    continue
+                for subjects in by_id.values():
+                    if any(e.rel.caveat is not None and not e.rel.expired(now)
+                           for e in subjects.values()):
+                        out.add((rtype, relation))
+                        break
+        return out
+
+    def caveated_keys(self) -> set:
+        """Identity keys of live caveated tuples (jax:// excludes these from
+        the device graph and tracks them across deltas)."""
+        now = self._clock()
+        out = set()
+        with self._lock:
+            for by_id in self._by_relation.values():
+                for subjects in by_id.values():
+                    for e in subjects.values():
+                        if e.rel.caveat is not None and not e.rel.expired(now):
+                            out.add(e.rel.key())
+        return out
+
     def resources_with_relation(self, resource_type: str, relation: str) -> list:
         """Live resource ids having any tuple for (type, relation)."""
         now = self._clock()
@@ -320,7 +376,25 @@ class TupleStore:
             return self._revision
 
     def bulk_load_text(self, text: str) -> int:
-        """Parse + adopt relationship text via the native loader."""
+        """Parse + adopt relationship text via the native loader.  Caveated
+        lines (`[caveat:...]` suffix) are split out and loaded through the
+        object path — the columnar base layer stays caveat-free by
+        construction (see subject_entries_for)."""
+        if "[caveat:" in text:
+            from .types import parse_relationship as _parse
+            plain_lines = []
+            caveat_rels = []
+            for line in text.splitlines():
+                stripped = line.strip()
+                if "[caveat:" in stripped:
+                    caveat_rels.append(_parse(stripped))
+                else:
+                    plain_lines.append(line)
+            rev = self.bulk_load_snapshot(
+                ColumnarSnapshot.from_text("\n".join(plain_lines)))
+            if caveat_rels:
+                rev = self.bulk_load(caveat_rels)
+            return rev
         return self.bulk_load_snapshot(ColumnarSnapshot.from_text(text))
 
     def columnar_view(self) -> Optional[tuple]:

@@ -45,14 +45,45 @@ class SubjectRef:
 
 
 @dataclass(frozen=True)
+class CaveatRef:
+    """A caveat attached to a relationship: name + partial context.  The
+    reference's embedded SpiceDB supports caveated tuples; the proxy's LR
+    path skips CONDITIONAL results (reference pkg/authz/lookups.go:85-88).
+    Context is carried as canonical JSON so the ref stays hashable."""
+    name: str
+    context_json: str = ""  # JSON object source; "" = empty context
+
+    def context(self) -> dict:
+        if not self.context_json:
+            return {}
+        import json
+        return json.loads(self.context_json)
+
+    @classmethod
+    def make(cls, name: str, context: Optional[dict] = None) -> "CaveatRef":
+        if not context:
+            return cls(name)
+        import json
+        return cls(name, json.dumps(context, sort_keys=True))
+
+    def __str__(self) -> str:
+        if self.context_json:
+            return f"[caveat:{self.name}:{self.context_json}]"
+        return f"[caveat:{self.name}]"
+
+
+@dataclass(frozen=True)
 class Relationship:
     resource: ObjectRef
     relation: str
     subject: SubjectRef
     expires_at: Optional[float] = None  # unix seconds; None = no expiration
+    caveat: Optional[CaveatRef] = None
 
     def rel_string(self) -> str:
         s = f"{self.resource}#{self.relation}@{self.subject}"
+        if self.caveat is not None:
+            s += str(self.caveat)
         if self.expires_at is not None:
             s += f"[expiration:{self.expires_at}]"
         return s
@@ -69,15 +100,27 @@ class Relationship:
 
 
 _EXPIRATION_SUFFIX = re.compile(r"\[expiration:([^\]]+)\]$")
+# `[caveat:name]` or `[caveat:name:{...json...}]`
+_CAVEAT_SUFFIX = re.compile(r"\[caveat:([A-Za-z_][\w/]*)(?::(\{.*\}))?\]$")
 
 
 def parse_relationship(rel: str) -> Relationship:
-    """Parse a concrete `type:id#rel@type:id(#rel)` string (no templates)."""
+    """Parse a concrete `type:id#rel@type:id(#rel)` string (no templates),
+    with optional `[caveat:...]` / `[expiration:...]` suffixes (any order)."""
     expires_at: Optional[float] = None
-    m = _EXPIRATION_SUFFIX.search(rel)
-    if m:
-        expires_at = float(m.group(1))
-        rel = rel[: m.start()]
+    caveat: Optional[CaveatRef] = None
+    for _ in range(2):
+        m = _EXPIRATION_SUFFIX.search(rel)
+        if m and expires_at is None:
+            expires_at = float(m.group(1))
+            rel = rel[: m.start()]
+            continue
+        m = _CAVEAT_SUFFIX.search(rel)
+        if m and caveat is None:
+            caveat = CaveatRef(m.group(1), m.group(2) or "")
+            rel = rel[: m.start()]
+            continue
+        break
     from ..rules.relstring import parse_rel_string  # local import, avoids cycle
     u = parse_rel_string(rel)
     for fieldval in (u.resource_type, u.resource_id, u.resource_relation,
@@ -94,6 +137,7 @@ def parse_relationship(rel: str) -> Relationship:
         relation=u.resource_relation,
         subject=SubjectRef(u.subject_type, u.subject_id, subject_relation),
         expires_at=expires_at,
+        caveat=caveat,
     )
 
 

@@ -1,0 +1,265 @@
+"""Caveat (conditional permission) support end-to-end.
+
+SURVEY.md hard part (c): the reference's embedded SpiceDB supports caveated
+tuples and the proxy skips CONDITIONAL LookupResources results
+(/root/reference/pkg/authz/lookups.go:85-88).  Coverage: schema DSL caveat
+blocks, caveated tuples in the store, tri-state (Kleene) evaluation in the
+oracle, CONDITIONAL in bulk-check results, LR skipping, and the jax://
+residual routing (differential vs the oracle, incl. deltas).
+"""
+
+import asyncio
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CaveatRef,
+    CheckRequest,
+    ObjectRef,
+    Permissionship,
+    RelationshipUpdate,
+    SchemaError,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+CAVEAT_SCHEMA = """
+caveat on_tuesday(day string) {
+  day == "tuesday"
+}
+caveat ip_allowlist(allowed list<string>, ip string) {
+  ip in allowed
+}
+definition user {}
+definition document {
+  relation viewer: user | user with on_tuesday
+  relation editor: user with ip_allowlist
+  relation banned: user | user with on_tuesday
+  permission view = viewer + editor
+  permission edit = editor - banned
+}
+"""
+
+
+def touch(*rels):
+    return [RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(r))
+            for r in rels]
+
+
+def delete(*rels):
+    return [RelationshipUpdate(UpdateOp.DELETE, parse_relationship(r))
+            for r in rels]
+
+
+class TestSchemaCaveats:
+    def test_parse_caveat_blocks(self):
+        s = sch.parse_schema(CAVEAT_SCHEMA)
+        assert set(s.caveats) == {"on_tuesday", "ip_allowlist"}
+        c = s.caveats["on_tuesday"]
+        assert c.params == (("day", "string"),)
+        assert c.body_src == 'day == "tuesday"'
+        assert s.caveats["ip_allowlist"].params == (
+            ("allowed", "list<string>"), ("ip", "string"))
+
+    def test_caveat_evaluate(self):
+        s = sch.parse_schema(CAVEAT_SCHEMA)
+        c = s.caveats["on_tuesday"]
+        assert c.evaluate({"day": "tuesday"}) is True
+        assert c.evaluate({"day": "monday"}) is False
+        assert c.evaluate({}) is None  # missing param -> CONDITIONAL
+
+    def test_unknown_trait_rejected(self):
+        with pytest.raises(SchemaError, match="unknown trait"):
+            sch.parse_schema("""
+definition user {}
+definition doc { relation viewer: user with nonexistent }
+""")
+
+    def test_with_and_expiration(self):
+        s = sch.parse_schema("""
+caveat c(x int) { x > 0 }
+definition user {}
+definition doc { relation viewer: user with c and expiration }
+""")
+        assert s.definitions["doc"].relations["viewer"][0].traits == \
+            ("c", "expiration")
+
+
+class TestRelStringCaveats:
+    def test_round_trip(self):
+        r = parse_relationship(
+            'document:d1#viewer@user:alice[caveat:on_tuesday:{"day": "tuesday"}]')
+        assert r.caveat == CaveatRef("on_tuesday", '{"day": "tuesday"}')
+        assert parse_relationship(r.rel_string()) == r
+
+    def test_caveat_without_context(self):
+        r = parse_relationship("document:d1#viewer@user:alice[caveat:on_tuesday]")
+        assert r.caveat == CaveatRef("on_tuesday")
+        assert r.caveat.context() == {}
+
+    def test_caveat_plus_expiration(self):
+        r = parse_relationship(
+            "document:d1#viewer@user:a[caveat:on_tuesday][expiration:99.5]")
+        assert r.caveat.name == "on_tuesday" and r.expires_at == 99.5
+        assert parse_relationship(r.rel_string()) == r
+
+
+def make_embedded(rels):
+    ep = EmbeddedEndpoint(sch.parse_schema(CAVEAT_SCHEMA))
+    if rels:
+        ep.store.write(touch(*rels))
+    return ep
+
+
+class TestOracleTristate:
+    def test_decided_true(self):
+        ep = make_embedded(
+            ['document:d#viewer@user:a[caveat:on_tuesday:{"day": "tuesday"}]'])
+        assert ep.evaluator.check3(ObjectRef("document", "d"), "view",
+                                   SubjectRef("user", "a")) == 2
+
+    def test_decided_false(self):
+        ep = make_embedded(
+            ['document:d#viewer@user:a[caveat:on_tuesday:{"day": "monday"}]'])
+        assert ep.evaluator.check3(ObjectRef("document", "d"), "view",
+                                   SubjectRef("user", "a")) == 0
+
+    def test_undecided_conditional(self):
+        ep = make_embedded(["document:d#viewer@user:a[caveat:on_tuesday]"])
+        assert ep.evaluator.check3(ObjectRef("document", "d"), "view",
+                                   SubjectRef("user", "a")) == 1
+
+    def test_definite_tuple_wins_union(self):
+        ep = make_embedded([
+            "document:d#viewer@user:a[caveat:on_tuesday]",
+            "document:d#viewer@user:a",
+        ])
+        assert ep.evaluator.check3(ObjectRef("document", "d"), "view",
+                                   SubjectRef("user", "a")) == 2
+
+    def test_exclusion_with_conditional_subtract(self):
+        # edit = editor - banned; banned is undecided -> MAYBE
+        ep = make_embedded([
+            'document:d#editor@user:a[caveat:ip_allowlist:'
+            '{"allowed": ["1.2.3.4"], "ip": "1.2.3.4"}]',
+            "document:d#banned@user:a[caveat:on_tuesday]",
+        ])
+        assert ep.evaluator.check3(ObjectRef("document", "d"), "edit",
+                                   SubjectRef("user", "a")) == 1
+
+    def test_bulk_check_conditional_permissionship(self):
+        ep = make_embedded(["document:d#viewer@user:a[caveat:on_tuesday]"])
+
+        async def run():
+            out = await ep.check_bulk_permissions([
+                CheckRequest(ObjectRef("document", "d"), "view",
+                             SubjectRef("user", "a")),
+                CheckRequest(ObjectRef("document", "d"), "view",
+                             SubjectRef("user", "b")),
+            ])
+            assert out[0].permissionship == \
+                Permissionship.CONDITIONAL_PERMISSION
+            assert not out[0].allowed  # conditional is NOT a pass
+            assert out[1].permissionship == Permissionship.NO_PERMISSION
+        asyncio.run(run())
+
+    def test_lr_skips_conditional(self):
+        # reference lookups.go:85-88: conditional results are skipped
+        ep = make_embedded([
+            "document:c#viewer@user:a[caveat:on_tuesday]",
+            'document:y#viewer@user:a[caveat:on_tuesday:{"day": "tuesday"}]',
+            "document:p#viewer@user:a",
+        ])
+
+        async def run():
+            ids = await ep.lookup_resources("document", "view",
+                                            SubjectRef("user", "a"))
+            assert sorted(ids) == ["p", "y"]
+        asyncio.run(run())
+
+
+def make_jax_pair(rels):
+    ep = JaxEndpoint(sch.parse_schema(CAVEAT_SCHEMA))
+    if rels:
+        ep.store.write(touch(*rels))
+    return ep, Evaluator(ep.schema, ep.store)
+
+
+def assert_jax_matches_oracle(ep, oracle, object_ids, subjects,
+                              permissions=("view", "edit")):
+    async def run():
+        for perm in permissions:
+            for s in subjects:
+                want_lr = sorted(oracle.lookup_resources("document", perm, s))
+                got_lr = sorted(await ep.lookup_resources("document", perm, s))
+                assert got_lr == want_lr, (perm, s, got_lr, want_lr)
+                reqs = [CheckRequest(ObjectRef("document", oid), perm, s)
+                        for oid in object_ids]
+                got = await ep.check_bulk_permissions(reqs)
+                for oid, res in zip(object_ids, got):
+                    want = oracle.check3(ObjectRef("document", oid), perm, s)
+                    got3 = {Permissionship.NO_PERMISSION: 0,
+                            Permissionship.CONDITIONAL_PERMISSION: 1,
+                            Permissionship.HAS_PERMISSION: 2}[res.permissionship]
+                    assert got3 == want, (perm, oid, s, got3, want)
+    asyncio.run(run())
+
+
+class TestJaxCaveatResiduals:
+    SUBJECTS = [SubjectRef("user", u) for u in ("a", "b", "nobody")]
+
+    def test_differential_with_caveats(self):
+        ep, oracle = make_jax_pair([
+            "document:d1#viewer@user:a[caveat:on_tuesday]",
+            'document:d2#viewer@user:a[caveat:on_tuesday:{"day": "tuesday"}]',
+            "document:d3#viewer@user:b",
+            'document:d3#editor@user:a[caveat:ip_allowlist:'
+            '{"allowed": [], "ip": "9.9.9.9"}]',
+        ])
+        assert_jax_matches_oracle(ep, oracle, ["d1", "d2", "d3"],
+                                  self.SUBJECTS)
+        # caveat-affected queries went to the host evaluator
+        assert ep.stats["oracle_residual_checks"] > 0
+
+    def test_no_caveats_no_residual(self):
+        ep, oracle = make_jax_pair(["document:d#viewer@user:a"])
+        assert_jax_matches_oracle(ep, oracle, ["d"], self.SUBJECTS)
+        assert ep.stats["oracle_residual_checks"] == 0
+        assert ep.stats["kernel_calls"] > 0
+
+    def test_delta_add_then_remove_caveat(self):
+        ep, oracle = make_jax_pair(["document:d#viewer@user:a"])
+        assert_jax_matches_oracle(ep, oracle, ["d"], self.SUBJECTS)
+        # first caveated tuple forces a rebuild + residual routing
+        ep.store.write(touch("document:d#viewer@user:b[caveat:on_tuesday]"))
+        assert_jax_matches_oracle(ep, oracle, ["d"], self.SUBJECTS)
+        # replacing the caveated tuple with a definite one
+        ep.store.write(touch("document:d#viewer@user:b"))
+        assert_jax_matches_oracle(ep, oracle, ["d"], self.SUBJECTS)
+        # deleting it
+        ep.store.write(delete("document:d#viewer@user:b"))
+        assert_jax_matches_oracle(ep, oracle, ["d"], self.SUBJECTS)
+
+    def test_replace_definite_with_caveated(self):
+        ep, oracle = make_jax_pair([
+            "document:d#viewer@user:a",
+            "document:x#viewer@user:b[caveat:on_tuesday]",
+        ])
+        assert_jax_matches_oracle(ep, oracle, ["d", "x"], self.SUBJECTS)
+        # same key flips definite -> caveated: device edge must disappear
+        ep.store.write(touch("document:d#viewer@user:a[caveat:on_tuesday]"))
+        assert_jax_matches_oracle(ep, oracle, ["d", "x"], self.SUBJECTS)
+
+    def test_bulk_load_text_with_caveats(self):
+        ep = JaxEndpoint(sch.parse_schema(CAVEAT_SCHEMA))
+        ep.store.bulk_load_text("\n".join([
+            "document:p#viewer@user:a",
+            "document:c#viewer@user:a[caveat:on_tuesday]",
+        ]))
+        oracle = Evaluator(ep.schema, ep.store)
+        assert_jax_matches_oracle(ep, oracle, ["p", "c"], self.SUBJECTS)
