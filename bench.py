@@ -1,34 +1,32 @@
 #!/usr/bin/env python
-"""Benchmark harness: authz checks/sec, jax:// kernel vs embedded oracle.
+"""Benchmark harness: authz checks/sec, jax:// kernel vs the python oracle.
 
 Prints ONE JSON line on stdout, ALWAYS (a global watchdog and a top-level
 exception handler both emit the line with an "error" field rather than
 dying with a traceback):
 
   {"metric": ..., "value": N, "unit": "checks/s", "vs_baseline": N,
-   "p99_list_filter_ms": N, "platform": ..., ...}
+   "p99_list_filter_ms": N, "platform": ..., "baseline": "python-oracle", ...}
 
-The headline config follows BASELINE.json: filtering list requests against a
-1M-tuple multi-tenant depth-4 graph, 256 concurrent list subjects, on one
-TPU chip.  `value` is effective authz checks/sec through LookupResources
-(each batched LR answers <permission> for every object of the listed type,
-i.e. batch_size x num_objects checks per kernel invocation); `vs_baseline`
-is the speedup over the embedded (host oracle) backend on the same workload;
-`p99_list_filter_ms` is the p99 latency of one batched list-filter call
-(BASELINE.md metric: "authz checks/sec + p99 list-filter latency").
+The headline config follows BASELINE.json config 5: filtering list requests
+against a 1M-tuple multi-tenant depth-4 graph, 256 *concurrent list
+requests* fused by the cross-request dispatcher (spicedb/dispatch.py) —
+i.e. the exact path production `jax://` traffic takes.  The direct
+batched-kernel number is reported alongside as `direct_batch_checks_per_s`.
 
-Robustness (round-1 postmortem: the harness died at first device_put with
-rc=1 when the TPU relay was down, and warmup conflated graph build + compile
-+ load with no checkpoints):
+Honesty note (VERDICT r2 weak-1): `vs_baseline` compares against THIS
+repo's single-threaded pure-Python oracle evaluator — NOT the reference's
+embedded Go SpiceDB, which cannot run in this image.  The payload carries
+`baseline: "python-oracle"` and a `baseline_note` so nobody mistakes the
+multiple for the BASELINE.md ">=50x vs embedded SpiceDB" target.
 
-- the TPU backend is probed in a SUBPROCESS with a bounded timeout and
-  retries; if it never comes up, the run falls back to JAX_PLATFORMS=cpu
-  and reports "platform": "cpu-fallback" — a measured number with a caveat
-  beats a dead harness;
-- warmup is staged (tiny-workload compile first, then the real config),
-  with per-stage stderr checkpoints and timings;
-- a watchdog emits the JSON line (with partial results if any) if the
-  whole run exceeds --deadline seconds.
+TPU bring-up (VERDICT r2 item 1): PJRT init in this sandbox has been
+observed to hang >540s, so the old 2x150s probes could never succeed.
+Now: ONE long probe (default 600s, BENCH_PROBE_TIMEOUT_S) in a subprocess
+with verbose libtpu logging captured; on failure the JSON carries a
+`tpu_probe` object with env vars, device-file existence, and the probe's
+stderr tail so "slow init" is distinguishable from "no device".  The probe
+verdict is cached on disk for 30 min so immediate re-runs don't re-pay it.
 
 All progress/diagnostics go to stderr; stdout carries only the JSON line.
 """
@@ -36,6 +34,7 @@ All progress/diagnostics go to stderr; stdout carries only the JSON line.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import statistics
@@ -47,6 +46,22 @@ import time
 _T0 = time.time()
 _STATE: dict = {"stage": "start", "partial": {}}
 _EMITTED = threading.Event()
+_PROBE_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".bench_probe.json")
+
+BASELINE_NOTE = (
+    "vs_baseline compares against this repo's single-threaded pure-Python "
+    "oracle evaluator, NOT the reference's embedded Go SpiceDB (not runnable "
+    "in this image). The BASELINE.md '>=50x vs embedded SpiceDB' target is "
+    "not established by this multiple."
+)
+
+
+def p99(times: list) -> float:
+    """Nearest-rank p99: ceil(0.99*n)-th order statistic — for n < 100
+    that is the max, never silently the p90."""
+    import math
+    return sorted(times)[math.ceil(0.99 * len(times)) - 1]
 
 
 def log(msg: str) -> None:
@@ -68,15 +83,20 @@ def emit(payload: dict) -> None:
 
 def emit_error(msg: str) -> None:
     p = _STATE["partial"]
-    emit({
+    out = {
         "metric": _STATE.get("metric", "authz checks/sec"),
         "value": p.get("value", 0.0),
         "unit": "checks/s",
         "vs_baseline": p.get("vs_baseline", 0.0),
         "p99_list_filter_ms": p.get("p99_list_filter_ms", 0.0),
         "platform": _STATE.get("platform", "unknown"),
+        "baseline": "python-oracle",
         "error": f"{msg} (stage={_STATE['stage']})",
-    })
+    }
+    out.update({k: v for k, v in p.items() if k not in out})
+    if "tpu_probe" in _STATE:
+        out["tpu_probe"] = _STATE["tpu_probe"]
+    emit(out)
 
 
 def start_watchdog(deadline_s: float) -> None:
@@ -92,31 +112,109 @@ def start_watchdog(deadline_s: float) -> None:
     t.start()
 
 
-def probe_backend(timeout_s: float, attempts: int) -> str:
+def collect_tpu_diagnostics(probe_stderr: str, note: str) -> dict:
+    """Everything the next round needs to tell 'slow PJRT init' from
+    'no TPU device in this sandbox'."""
+    env = {k: v for k, v in sorted(os.environ.items())
+           if k.split("_")[0] in ("TPU", "JAX", "PJRT", "LIBTPU", "XLA")
+           or k.startswith("CLOUD_TPU")}
+    paths = {}
+    for pat in ("/dev/accel*", "/dev/vfio/*", "/dev/tpu*", "/run/tpu*",
+                "/var/run/tpu*", "/tmp/libtpu_lockfile",
+                "/tmp/tpu_logs", "/sys/class/accel/*"):
+        paths[pat] = sorted(glob.glob(pat))
+    libtpu = None
+    try:
+        import importlib.util
+        spec = importlib.util.find_spec("libtpu")
+        libtpu = getattr(spec, "origin", None) if spec else None
+    except Exception as e:
+        libtpu = f"find_spec failed: {e!r}"
+    return {
+        "note": note,
+        "env": env,
+        "device_paths": {k: v for k, v in paths.items()},
+        "libtpu_module": libtpu,
+        "probe_stderr_tail": (probe_stderr or "").strip()[-2000:],
+    }
+
+
+def probe_backend(timeout_s: float, attempts: int,
+                  fresh: bool = False) -> str:
     """Check (in a subprocess, so a hung PJRT init can't wedge this
     process) whether the default JAX backend initializes.  Returns the
-    platform string to use: "" (keep driver default) or "cpu"."""
+    platform string to use: "" (keep driver default) or "cpu".
+
+    On failure, leaves a full diagnostic bundle in _STATE["tpu_probe"].
+    """
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return "cpu"
+    # 30-min disk cache: immediate re-runs (e.g. --all sweeps driven
+    # externally) must not re-pay a 600s probe.  A cached FAILURE is only
+    # trusted if it was probed at least as patiently as this run asks for.
+    try:
+        if fresh:
+            raise OSError("--fresh-probe: cache bypassed")
+        with open(_PROBE_CACHE) as f:
+            c = json.load(f)
+        if time.time() - c.get("ts", 0) < 1800 and (
+                c["verdict"] == ""
+                or c.get("probe_timeout", 0) >= timeout_s):
+            log(f"backend probe cached ({c['verdict']!r}, "
+                f"{time.time() - c['ts']:.0f}s old, probed at "
+                f"{c.get('probe_timeout', 0):.0f}s timeout)")
+            if c.get("diagnostics"):
+                _STATE["tpu_probe"] = c["diagnostics"]
+            return c["verdict"]
+    except (OSError, ValueError, KeyError):
+        pass
+
     code = ("import jax; d = jax.devices(); "
             "print(d[0].platform, len(d))")
+    probe_env = dict(os.environ)
+    # verbose libtpu/PJRT breadcrumbs so a hang leaves evidence in stderr
+    probe_env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
+    probe_env.setdefault("TPU_MIN_LOG_LEVEL", "0")
+    verdict, diagnostics = "cpu", None
     for i in range(attempts):
         stage(f"backend-probe attempt {i + 1}/{attempts} "
               f"(timeout {timeout_s:.0f}s)")
+        t0 = time.time()
         try:
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, text=True,
-                               timeout=timeout_s)
+                               timeout=timeout_s, env=probe_env)
             if r.returncode == 0 and r.stdout.strip():
-                log(f"backend probe ok: {r.stdout.strip()}")
-                return ""
+                log(f"backend probe ok in {time.time() - t0:.0f}s: "
+                    f"{r.stdout.strip()}")
+                verdict, diagnostics = "", None
+                break
             log(f"backend probe rc={r.returncode}: "
                 f"{(r.stderr or '').strip()[-300:]}")
-        except subprocess.TimeoutExpired:
-            log("backend probe timed out (PJRT init hang)")
+            diagnostics = collect_tpu_diagnostics(
+                r.stderr, f"probe exited rc={r.returncode} "
+                f"in {time.time() - t0:.0f}s")
+        except subprocess.TimeoutExpired as e:
+            err = e.stderr
+            if isinstance(err, bytes):
+                err = err.decode("utf-8", "replace")
+            log(f"backend probe timed out after {timeout_s:.0f}s "
+                f"(PJRT init hang)")
+            diagnostics = collect_tpu_diagnostics(
+                err or "", f"PJRT init did not complete within "
+                f"{timeout_s:.0f}s (hang, not error)")
         time.sleep(min(10.0, 2.0 * (i + 1)))
-    log("backend unavailable -> falling back to JAX_PLATFORMS=cpu")
-    return "cpu"
+    if verdict == "cpu":
+        log("backend unavailable -> falling back to JAX_PLATFORMS=cpu")
+        _STATE["tpu_probe"] = diagnostics
+    try:
+        with open(_PROBE_CACHE, "w") as f:
+            json.dump({"ts": time.time(), "verdict": verdict,
+                       "probe_timeout": timeout_s,
+                       "diagnostics": diagnostics}, f)
+    except OSError:
+        pass
+    return verdict
 
 
 def build_endpoint(workload, kind: str):
@@ -154,13 +252,15 @@ def warmup_tiny() -> None:
         f"(allowed sizes sample {[len(x) for x in out[:4]]})")
 
 
-def bench_jax(workload, batch: int, rounds: int) -> dict:
+def bench_jax(workload, batch: int, rounds: int, ep=None) -> dict:
+    """Direct batched-kernel path: one lookup_resources_batch per round."""
     import asyncio
 
     from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
 
-    stage("jax graph build + load")
-    ep = build_endpoint(workload, "jax")
+    if ep is None:
+        stage("jax graph build + load")
+        ep = build_endpoint(workload, "jax")
     subjects = [s for s in workload.subjects]
 
     def batch_subjects(r):
@@ -178,7 +278,7 @@ def bench_jax(workload, batch: int, rounds: int) -> dict:
         log(f"jax warmup {warm:.1f}s; {n_obj} objects of type "
             f"{workload.resource_type}; first batch allowed sizes sample "
             f"{[len(x) for x in first[:4]]}")
-        stage("jax timed rounds")
+        stage("jax timed rounds (direct batch)")
         times = []
         for r in range(rounds):
             t0 = time.time()
@@ -191,26 +291,29 @@ def bench_jax(workload, batch: int, rounds: int) -> dict:
         checks = batch * n_obj
         return {
             "per_batch_s": per_batch,
-            "p99_s": sorted(times)[max(0, int(len(times) * 0.99) - 1)],
+            "p99_s": p99(times),
             "checks_per_s": checks / per_batch,
             "objects": n_obj,
             "warmup_s": warm,
+            "endpoint": ep,
         }
 
     return asyncio.run(run())
 
 
 def bench_concurrent(workload, batch: int, rounds: int) -> dict:
-    """BASELINE config-5 shape: `batch` concurrent list requests, each
-    issuing a single-subject LookupResources, fused by the cross-request
-    dispatcher (spicedb/dispatch.py) into device batches."""
+    """BASELINE config-5 shape (the HEADLINE): `batch` concurrent list
+    requests, each issuing a single-subject LookupResources, fused by the
+    cross-request dispatcher (spicedb/dispatch.py) into device batches —
+    the exact path production `jax://` traffic takes."""
     import asyncio
 
     from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
     from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
 
     stage("jax concurrent-dispatch build + load")
-    ep = BatchingEndpoint(build_endpoint(workload, "jax"))
+    inner = build_endpoint(workload, "jax")
+    ep = BatchingEndpoint(inner)
     subjects = workload.subjects
 
     async def one_round(r):
@@ -223,19 +326,24 @@ def bench_concurrent(workload, batch: int, rounds: int) -> dict:
         return time.time() - t0
 
     async def run():
-        stage("jax concurrent warmup")
+        stage("dispatcher warmup (compile + first fused round)")
         await one_round(0)
-        stage("jax concurrent timed rounds")
-        times = [await one_round(r + 1) for r in range(rounds)]
+        stage("dispatcher timed rounds (concurrent list requests)")
+        times = []
+        for r in range(rounds):
+            times.append(await one_round(r + 1))
+            log(f"round {r + 1}/{rounds}: {times[-1] * 1000:.1f} ms")
         n_obj = len(ep.store.object_ids_of_type(workload.resource_type))
         per_round = statistics.median(times)
         log(f"dispatch stats: {ep.stats}")
         return {
             "per_round_s": per_round,
-            "p99_s": sorted(times)[max(0, int(len(times) * 0.99) - 1)],
+            "per_batch_s": per_round,
+            "p99_s": p99(times),
             "checks_per_s": batch * n_obj / per_round,
             "objects": n_obj,
             "fused_lookups": ep.stats["fused_lookups"],
+            "endpoint": inner,
         }
 
     return asyncio.run(run())
@@ -293,31 +401,43 @@ def main() -> None:
                     help="hard wall-clock cap; the JSON line is emitted "
                          "with partial results when it expires")
     ap.add_argument("--probe-timeout", type=float,
-                    default=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "150")))
-    ap.add_argument("--probe-attempts", type=int, default=2)
+                    default=float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "600")),
+                    help="ONE long probe: PJRT init has been observed to "
+                         "need >540s here; short retries are wasted time")
+    ap.add_argument("--probe-attempts", type=int, default=1)
+    ap.add_argument("--fresh-probe", action="store_true",
+                    default=os.environ.get("BENCH_FRESH_PROBE", "") == "1",
+                    help="ignore the cached probe verdict (env "
+                         "BENCH_FRESH_PROBE=1); use after fixing the TPU "
+                         "relay within the 30-min cache window")
     ap.add_argument("--no-fallback", action="store_true",
                     help="fail instead of falling back to CPU")
     ap.add_argument("--all", action="store_true",
                     help="run every config; headline metric stays the "
                          "default config")
-    ap.add_argument("--concurrent", action="store_true",
-                    help="drive the batch as N concurrent single-subject "
-                         "callers through the cross-request dispatcher "
-                         "instead of one explicit batched call")
+    ap.add_argument("--no-cold-users", action="store_true",
+                    help="skip the cold-users side-measurement")
+    ap.add_argument("--direct-only", action="store_true",
+                    help="headline = direct batched call instead of the "
+                         "concurrent dispatcher path")
     args = ap.parse_args()
 
     start_watchdog(args.deadline)
-    _STATE["metric"] = (f"authz checks/sec ({args.config}, {args.batch} "
-                        f"concurrent list subjects)")
+    path_desc = (f"{args.batch}-subject direct batched call"
+                 if args.direct_only else
+                 f"{args.batch} concurrent list requests, batched dispatch")
+    _STATE["metric"] = f"authz checks/sec ({args.config}, {path_desc})"
 
     # -- backend selection, BEFORE importing jax in this process ------------
-    platform = probe_backend(args.probe_timeout, args.probe_attempts)
+    cpu_requested = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    platform = probe_backend(args.probe_timeout, args.probe_attempts,
+                             fresh=args.fresh_probe)
     if platform == "cpu":
-        if args.no_fallback and os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        if args.no_fallback and not cpu_requested:
             emit_error("TPU backend unavailable and --no-fallback set")
             return
         os.environ["JAX_PLATFORMS"] = "cpu"
-        _STATE["platform"] = "cpu-fallback"
+        _STATE["platform"] = "cpu" if cpu_requested else "cpu-fallback"
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
     stage("jax import + device init")
@@ -332,50 +452,102 @@ def main() -> None:
 
     from spicedb_kubeapi_proxy_tpu.models import workloads as wl
 
-    def run_one(name):
+    def load_workload(name):
         fn_name, kw = CONFIGS[name]
         workload = getattr(wl, fn_name)(**kw)
         log(f"== config {name}: {len(workload.relationships)} tuples, "
             f"{len(workload.subjects)} subjects ==")
-        if args.concurrent:
-            jax_res = bench_concurrent(workload, args.batch, args.rounds)
-            jax_res.setdefault("per_batch_s", jax_res["per_round_s"])
-        else:
-            jax_res = bench_jax(workload, args.batch, args.rounds)
-        log(f"jax: {jax_res['checks_per_s']:.3g} checks/s"
-            f" ({jax_res['per_batch_s'] * 1000:.1f} ms / {args.batch}-batch,"
-            f" p99 {jax_res['p99_s'] * 1000:.1f} ms)")
-        _STATE["partial"].update({
-            "value": round(jax_res["checks_per_s"], 1),
-            "p99_list_filter_ms": round(jax_res["p99_s"] * 1000, 2),
-        })
-        oracle_res = bench_oracle(workload, args.oracle_queries)
-        log(f"oracle: {oracle_res['checks_per_s']:.3g} checks/s"
-            f" ({oracle_res['per_query_s'] * 1000:.1f} ms / query)")
-        return jax_res, oracle_res
+        return workload
 
+    def run_one(name, with_oracle=True):
+        workload = load_workload(name)
+        if args.direct_only:
+            head = bench_jax(workload, args.batch, args.rounds)
+            direct = head
+        else:
+            head = bench_concurrent(workload, args.batch, args.rounds)
+            # re-use the already-built+compiled endpoint for the direct run
+            direct = bench_jax(workload, args.batch, max(3, args.rounds // 2),
+                               ep=head["endpoint"])
+        log(f"headline (dispatcher): {head['checks_per_s']:.3g} checks/s "
+            f"({head['per_batch_s'] * 1000:.1f} ms / {args.batch} requests, "
+            f"p99 {head['p99_s'] * 1000:.1f} ms)")
+        log(f"direct batch: {direct['checks_per_s']:.3g} checks/s "
+            f"({direct['per_batch_s'] * 1000:.1f} ms, "
+            f"p99 {direct['p99_s'] * 1000:.1f} ms)")
+        if name == args.config:
+            # watchdog partials must only ever carry the headline config's
+            # numbers — a sweep config's value under the headline metric
+            # label would misattribute the workload
+            _STATE["partial"].update({
+                "value": round(head["checks_per_s"], 1),
+                "p99_list_filter_ms": round(head["p99_s"] * 1000, 2),
+                "direct_batch_checks_per_s": round(direct["checks_per_s"], 1),
+            })
+        oracle_res = None
+        if with_oracle:
+            oracle_res = bench_oracle(workload, args.oracle_queries)
+            log(f"oracle: {oracle_res['checks_per_s']:.3g} checks/s"
+                f" ({oracle_res['per_query_s'] * 1000:.1f} ms / query)")
+        return head, direct, oracle_res
+
+    cold_users_planned = (args.config == "multitenant-1m"
+                          and not args.no_cold_users)
     if args.all:
         for name in CONFIGS:
             if name == args.config:
                 continue
+            if name == "multitenant-1m-cold-users" and cold_users_planned:
+                continue  # measured once, as the side-measurement below
             try:
-                run_one(name)
+                run_one(name, with_oracle=False)
             except Exception as e:  # keep the headline alive
                 log(f"config {name} failed: {e!r}")
 
-    jax_res, oracle_res = run_one(args.config)
-    speedup = jax_res["checks_per_s"] / max(oracle_res["checks_per_s"], 1e-9)
+    head, direct, oracle_res = run_one(args.config)
+    speedup = head["checks_per_s"] / max(oracle_res["checks_per_s"], 1e-9)
     payload = {
         "metric": _STATE["metric"],
-        "value": round(jax_res["checks_per_s"], 1),
+        "value": round(head["checks_per_s"], 1),
         "unit": "checks/s",
         "vs_baseline": round(speedup, 2),
-        "p99_list_filter_ms": round(jax_res["p99_s"] * 1000, 2),
+        "p99_list_filter_ms": round(head["p99_s"] * 1000, 2),
         "platform": _STATE["platform"],
-        "objects": jax_res["objects"],
+        "objects": head["objects"],
         "batch": args.batch,
+        "fused_lookups": head.get("fused_lookups"),
+        "direct_batch_checks_per_s": round(direct["checks_per_s"], 1),
+        "direct_batch_p99_ms": round(direct["p99_s"] * 1000, 2),
         "oracle_checks_per_s": round(oracle_res["checks_per_s"], 1),
+        "baseline": "python-oracle",
+        "baseline_note": BASELINE_NOTE,
     }
+
+    # VERDICT r2 item 9: measure the cold-users config (50% of querying
+    # subjects have zero tuples) and record the warm/cold ratio — the
+    # phantom-column path must show no cliff.
+    if cold_users_planned:
+        try:
+            # free the warm 1M graph before building the cold one — holding
+            # both doubles peak memory for nothing
+            head.pop("endpoint", None)
+            direct.pop("endpoint", None)
+            cold_wl = load_workload("multitenant-1m-cold-users")
+            cold = bench_jax(cold_wl, args.batch, max(3, args.rounds // 2))
+            cold.pop("endpoint", None)
+            ratio = direct["per_batch_s"] / max(cold["per_batch_s"], 1e-9)
+            log(f"cold-users: {cold['checks_per_s']:.3g} checks/s "
+                f"(warm/cold per-batch ratio {ratio:.2f}; "
+                f"1.0 = no cliff)")
+            payload["cold_users_checks_per_s"] = round(cold["checks_per_s"], 1)
+            payload["cold_users_p99_ms"] = round(cold["p99_s"] * 1000, 2)
+            payload["warm_over_cold_batch_time"] = round(ratio, 3)
+        except Exception as e:
+            log(f"cold-users run failed: {e!r}")
+            payload["cold_users_error"] = repr(e)
+
+    if "tpu_probe" in _STATE:
+        payload["tpu_probe"] = _STATE["tpu_probe"]
     emit(payload)
 
 

@@ -290,6 +290,18 @@ class FakeKubeApiServer:
                      if _match_label_selector(
                          selector, o.get("metadata", {}).get("labels") or {})]
         if self._wants_table(req):
+            if self._wants_proto(req):
+                # proto-negotiated Table: each row's object is a nested
+                # `k8s\x00` envelope, like the real apiserver emits
+                from ..proxy import k8sproto
+                rows = [k8sproto.encode_unknown(
+                    "meta.k8s.io/v1", "PartialObjectMetadata",
+                    k8sproto.encode_object(
+                        "meta.k8s.io/v1", "PartialObjectMetadata",
+                        o.get("metadata", {}).get("name", ""),
+                        o.get("metadata", {}).get("namespace", "")))
+                    for o in items]
+                return self._proto_response(k8sproto.encode_table(rows))
             return json_response(200, self._to_table(t, items))
         if self._wants_proto(req):
             # serve the k8s protobuf envelope (magic + runtime.Unknown);

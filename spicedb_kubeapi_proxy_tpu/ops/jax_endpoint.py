@@ -401,7 +401,6 @@ class JaxEndpoint(PermissionsEndpoint):
         # current expiration per tuple key; heap entries not matching this
         # map are stale and skipped (lazy deletion)
         self._expiry_meta: dict = {}
-        self._known_extra_subjects: dict[str, set] = {}
         # caveat residuals (SURVEY.md hard part (c)): caveated tuples never
         # enter the device graph; queries on (type, permission) pairs whose
         # closure could traverse one are host-evaluated (tri-state oracle)
@@ -495,9 +494,9 @@ class JaxEndpoint(PermissionsEndpoint):
             if self._caveated_pairs else set())
         self._caveated_keys = (self.store.caveated_keys()
                                if self._caveated_pairs else set())
-        extra = {t: set(ids) for t, ids in self._known_extra_subjects.items()}
-        for t in self.schema.definitions:
-            extra.setdefault(t, set()).add(PHANTOM_ID)
+        # phantom-subject columns: every type gets one reserved column so
+        # first-contact subjects (zero tuples) still hit the kernel
+        extra = {t: {PHANTOM_ID} for t in self.schema.definitions}
         view = self.store.columnar_view() if self._graph_cls is _EllGraph \
             or self.mesh is not None else None
         if view is not None:
@@ -598,8 +597,12 @@ class JaxEndpoint(PermissionsEndpoint):
                     # a previously-definite tuple may have been replaced by
                     # a caveated one: its device edges must go
                     if key not in self._caveated_keys:
-                        graph.remove_key(key)
                         self._caveated_keys.add(key)
+                        if not graph.remove_key(key):
+                            # graph can't remove incrementally: stale
+                            # definite edges would over-grant
+                            needs_rebuild = True
+                            break
                 else:  # TOUCH, definite
                     self._set_expiry(key, u.rel.expires_at)
                     self._caveated_keys.discard(key)
@@ -846,19 +849,6 @@ class JaxEndpoint(PermissionsEndpoint):
 
     # -- maintenance hooks --------------------------------------------------
 
-    def register_query_subjects(self, subjects: dict) -> None:
-        """Pre-register subject ids ({type: iterable}) so queries about them
-        hit the kernel instead of the oracle fallback on first contact."""
-        with self._lock:
-            changed = False
-            for t, ids in subjects.items():
-                bucket_set = self._known_extra_subjects.setdefault(t, set())
-                new = set(ids) - bucket_set
-                if new:
-                    bucket_set.update(new)
-                    changed = True
-            if changed:
-                self._graph = None  # force rebuild on next query
     def force_rebuild(self) -> None:
         with self._lock:
             self._rebuild()

@@ -237,3 +237,15 @@ def encode_list(api_version: str, kind: str, items: list) -> bytes:
         raw += _ld(2, item)
     return encode_unknown(api_version, kind, raw,
                           "application/vnd.kubernetes.protobuf")
+
+
+def encode_table(row_objects: list) -> bytes:
+    """A serialized meta/v1 Table envelope.  `row_objects` are the per-row
+    object payloads — either plain serialized objects or full `k8s\\x00`
+    envelopes (the real apiserver nests envelopes; _table_row_meta handles
+    both).  Each becomes rows[i].object.raw (RawExtension field 1)."""
+    raw = _ld(1, b"")  # empty ListMeta
+    for obj in row_objects:
+        raw += _ld(3, _ld(3, _ld(1, obj)))  # row{ object{ raw } }
+    return encode_unknown("meta.k8s.io/v1", "Table", raw,
+                          "application/vnd.kubernetes.protobuf")

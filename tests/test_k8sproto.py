@@ -1,0 +1,324 @@
+"""Unit tests for the k8s protobuf envelope codec (proxy/k8sproto.py).
+
+Covers every public function, hostile/truncated input handling, and —
+crucially — cross-validation against the REAL protobuf runtime
+(google.protobuf with dynamically-built descriptors mirroring
+k8s.io/apimachinery runtime.Unknown + meta/v1 ObjectMeta), so the
+hand-rolled wire splicing can't drift into a private dialect.
+
+Reference behavior: pkg/authz/responsefilterer.go:241-301 (decode /
+re-encode negotiated protobuf bodies; reject unrecognized).
+"""
+
+import pytest
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+from spicedb_kubeapi_proxy_tpu.proxy import k8sproto
+from spicedb_kubeapi_proxy_tpu.proxy.k8sproto import (
+    K8S_MAGIC,
+    K8sProtoError,
+    decode_unknown,
+    encode_list,
+    encode_object,
+    encode_object_meta,
+    encode_table,
+    encode_unknown,
+    field_bytes,
+    filter_list_raw,
+    filter_table_raw,
+    is_k8s_proto,
+    iter_list_items,
+    object_meta,
+    records,
+)
+
+
+# -- dynamic descriptors mirroring the k8s proto layout -----------------------
+
+def _build_k8s_messages():
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "k8s_mirror.proto"
+    fdp.package = "k8smirror"
+    fdp.syntax = "proto2"
+
+    def msg(name, fields):
+        m = fdp.message_type.add()
+        m.name = name
+        for num, fname, ftype, extra in fields:
+            f = m.field.add()
+            f.name = fname
+            f.number = num
+            f.label = (descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+                       if extra.get("repeated")
+                       else descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL)
+            f.type = ftype
+            if "type_name" in extra:
+                f.type_name = ".k8smirror." + extra["type_name"]
+
+    T = descriptor_pb2.FieldDescriptorProto
+    msg("TypeMeta", [(1, "apiVersion", T.TYPE_STRING, {}),
+                     (2, "kind", T.TYPE_STRING, {})])
+    msg("Unknown", [(1, "typeMeta", T.TYPE_MESSAGE, {"type_name": "TypeMeta"}),
+                    (2, "raw", T.TYPE_BYTES, {}),
+                    (3, "contentEncoding", T.TYPE_STRING, {}),
+                    (4, "contentType", T.TYPE_STRING, {})])
+    # meta/v1 ObjectMeta prefix: name=1, generateName=2, namespace=3,
+    # plus a high-numbered field to prove unknown fields survive splicing
+    msg("ObjectMeta", [(1, "name", T.TYPE_STRING, {}),
+                       (2, "generateName", T.TYPE_STRING, {}),
+                       (3, "namespace", T.TYPE_STRING, {}),
+                       (11, "labels_blob", T.TYPE_BYTES, {})])
+    msg("Object", [(1, "metadata", T.TYPE_MESSAGE, {"type_name": "ObjectMeta"}),
+                   (2, "spec_blob", T.TYPE_BYTES, {})])
+    msg("ListMeta", [(2, "resourceVersion", T.TYPE_STRING, {})])
+    msg("List", [(1, "metadata", T.TYPE_MESSAGE, {"type_name": "ListMeta"}),
+                 (2, "items", T.TYPE_MESSAGE,
+                  {"type_name": "Object", "repeated": True})])
+    msg("RawExtension", [(1, "raw", T.TYPE_BYTES, {})])
+    msg("TableRow", [(1, "cells", T.TYPE_MESSAGE,
+                      {"type_name": "RawExtension", "repeated": True}),
+                     (3, "object", T.TYPE_MESSAGE,
+                      {"type_name": "RawExtension"})])
+    msg("Table", [(1, "metadata", T.TYPE_MESSAGE, {"type_name": "ListMeta"}),
+                  (3, "rows", T.TYPE_MESSAGE,
+                   {"type_name": "TableRow", "repeated": True})])
+
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    return {name: message_factory.GetMessageClass(
+        pool.FindMessageTypeByName(f"k8smirror.{name}"))
+        for name in ("TypeMeta", "Unknown", "ObjectMeta", "Object",
+                     "ListMeta", "List", "RawExtension", "TableRow", "Table")}
+
+
+M = _build_k8s_messages()
+
+
+def real_object(name, namespace="", extra=b""):
+    o = M["Object"]()
+    o.metadata.name = name
+    if namespace:
+        o.metadata.namespace = namespace
+    if extra:
+        o.metadata.labels_blob = extra
+    return o
+
+
+def real_envelope(api_version, kind, raw, content_type=""):
+    u = M["Unknown"]()
+    u.typeMeta.apiVersion = api_version
+    u.typeMeta.kind = kind
+    u.raw = raw
+    if content_type:
+        u.contentType = content_type
+    return K8S_MAGIC + u.SerializeToString()
+
+
+# -- wire primitives ----------------------------------------------------------
+
+class TestRecords:
+    def test_all_wire_types(self):
+        # field1 varint, field2 LD, field3 fixed64, field4 fixed32
+        buf = (b"\x08\x96\x01"              # 1: varint 150
+               b"\x12\x03abc"               # 2: LD "abc"
+               b"\x19" + b"\x11" * 8 +      # 3: fixed64
+               b"\x25" + b"\x22" * 4)       # 4: fixed32
+        recs = list(records(buf))
+        assert [(f, wt) for f, wt, _, _, _ in recs] == [
+            (1, 0), (2, 2), (3, 1), (4, 5)]
+        assert recs[0][4] == 150
+        assert recs[1][4] == b"abc"
+        assert recs[2][4] == b"\x11" * 8
+        assert recs[3][4] == b"\x22" * 4
+        # start/end offsets tile the buffer exactly
+        assert recs[0][2] == 0
+        assert all(recs[i][3] == recs[i + 1][2] for i in range(3))
+        assert recs[-1][3] == len(buf)
+
+    def test_matches_real_protobuf_offsets(self):
+        o = real_object("p0", "team-a", extra=b"\x00\xffblob")
+        raw = o.SerializeToString()
+        # re-concatenating every record reproduces the buffer byte-exactly
+        out = b"".join(raw[s:e] for _, _, s, e, _ in records(raw))
+        assert out == raw
+
+    @pytest.mark.parametrize("buf,err", [
+        (b"\x08", "truncated varint"),                 # key then nothing
+        (b"\x12\x05ab", "truncated length-delimited"),  # LD len 5, 2 bytes
+        (b"\x19\x00", "truncated fixed64"),
+        (b"\x25\x00", "truncated fixed32"),
+        (b"\x0b", "unsupported wire type"),             # wt=3 group start
+        (b"\x0c", "unsupported wire type"),             # wt=4 group end
+        (b"\x08" + b"\xff" * 10 + b"\x01", "varint too long"),
+    ])
+    def test_hostile_input(self, buf, err):
+        with pytest.raises(K8sProtoError, match=err):
+            list(records(buf))
+
+    def test_field_bytes_last_occurrence(self):
+        buf = b"\x12\x01a" + b"\x12\x01b" + b"\x08\x01"
+        assert field_bytes(buf, 2) == b"b"
+        assert field_bytes(buf, 1) is None  # varint, not LD
+        assert field_bytes(buf, 9) is None
+
+
+# -- envelope -----------------------------------------------------------------
+
+class TestEnvelope:
+    def test_is_k8s_proto(self):
+        assert is_k8s_proto(K8S_MAGIC + b"anything")
+        assert not is_k8s_proto(b'{"kind":"Pod"}')
+        assert not is_k8s_proto(b"")
+
+    def test_decode_real_unknown(self):
+        body = real_envelope("v1", "PodList", b"rawbytes",
+                             "application/vnd.kubernetes.protobuf")
+        av, kind, raw, ct = decode_unknown(body)
+        assert (av, kind, raw, ct) == (
+            "v1", "PodList", b"rawbytes",
+            "application/vnd.kubernetes.protobuf")
+
+    def test_encode_parsed_by_real_protobuf(self):
+        body = encode_unknown("apps/v1", "DeploymentList", b"\x01\x02",
+                              "application/vnd.kubernetes.protobuf")
+        u = M["Unknown"]()
+        u.ParseFromString(body[len(K8S_MAGIC):])
+        assert u.typeMeta.apiVersion == "apps/v1"
+        assert u.typeMeta.kind == "DeploymentList"
+        assert u.raw == b"\x01\x02"
+        assert u.contentType == "application/vnd.kubernetes.protobuf"
+
+    def test_round_trip(self):
+        body = encode_unknown("v1", "Pod", b"payload")
+        assert decode_unknown(body) == ("v1", "Pod", b"payload", "")
+
+    def test_missing_magic(self):
+        with pytest.raises(K8sProtoError, match="magic"):
+            decode_unknown(b"\x0a\x04")
+
+    def test_truncated_envelope(self):
+        good = real_envelope("v1", "Pod", b"x" * 50)
+        with pytest.raises(K8sProtoError):
+            decode_unknown(good[:-10])
+
+
+# -- object meta --------------------------------------------------------------
+
+class TestObjectMeta:
+    def test_real_object(self):
+        raw = real_object("p1", "team-b").SerializeToString()
+        assert object_meta(raw) == ("team-b", "p1")
+
+    def test_cluster_scoped(self):
+        raw = real_object("node-1").SerializeToString()
+        assert object_meta(raw) == ("", "node-1")
+
+    def test_no_metadata(self):
+        assert object_meta(b"") == ("", "")
+
+    def test_encode_object_meta_parsed_by_real(self):
+        raw = encode_object_meta("p0", "ns0")
+        om = M["ObjectMeta"]()
+        om.ParseFromString(raw)
+        assert (om.name, om.namespace) == ("p0", "ns0")
+
+    def test_encode_object_round_trip(self):
+        raw = encode_object("v1", "Pod", "p0", "ns0")
+        assert object_meta(raw) == ("ns0", "p0")
+
+
+# -- list filtering -----------------------------------------------------------
+
+class TestListFilter:
+    def _real_list(self, specs):
+        lst = M["List"]()
+        lst.metadata.resourceVersion = "42"
+        for name, ns in specs:
+            lst.items.append(real_object(name, ns, extra=b"\xde\xad" * 8))
+        return lst.SerializeToString()
+
+    def test_filter_drops_disallowed(self):
+        raw = self._real_list([("p0", "a"), ("p1", "b"), ("p2", "a")])
+        out = filter_list_raw(raw, lambda ns, n: ns == "a")
+        lst = M["List"]()
+        lst.ParseFromString(out)
+        assert [i.metadata.name for i in lst.items] == ["p0", "p2"]
+        assert lst.metadata.resourceVersion == "42"  # ListMeta preserved
+
+    def test_allowed_items_byte_exact(self):
+        raw = self._real_list([("p0", "a"), ("p1", "b")])
+        out = filter_list_raw(raw, lambda ns, n: True)
+        assert out == raw  # nothing re-encoded, verbatim copy
+
+    def test_filter_all_gone(self):
+        raw = self._real_list([("p0", "a")])
+        out = filter_list_raw(raw, lambda ns, n: False)
+        lst = M["List"]()
+        lst.ParseFromString(out)
+        assert len(lst.items) == 0
+        assert lst.metadata.resourceVersion == "42"
+
+    def test_iter_list_items(self):
+        raw = self._real_list([("p0", "a"), ("p1", "b")])
+        items = list(iter_list_items(raw))
+        assert [object_meta(i) for i in items] == [("a", "p0"), ("b", "p1")]
+
+    def test_encode_list_round_trip(self):
+        body = encode_list("v1", "PodList", [
+            encode_object("v1", "Pod", "p0", "a"),
+            encode_object("v1", "Pod", "p1", "b")])
+        av, kind, raw, ct = decode_unknown(body)
+        assert (av, kind) == ("v1", "PodList")
+        assert [object_meta(i) for i in iter_list_items(raw)] == [
+            ("a", "p0"), ("b", "p1")]
+
+    def test_truncated_list_raises(self):
+        raw = self._real_list([("p0", "a")])
+        with pytest.raises(K8sProtoError):
+            filter_list_raw(raw[:-3], lambda ns, n: True)
+
+
+# -- table filtering ----------------------------------------------------------
+
+class TestTableFilter:
+    def _real_table(self, specs, enveloped=True):
+        t = M["Table"]()
+        t.metadata.resourceVersion = "7"
+        for name, ns in specs:
+            row = t.rows.add()
+            obj_raw = real_object(name, ns).SerializeToString()
+            if enveloped:
+                obj_raw = real_envelope("meta.k8s.io/v1",
+                                        "PartialObjectMetadata", obj_raw)
+            row.object.raw = obj_raw
+        return t.SerializeToString()
+
+    @pytest.mark.parametrize("enveloped", [True, False])
+    def test_filter_rows(self, enveloped):
+        raw = self._real_table([("p0", "a"), ("p1", "b")], enveloped)
+        out = filter_table_raw(raw, lambda ns, n: ns == "a")
+        t = M["Table"]()
+        t.ParseFromString(out)
+        assert len(t.rows) == 1
+        assert t.metadata.resourceVersion == "7"
+
+    def test_rows_without_object_kept(self):
+        t = M["Table"]()
+        t.rows.add()  # no object at all -> ("", "")
+        out = filter_table_raw(t.SerializeToString(),
+                               lambda ns, n: (ns, n) == ("", ""))
+        t2 = M["Table"]()
+        t2.ParseFromString(out)
+        assert len(t2.rows) == 1
+
+    def test_encode_table_round_trip(self):
+        body = encode_table([
+            real_envelope("meta.k8s.io/v1", "PartialObjectMetadata",
+                          real_object("p0", "a").SerializeToString()),
+            real_object("p1", "b").SerializeToString()])
+        av, kind, raw, ct = decode_unknown(body)
+        assert kind == "Table"
+        out = filter_table_raw(raw, lambda ns, n: n == "p1")
+        t = M["Table"]()
+        t.ParseFromString(out)
+        assert len(t.rows) == 1
