@@ -33,6 +33,8 @@ from .rulesel import single_pre_filter_rule
 from .watch import WatchTracker, run_watch
 
 PREFILTER_TIMEOUT = 10.0
+# max not-yet-authorized frames buffered per watch (overflow drops oldest)
+WATCH_BUFFER_CAP = 1024
 
 
 class FilterError(Exception):
@@ -313,6 +315,10 @@ class WatchResponseFilterer(ResponseFilterer):
         pump1 = asyncio.ensure_future(pump_upstream())
         pump2 = asyncio.ensure_future(pump_changes())
         allowed: set = set()
+        # bounded not-yet-authorized frame buffer: a watch on a resource
+        # the subject will never be granted must not grow memory without
+        # limit — overflow drops the OLDEST buffered frame (the client
+        # re-lists on resume, matching kube watch semantics)
         buffered: dict = {}
         try:
             while True:
@@ -358,6 +364,14 @@ class WatchResponseFilterer(ResponseFilterer):
                         yield raw
                     else:
                         buffered[nn] = raw
+                        if len(buffered) > WATCH_BUFFER_CAP:
+                            victim = next(iter(buffered))
+                            buffered.pop(victim)
+                            import logging
+                            logging.getLogger(__name__).warning(
+                                "watch buffer cap %d exceeded; dropped "
+                                "buffered frame for %s", WATCH_BUFFER_CAP,
+                                victim)
                 # DELETED / BOOKMARK events: the reference neither replays nor
                 # buffers them (only ADDED/MODIFIED are handled)
         finally:

@@ -39,14 +39,13 @@ async def run_watch(endpoint: PermissionsEndpoint, tracker: WatchTracker,
     coroutine, so tuple writes racing the watch setup are not lost."""
     if watcher is None:
         watcher = endpoint.watch([config.rel.resource_type])
-    loop = asyncio.get_event_loop()
     try:
         while True:
-            update = await loop.run_in_executor(None, watcher.poll, 0.5)
+            # push-based: the store/stream wakes this coroutine directly
+            # (WatchQueue.next) — no executor thread, no poll interval
+            update = await watcher.next()
             if update is None:
-                if watcher.closed:
-                    return
-                continue
+                return  # closed and drained
             for u in update.updates:
                 resource_id = u.rel.resource.id
                 result = await endpoint.check_permission(CheckRequest(

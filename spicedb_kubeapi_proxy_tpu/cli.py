@@ -33,6 +33,8 @@ from .proxy.authn import (
     AuthenticatorChain,
     ClientCertAuthenticator,
     HeaderAuthenticator,
+    OIDCAuthenticator,
+    RequestHeaderAuthenticator,
     TokenFileAuthenticator,
 )
 from .proxy.httpcore import Transport
@@ -112,6 +114,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--token-auth-file", default="",
                    help="CSV file of static bearer tokens "
                         "(token,user,uid,groups)")
+    # front-proxy (request-header) authn (reference authn.go:121-153)
+    p.add_argument("--requestheader-client-ca-file", default="",
+                   help="CA bundle; X-Remote-* identity headers are "
+                        "trusted only from clients whose certificate "
+                        "verifies against it")
+    p.add_argument("--requestheader-allowed-names", default="",
+                   help="comma-separated CNs allowed to front-proxy; "
+                        "empty = any CN under the requestheader CA")
+    p.add_argument("--requestheader-username-headers",
+                   default="X-Remote-User")
+    p.add_argument("--requestheader-group-headers",
+                   default="X-Remote-Group")
+    p.add_argument("--requestheader-extra-headers-prefix",
+                   default="X-Remote-Extra-")
+    # OIDC bearer authn with static JWKS (no egress for discovery)
+    p.add_argument("--oidc-issuer-url", default="")
+    p.add_argument("--oidc-client-id", default="")
+    p.add_argument("--oidc-jwks-file", default="",
+                   help="static JWKS (RFC 7517) file with the issuer's "
+                        "signing keys; required with --oidc-issuer-url")
+    p.add_argument("--oidc-username-claim", default="sub")
+    p.add_argument("--oidc-groups-claim", default="groups")
+    p.add_argument("--oidc-username-prefix", default="")
 
     p.add_argument("-v", "--verbosity", type=int, default=3,
                    help="log verbosity (reference defaults to 3)")
@@ -201,9 +226,42 @@ def complete(args: argparse.Namespace,
             cert_file, key_file = kubecfg.generate_self_signed_cert(
                 args.cert_dir, hosts=[args.bind_address])
         ssl_context = kubecfg.serving_ssl_context(
-            cert_file, key_file, client_ca_file=args.client_ca_file)
+            cert_file, key_file, client_ca_file=args.client_ca_file,
+            extra_client_ca_files=(args.requestheader_client_ca_file,))
+        if args.requestheader_client_ca_file:
+            # requestheader outranks plain client-cert authn, matching the
+            # k8s union authenticator's order
+            try:
+                authenticators.append(RequestHeaderAuthenticator(
+                    args.requestheader_client_ca_file,
+                    allowed_names=tuple(
+                        n for n in
+                        args.requestheader_allowed_names.split(",") if n),
+                    username_headers=tuple(
+                        args.requestheader_username_headers.split(",")),
+                    group_headers=tuple(
+                        args.requestheader_group_headers.split(",")),
+                    extra_prefixes=tuple(
+                        args.requestheader_extra_headers_prefix.split(","))))
+            except (OSError, ValueError) as e:
+                raise OptionsError(
+                    f"couldn't load requestheader CA: {e}") from e
         if args.client_ca_file:
             authenticators.append(ClientCertAuthenticator())
+    if args.oidc_issuer_url:
+        if not args.oidc_jwks_file:
+            raise OptionsError(
+                "--oidc-jwks-file is required with --oidc-issuer-url "
+                "(no egress for issuer discovery)")
+        try:
+            authenticators.append(OIDCAuthenticator(
+                args.oidc_issuer_url, args.oidc_client_id,
+                args.oidc_jwks_file,
+                username_claim=args.oidc_username_claim,
+                groups_claim=args.oidc_groups_claim,
+                username_prefix=args.oidc_username_prefix))
+        except (OSError, ValueError) as e:
+            raise OptionsError(f"couldn't load OIDC JWKS: {e}") from e
     if args.token_auth_file:
         try:
             authenticators.append(TokenFileAuthenticator(args.token_auth_file))

@@ -80,6 +80,7 @@ class Request:
     # request-scoped context values (request_info, user, response filterer…)
     context: dict = field(default_factory=dict)
     peer_cert: Optional[dict] = None  # TLS client certificate, if any
+    peer_cert_der: Optional[bytes] = None  # same certificate, DER bytes
 
     @property
     def path(self) -> str:
@@ -160,6 +161,13 @@ class H11Transport(Transport):
                                         "accept-encoding")]
         headers.append(("Host", f"{self.host}:{self.port}"))
         headers.append(("Content-Length", str(len(req.body))))
+        # the transport owns encoding negotiation (reference activity.go:
+        # 208-215, server.go:98-108): ask for gzip on its own behalf and
+        # decompress transparently below, so callers always see plaintext.
+        # Watch streams are relayed frame-by-frame without buffering, so
+        # no gzip there.
+        if "watch" not in urlsplit(req.target).query:
+            headers.append(("Accept-Encoding", "gzip"))
 
         writer.write(conn.send(h11.Request(
             method=req.method.encode(), target=req.target.encode(),
@@ -268,13 +276,14 @@ class HttpServer:
 
     async def _serve_conn(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
-        peer_cert = None
+        peer_cert = peer_cert_der = None
         ssl_obj = writer.get_extra_info("ssl_object")
         if ssl_obj is not None:
             try:
                 peer_cert = ssl_obj.getpeercert()
+                peer_cert_der = ssl_obj.getpeercert(True)
             except ValueError:
-                peer_cert = None
+                peer_cert = peer_cert_der = None
         conn = h11.Connection(our_role=h11.SERVER)
         try:
             while True:
@@ -289,6 +298,7 @@ class HttpServer:
                     headers=Headers([(k.decode(), v.decode())
                                      for k, v in event.headers]),
                     peer_cert=peer_cert,
+                    peer_cert_der=peer_cert_der,
                 )
                 body = bytearray()
                 while True:

@@ -259,12 +259,18 @@ def generate_self_signed_cert(cert_dir: str, pair_name: str = "tls",
 
 
 def serving_ssl_context(cert_file: str, key_file: str,
-                        client_ca_file: str = "") -> ssl.SSLContext:
+                        client_ca_file: str = "",
+                        extra_client_ca_files: tuple = ()) -> ssl.SSLContext:
     """Server-side TLS context; with a client CA, client certificates are
-    requested and verified (kube client-cert authn)."""
+    requested and verified (kube client-cert authn).  Extra CAs (e.g. the
+    front-proxy requestheader CA) join the handshake trust store; the
+    authenticators decide per-CA trust afterwards."""
     ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ssl_ctx.load_cert_chain(cert_file, key_file)
-    if client_ca_file:
-        ssl_ctx.load_verify_locations(client_ca_file)
+    ca_files = ([client_ca_file] if client_ca_file else []) + \
+        [f for f in extra_client_ca_files if f]
+    for ca in ca_files:
+        ssl_ctx.load_verify_locations(ca)
+    if ca_files:
         ssl_ctx.verify_mode = ssl.CERT_OPTIONAL
     return ssl_ctx

@@ -26,6 +26,7 @@ import grpc.aio
 
 from . import wire
 from .endpoints import PermissionsEndpoint
+from .store import WatchQueue
 from .types import (
     AlreadyExistsError,
     CheckRequest,
@@ -58,16 +59,15 @@ def _map_rpc_error(e: grpc.RpcError) -> Exception:
     return RemoteEndpointError(code, details or "")
 
 
-class _RemoteWatcher:
+class _RemoteWatcher(WatchQueue):
     """Adapter: a background sync-gRPC Watch stream feeding the same
-    poll()/close() surface as store.Watcher (consumed via run_in_executor
-    by authz/watch.py)."""
+    poll()/next()/close() surface as store.Watcher (the async consumer in
+    authz/watch.py awaits next() directly — the stream thread wakes it
+    through the queue, no polling)."""
 
     def __init__(self, target: str, object_types: Optional[list],
                  channel_factory):
-        self._events: list = []
-        self._cond = threading.Condition()
-        self.closed = False
+        super().__init__()
         self._channel = channel_factory()
         self._thread = threading.Thread(
             target=self._run, args=(object_types,), daemon=True)
@@ -83,29 +83,15 @@ class _RemoteWatcher:
                 revision, updates = wire.dec_watch_response(payload)
                 if not updates:
                     continue
-                with self._cond:
-                    self._events.append(WatchUpdate(updates=tuple(updates),
-                                                    revision=revision))
-                    self._cond.notify_all()
+                self._push(WatchUpdate(updates=tuple(updates),
+                                       revision=revision))
         except grpc.RpcError:
             pass  # channel closed / server gone: surface as closed watcher
         finally:
-            with self._cond:
-                self.closed = True
-                self._cond.notify_all()
-
-    def poll(self, timeout: Optional[float] = None) -> Optional[WatchUpdate]:
-        with self._cond:
-            if not self._events and not self.closed:
-                self._cond.wait(timeout)
-            if self._events:
-                return self._events.pop(0)
-            return None
+            self._mark_closed()
 
     def close(self) -> None:
-        with self._cond:
-            self.closed = True
-            self._cond.notify_all()
+        self._mark_closed()
         self._channel.close()
 
 

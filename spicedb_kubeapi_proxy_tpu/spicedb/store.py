@@ -45,28 +45,45 @@ class WriteLimitExceededError(Exception):
     pass
 
 
-class Watcher:
-    """A subscription to relationship updates; drained via poll()."""
+class WatchQueue:
+    """Thread-safe event drain with BOTH a blocking poll() and an
+    asyncio-native next() (no polling thread, no added latency — the
+    publisher wakes async consumers through call_soon_threadsafe).
+    Publishers may run on any thread; multiple async consumers on
+    multiple loops are supported."""
 
-    def __init__(self, store: "TupleStore", object_types: Optional[set]):
-        self._store = store
-        self._object_types = object_types
-        self._events: list[WatchUpdate] = []
+    def __init__(self):
+        self._events: list = []
         self._cond = threading.Condition()
         self.closed = False
+        self._waiters: list = []  # (loop, future) pairs
 
-    def _publish(self, update: WatchUpdate) -> None:
-        if self._object_types:
-            updates = tuple(u for u in update.updates
-                            if u.rel.resource.type in self._object_types)
-            if not updates:
-                return
-            update = WatchUpdate(updates=updates, revision=update.revision)
+    def _push(self, item) -> None:
         with self._cond:
-            self._events.append(update)
+            self._events.append(item)
             self._cond.notify_all()
+            self._wake_waiters_locked()
 
-    def poll(self, timeout: Optional[float] = None) -> Optional[WatchUpdate]:
+    def _mark_closed(self) -> None:
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+            self._wake_waiters_locked()
+
+    def _wake_waiters_locked(self) -> None:
+        waiters, self._waiters = self._waiters, []
+        for loop, fut in waiters:
+            try:
+                loop.call_soon_threadsafe(self._resolve, fut)
+            except RuntimeError:
+                pass  # consumer's loop already closed
+
+    @staticmethod
+    def _resolve(fut) -> None:
+        if not fut.done():
+            fut.set_result(None)
+
+    def poll(self, timeout: Optional[float] = None):
         """Block until the next batch (or timeout/close); None on timeout."""
         with self._cond:
             if not self._events and not self.closed:
@@ -75,10 +92,55 @@ class Watcher:
                 return self._events.pop(0)
             return None
 
+    async def next(self, timeout: Optional[float] = None):
+        """Await the next batch without blocking the event loop; None on
+        timeout or when the watcher is closed and drained."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        while True:
+            with self._cond:
+                if self._events:
+                    return self._events.pop(0)
+                if self.closed:
+                    return None
+                fut = loop.create_future()
+                self._waiters.append((loop, fut))
+            try:
+                if timeout is None:
+                    await fut
+                else:
+                    try:
+                        await asyncio.wait_for(fut, timeout)
+                    except asyncio.TimeoutError:
+                        return None
+            finally:
+                with self._cond:
+                    try:
+                        self._waiters.remove((loop, fut))
+                    except ValueError:
+                        pass
+
+
+class Watcher(WatchQueue):
+    """A subscription to relationship updates; drained via poll()/next()."""
+
+    def __init__(self, store: "TupleStore", object_types: Optional[set]):
+        super().__init__()
+        self._store = store
+        self._object_types = object_types
+
+    def _publish(self, update: WatchUpdate) -> None:
+        if self._object_types:
+            updates = tuple(u for u in update.updates
+                            if u.rel.resource.type in self._object_types)
+            if not updates:
+                return
+            update = WatchUpdate(updates=updates, revision=update.revision)
+        self._push(update)
+
     def close(self) -> None:
-        with self._cond:
-            self.closed = True
-            self._cond.notify_all()
+        self._mark_closed()
         self._store._unsubscribe(self)
 
 

@@ -8,10 +8,12 @@ stubs (no authzed package and no egress in this environment), the handful
 of messages are encoded/decoded directly in the protobuf wire format:
 varint tags, length-delimited submessages.
 
-Field numbers follow the public authzed.api.v1 protos (best effort —
-wire compatibility with a real SpiceDB cannot be integration-tested in
-this offline environment; client and server in this repo are
-self-consistent and round-trip tested either way):
+Field numbers follow the public authzed.api.v1 protos.  Wire compatibility
+is pinned by golden fixtures (tests/test_wire_golden.py): literal
+hand-assembled byte strings plus cross-validation against the real
+protobuf runtime via dynamic descriptors mirroring authzed.api.v1 —
+byte-identical encoding for the request messages, parse-identical both
+directions for the rest:
 
   ObjectReference        { object_type=1, object_id=2 }
   SubjectReference       { object=1, optional_relation=2 }

@@ -1,0 +1,407 @@
+"""Authenticator tests: front-proxy (request-header) CA trust and OIDC
+static-JWKS bearer validation (VERDICT r2 item 5; reference
+pkg/proxy/authn.go:17-53,121-153).
+
+The critical property: a spoofed `X-Remote-User` header with no verified
+front-proxy certificate — or one signed by the WRONG CA — authenticates as
+nobody.
+"""
+
+import base64
+import datetime
+import json
+import time
+
+import pytest
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, rsa
+from cryptography.x509.oid import NameOID
+
+from spicedb_kubeapi_proxy_tpu.proxy.authn import (
+    AuthenticatorChain,
+    HeaderAuthenticator,
+    OIDCAuthenticator,
+    RequestHeaderAuthenticator,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import Headers, Request
+
+
+# -- cert fixtures ------------------------------------------------------------
+
+def make_ca(cn: str):
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                           critical=True)
+            .sign(key, hashes.SHA256()))
+    return key, cert
+
+
+def issue_client_cert(ca_key, ca_cert, cn: str, not_after_minutes=60):
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(x509.Name(
+                [x509.NameAttribute(NameOID.COMMON_NAME, cn)]))
+            .issuer_name(ca_cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(
+                minutes=abs(not_after_minutes) + 60))
+            .not_valid_after(now + datetime.timedelta(
+                minutes=not_after_minutes))
+            .sign(ca_key, hashes.SHA256()))
+    return cert.public_bytes(serialization.Encoding.DER)
+
+
+@pytest.fixture(scope="module")
+def front_proxy_pki(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("pki")
+    ca_key, ca_cert = make_ca("front-proxy-ca")
+    ca_path = tmp / "front-proxy-ca.pem"
+    ca_path.write_bytes(ca_cert.public_bytes(serialization.Encoding.PEM))
+    rogue_key, rogue_cert = make_ca("rogue-ca")
+    return {
+        "ca_path": str(ca_path),
+        "good_der": issue_client_cert(ca_key, ca_cert, "front-proxy-client"),
+        "wrong_cn_der": issue_client_cert(ca_key, ca_cert, "impostor"),
+        "rogue_der": issue_client_cert(rogue_key, rogue_cert,
+                                       "front-proxy-client"),
+        "expired_der": issue_client_cert(ca_key, ca_cert,
+                                         "front-proxy-client",
+                                         not_after_minutes=-10),
+    }
+
+
+def req_with(der=None, user="alice", groups=(), extra=()):
+    headers = Headers()
+    if user:
+        headers.add("X-Remote-User", user)
+    for g in groups:
+        headers.add("X-Remote-Group", g)
+    for k, v in extra:
+        headers.add(k, v)
+    return Request(method="GET", target="/api/v1/pods", headers=headers,
+                   peer_cert_der=der)
+
+
+class TestRequestHeaderAuthenticator:
+    def test_verified_front_proxy_trusted(self, front_proxy_pki):
+        a = RequestHeaderAuthenticator(
+            front_proxy_pki["ca_path"],
+            allowed_names=("front-proxy-client",))
+        user = a.authenticate(req_with(
+            front_proxy_pki["good_der"], groups=["admins", "devs"],
+            extra=[("X-Remote-Extra-Scopes", "view")]))
+        assert user is not None
+        assert user.name == "alice"
+        assert user.groups == ["admins", "devs"]
+        assert user.extra == {"scopes": ["view"]}
+
+    def test_spoofed_header_without_cert_rejected(self, front_proxy_pki):
+        a = RequestHeaderAuthenticator(front_proxy_pki["ca_path"])
+        assert a.authenticate(req_with(None, user="system:admin")) is None
+
+    def test_cert_from_wrong_ca_rejected(self, front_proxy_pki):
+        a = RequestHeaderAuthenticator(front_proxy_pki["ca_path"])
+        # signed by a rogue CA with the RIGHT CN — must still fail
+        assert a.authenticate(req_with(
+            front_proxy_pki["rogue_der"], user="system:admin")) is None
+
+    def test_cn_not_in_allowed_names_rejected(self, front_proxy_pki):
+        a = RequestHeaderAuthenticator(
+            front_proxy_pki["ca_path"],
+            allowed_names=("front-proxy-client",))
+        assert a.authenticate(req_with(
+            front_proxy_pki["wrong_cn_der"])) is None
+
+    def test_any_cn_ok_when_no_allowed_names(self, front_proxy_pki):
+        a = RequestHeaderAuthenticator(front_proxy_pki["ca_path"])
+        assert a.authenticate(req_with(
+            front_proxy_pki["wrong_cn_der"])).name == "alice"
+
+    def test_expired_cert_rejected(self, front_proxy_pki):
+        a = RequestHeaderAuthenticator(front_proxy_pki["ca_path"])
+        assert a.authenticate(req_with(
+            front_proxy_pki["expired_der"])) is None
+
+    def test_garbage_der_rejected(self, front_proxy_pki):
+        a = RequestHeaderAuthenticator(front_proxy_pki["ca_path"])
+        assert a.authenticate(req_with(b"\x30\x03notacert")) is None
+
+    def test_no_username_header(self, front_proxy_pki):
+        a = RequestHeaderAuthenticator(front_proxy_pki["ca_path"])
+        assert a.authenticate(req_with(
+            front_proxy_pki["good_der"], user="")) is None
+
+    def test_chain_does_not_fall_through_to_plain_headers(
+            self, front_proxy_pki):
+        """Serving-mode chain must NOT contain the embedded-mode
+        HeaderAuthenticator; with only requestheader configured, a spoofed
+        header + no cert yields anonymous/nothing."""
+        chain = AuthenticatorChain([RequestHeaderAuthenticator(
+            front_proxy_pki["ca_path"])])
+        assert chain.authenticate(req_with(None, user="root")) is None
+
+
+# -- OIDC ---------------------------------------------------------------------
+
+def b64url(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def make_jwt(key, kid: str, alg: str, claims: dict,
+             tamper: bool = False) -> str:
+    header = {"alg": alg, "kid": kid, "typ": "JWT"}
+    h = b64url(json.dumps(header).encode())
+    p = b64url(json.dumps(claims).encode())
+    signing_input = f"{h}.{p}".encode()
+    if alg == "RS256":
+        from cryptography.hazmat.primitives.asymmetric import padding
+        sig = key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
+    else:  # ES256: raw r||s
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            decode_dss_signature,
+        )
+        der = key.sign(signing_input, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        sig = r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    if tamper:
+        p = b64url(json.dumps({**claims, "sub": "evil"}).encode())
+    return f"{h}.{p}.{b64url(sig)}"
+
+
+def jwk_of(key, kid: str) -> dict:
+    pub = key.public_key()
+    if isinstance(key, rsa.RSAPrivateKey):
+        nums = pub.public_numbers()
+        byte_len = (nums.n.bit_length() + 7) // 8
+        return {"kty": "RSA", "kid": kid, "alg": "RS256",
+                "n": b64url(nums.n.to_bytes(byte_len, "big")),
+                "e": b64url(nums.e.to_bytes(3, "big"))}
+    nums = pub.public_numbers()
+    return {"kty": "EC", "crv": "P-256", "kid": kid, "alg": "ES256",
+            "x": b64url(nums.x.to_bytes(32, "big")),
+            "y": b64url(nums.y.to_bytes(32, "big"))}
+
+
+ISSUER = "https://issuer.test"
+CLIENT_ID = "kube-proxy"
+
+
+@pytest.fixture(scope="module")
+def oidc(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("oidc")
+    rsa_key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    ec_key = ec.generate_private_key(ec.SECP256R1())
+    rogue = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    jwks_path = tmp / "jwks.json"
+    jwks_path.write_text(json.dumps({
+        "keys": [jwk_of(rsa_key, "rsa1"), jwk_of(ec_key, "ec1")]}))
+    auth = OIDCAuthenticator(ISSUER, CLIENT_ID, str(jwks_path))
+    return {"auth": auth, "rsa": rsa_key, "ec": ec_key, "rogue": rogue}
+
+
+def bearer_req(token: str) -> Request:
+    h = Headers()
+    h.add("Authorization", f"Bearer {token}")
+    return Request(method="GET", target="/api/v1/pods", headers=h)
+
+
+def good_claims(**over):
+    now = time.time()
+    claims = {"iss": ISSUER, "aud": CLIENT_ID, "sub": "alice",
+              "groups": ["devs"], "exp": now + 300, "nbf": now - 60}
+    claims.update(over)
+    return claims
+
+
+class TestOIDCAuthenticator:
+    @pytest.mark.parametrize("keyname,kid,alg", [
+        ("rsa", "rsa1", "RS256"), ("ec", "ec1", "ES256")])
+    def test_valid_token(self, oidc, keyname, kid, alg):
+        tok = make_jwt(oidc[keyname], kid, alg, good_claims())
+        user = oidc["auth"].authenticate(bearer_req(tok))
+        assert user is not None and user.name == "alice"
+        assert user.groups == ["devs"]
+
+    def test_aud_as_list(self, oidc):
+        tok = make_jwt(oidc["rsa"], "rsa1", "RS256",
+                       good_claims(aud=["other", CLIENT_ID]))
+        assert oidc["auth"].authenticate(bearer_req(tok)).name == "alice"
+
+    def test_rogue_key_rejected(self, oidc):
+        tok = make_jwt(oidc["rogue"], "rsa1", "RS256", good_claims())
+        assert oidc["auth"].authenticate(bearer_req(tok)) is None
+
+    def test_tampered_payload_rejected(self, oidc):
+        tok = make_jwt(oidc["rsa"], "rsa1", "RS256", good_claims(),
+                       tamper=True)
+        assert oidc["auth"].authenticate(bearer_req(tok)) is None
+
+    @pytest.mark.parametrize("bad", [
+        {"iss": "https://evil.test"},
+        {"aud": "someone-else"},
+        {"exp": time.time() - 3600},
+        {"nbf": time.time() + 3600},
+        {"sub": ""},
+    ])
+    def test_bad_claims_rejected(self, oidc, bad):
+        tok = make_jwt(oidc["rsa"], "rsa1", "RS256", good_claims(**bad))
+        assert oidc["auth"].authenticate(bearer_req(tok)) is None
+
+    def test_alg_none_rejected(self, oidc):
+        h = b64url(json.dumps({"alg": "none"}).encode())
+        p = b64url(json.dumps(good_claims()).encode())
+        assert oidc["auth"].authenticate(bearer_req(f"{h}.{p}.")) is None
+
+    def test_alg_confusion_rejected(self, oidc):
+        """An RS256 kid must not verify an ES256-signed blob and vice
+        versa (kty is matched to the declared alg)."""
+        tok = make_jwt(oidc["ec"], "rsa1", "ES256", good_claims())
+        # kid points at the RSA key; kty mismatch -> no candidates
+        user = oidc["auth"].authenticate(bearer_req(tok))
+        assert user is None
+
+    def test_malformed_tokens(self, oidc):
+        for tok in ("", "a.b", "a.b.c.d", "!!!.???.###",
+                    "Zm9v.YmFy.YmF6"):
+            assert oidc["auth"].authenticate(bearer_req(tok)) is None
+
+    def test_groups_string_normalized(self, oidc):
+        tok = make_jwt(oidc["rsa"], "rsa1", "RS256",
+                       good_claims(groups="admins"))
+        assert oidc["auth"].authenticate(bearer_req(tok)).groups == \
+            ["admins"]
+
+    def test_username_prefix_and_claim(self, oidc, tmp_path):
+        jwks = tmp_path / "jwks.json"
+        jwks.write_text(json.dumps({"keys": [jwk_of(oidc["rsa"], "rsa1")]}))
+        a = OIDCAuthenticator(ISSUER, CLIENT_ID, str(jwks),
+                              username_claim="email",
+                              username_prefix="oidc:")
+        tok = make_jwt(oidc["rsa"], "rsa1", "RS256",
+                       good_claims(email="a@b.co"))
+        assert a.authenticate(bearer_req(tok)).name == "oidc:a@b.co"
+
+    def test_non_bearer_ignored(self, oidc):
+        h = Headers()
+        h.add("Authorization", "Basic dXNlcjpwYXNz")
+        assert oidc["auth"].authenticate(
+            Request(method="GET", target="/", headers=h)) is None
+
+
+# -- front-proxy over real TLS end-to-end -------------------------------------
+
+class TestFrontProxyTLSEndToEnd:
+    """CLI flags -> ProxyServer over real TLS: a front proxy presenting its
+    client certificate can set X-Remote-*; the same headers WITHOUT the
+    certificate are 401 (this is the spoof the requestheader CA exists to
+    stop)."""
+
+    def test_requestheader_over_tls(self, tmp_path):
+        import asyncio
+        import ssl as ssl_mod
+
+        from spicedb_kubeapi_proxy_tpu import cli
+        from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+            H11Transport,
+            Response,
+            Transport,
+        )
+        from spicedb_kubeapi_proxy_tpu.proxy.server import ProxyServer
+
+        ca_key, ca_cert = make_ca("front-proxy-ca")
+        ca_path = tmp_path / "fp-ca.pem"
+        ca_path.write_bytes(ca_cert.public_bytes(
+            serialization.Encoding.PEM))
+        # front-proxy leaf, PEM pair for the TLS client
+        fp_key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        fp_cert = (x509.CertificateBuilder()
+                   .subject_name(x509.Name([x509.NameAttribute(
+                       NameOID.COMMON_NAME, "front-proxy-client")]))
+                   .issuer_name(ca_cert.subject)
+                   .public_key(fp_key.public_key())
+                   .serial_number(x509.random_serial_number())
+                   .not_valid_before(now - datetime.timedelta(minutes=5))
+                   .not_valid_after(now + datetime.timedelta(hours=1))
+                   .sign(ca_key, hashes.SHA256()))
+        cert_pem = tmp_path / "fp.pem"
+        cert_pem.write_bytes(fp_cert.public_bytes(
+            serialization.Encoding.PEM))
+        key_pem = tmp_path / "fp-key.pem"
+        key_pem.write_bytes(fp_key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+
+        rules = tmp_path / "rules.yaml"
+        rules.write_text("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [get]}]
+check: [{tpl: "namespace:{{name}}#view@user:{{user.name}}"}]
+""")
+
+        class Upstream(Transport):
+            async def round_trip(self, req):
+                return Response(status=200, body=json.dumps({
+                    "kind": "Namespace", "apiVersion": "v1",
+                    "metadata": {"name": "ns1"}}).encode())
+
+        args = cli.build_parser().parse_args(cli._normalize_argv([
+            "--rule-config", str(rules),
+            "--cert-dir", str(tmp_path / "certs"),
+            "--requestheader-client-ca-file", str(ca_path),
+            "--requestheader-allowed-names", "front-proxy-client",
+            "--use-in-cluster-config"]))
+        completed = cli.complete(args, upstream_transport=Upstream())
+
+        async def run():
+            from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+                RelationshipUpdate,
+                UpdateOp,
+                parse_relationship,
+            )
+            server = ProxyServer(completed.server_options)
+            await server.endpoint.write_relationships([RelationshipUpdate(
+                op=UpdateOp.TOUCH,
+                rel=parse_relationship("namespace:ns1#viewer@user:alice"))])
+            port = await server.start("127.0.0.1", 0)
+            try:
+                def client_ctx(with_cert):
+                    c = ssl_mod.create_default_context()
+                    c.check_hostname = False
+                    c.verify_mode = ssl_mod.CERT_NONE
+                    if with_cert:
+                        c.load_cert_chain(str(cert_pem), str(key_pem))
+                    return c
+
+                req = Request(
+                    method="GET", target="/api/v1/namespaces/ns1",
+                    headers=Headers([("X-Remote-User", "alice"),
+                                     ("Accept", "application/json")]))
+                with_cert = await H11Transport(
+                    f"https://127.0.0.1:{port}",
+                    ssl_context=client_ctx(True)).round_trip(req)
+                spoofed = await H11Transport(
+                    f"https://127.0.0.1:{port}",
+                    ssl_context=client_ctx(False)).round_trip(req)
+                return with_cert, spoofed
+            finally:
+                await server.stop()
+
+        with_cert, spoofed = asyncio.run(run())
+        assert with_cert.status == 200
+        assert json.loads(with_cert.body)["metadata"]["name"] == "ns1"
+        assert spoofed.status == 401

@@ -1,0 +1,314 @@
+"""E2E parity scenarios from the reference suite (VERDICT r2 item 3):
+
+- gzip through the proxy with a ~300KB object, both failure paths the
+  reference guards: the workflow-engine write path and the reverse-proxy
+  read path (reference e2e/proxy_test.go:1225-1290);
+- proxy-level concurrent dual-write mutual exclusion, repeated 5x
+  (reference proxy_test.go:889, MustPassRepeatedly(5));
+- custom resource type (CRD-equivalent) registered in kubefake with its
+  own rules (reference e2e/testresource-crd.yaml usage).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.config import proxyrule
+from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import (
+    BUILTIN_TYPES,
+    FakeKubeApiServer,
+    ResourceType,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+    H11Transport,
+    HandlerTransport,
+    HttpServer,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+from spicedb_kubeapi_proxy_tpu.spicedb.types import parse_relationship
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  permission view = creator
+}
+definition configmap {
+  relation creator: user
+  permission view = creator
+}
+definition testresource {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+"""
+
+GZIP_RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-configmaps}
+match: [{apiVersion: v1, resource: configmaps, verbs: [create]}]
+update:
+  creates:
+  - tpl: "configmap:{{namespacedName}}#creator@user:{{user.name}}"
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-configmaps}
+match: [{apiVersion: v1, resource: configmaps, verbs: [get]}]
+check: [{tpl: "configmap:{{namespacedName}}#view@user:{{user.name}}"}]
+"""
+
+
+class TestGzipThroughProxy:
+    """A large (~300KB) ConfigMap round-trips through the proxy over REAL
+    HTTP with the upstream gzip-encoding its responses.  Exercises both
+    reference failure paths: the workflow-engine kube write (CREATE) and
+    the reverse-proxy filter read (GET) — each must see plaintext because
+    the transport owns encoding negotiation."""
+
+    def test_large_configmap_create_and_get(self):
+        async def go():
+            gzipped_paths = []
+            kube = FakeKubeApiServer()
+
+            async def recording_kube(req):
+                resp = await kube(req)
+                if resp.headers.get("Content-Encoding") == "gzip":
+                    gzipped_paths.append(req.path)
+                return resp
+
+            upstream_srv = HttpServer(recording_kube)
+            port = await upstream_srv.start("127.0.0.1", 0)
+            try:
+                proxy = ProxyServer(Options(
+                    spicedb_endpoint="embedded://",
+                    bootstrap=Bootstrap(schema_text=SCHEMA),
+                    rules_yaml=GZIP_RULES,
+                    upstream_transport=H11Transport(
+                        f"http://127.0.0.1:{port}"),
+                ))
+                proxy.enable_dual_writes()
+                paul = proxy.get_embedded_client(user="paul")
+
+                # ~300KB payload: far over the fake apiserver's 1KB gzip
+                # threshold (the real apiserver's is ~128KB)
+                payload = "x" * (300 * 1024)
+                cm = {"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": "large-cm", "namespace": "ns1"},
+                      "data": {"payload": payload}}
+
+                # CREATE goes through the workflow engine -> kube write
+                # activity -> H11Transport; kube gzips the 201 response
+                resp = await paul.post(
+                    "/api/v1/namespaces/ns1/configmaps", cm)
+                assert resp.status in (200, 201), (resp.status,
+                                                   resp.body[:300])
+                created = json.loads(resp.body)  # plaintext, not gzip bytes
+                assert created["data"]["payload"] == payload
+
+                # GET goes through the reverse proxy + response filterer;
+                # kube gzips the 300KB 200 response
+                resp = await paul.get(
+                    "/api/v1/namespaces/ns1/configmaps/large-cm")
+                assert resp.status == 200, (resp.status, resp.body[:300])
+                fetched = json.loads(resp.body)
+                assert fetched["data"]["payload"] == payload
+
+                # intruder without the creator tuple is denied
+                resp = await proxy.get_embedded_client(user="eve").get(
+                    "/api/v1/namespaces/ns1/configmaps/large-cm")
+                assert resp.status == 403
+
+                # the upstream really did gzip both hops — otherwise this
+                # test proves nothing
+                assert len(gzipped_paths) >= 2, gzipped_paths
+            finally:
+                await upstream_srv.stop()
+        run(go())
+
+
+NS_CREATE_RULES_TMPL = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {{name: create-namespaces}}
+lock: {lock_mode}
+match: [{{apiVersion: v1, resource: namespaces, verbs: [create]}}]
+update:
+  creates:
+  - tpl: "namespace:{{{{name}}}}#creator@user:{{{{user.name}}}}"
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {{name: get-namespaces}}
+match: [{{apiVersion: v1, resource: namespaces, verbs: [get]}}]
+check: [{{tpl: "namespace:{{{{name}}}}#view@user:{{{{user.name}}}}"}}]
+"""
+
+
+class TestConcurrentDualWriteMutex:
+    """Two clients race a create of the SAME object through the full proxy
+    HTTP path; exactly one must win, the loser must get a conflict-class
+    error (409 pessimistic-lock or 409 AlreadyExists optimistic).  The
+    reference runs this with MustPassRepeatedly(5) because the interleaving
+    is timing-dependent — we repeat 5x per lock mode."""
+
+    @pytest.mark.parametrize("lock_mode", ["Pessimistic", "Optimistic"])
+    def test_only_one_write_wins(self, lock_mode):
+        async def go():
+            kube = FakeKubeApiServer()
+            rules = NS_CREATE_RULES_TMPL.format(lock_mode=lock_mode)
+            proxy = ProxyServer(Options(
+                spicedb_endpoint="embedded://",
+                bootstrap=Bootstrap(schema_text=SCHEMA),
+                rules_yaml=rules,
+                upstream_transport=HandlerTransport(kube),
+            ))
+            proxy.enable_dual_writes()
+            paul = proxy.get_embedded_client(user="paul")
+            chani = proxy.get_embedded_client(user="chani")
+
+            for attempt in range(5):
+                ns_name = f"contested-{lock_mode.lower()}-{attempt}"
+                ns_obj = {"apiVersion": "v1", "kind": "Namespace",
+                          "metadata": {"name": ns_name}}
+
+                async def create(client):
+                    return await client.post("/api/v1/namespaces", ns_obj)
+
+                r1, r2 = await asyncio.gather(create(paul), create(chani))
+                statuses = sorted([r1.status, r2.status])
+                assert statuses[0] in (200, 201), (attempt, statuses,
+                                                   r1.body[:200],
+                                                   r2.body[:200])
+                assert statuses[1] == 409, (attempt, statuses,
+                                            r1.body[:200], r2.body[:200])
+
+                # the winner owns the namespace.  (The loser's tuples are
+                # intentionally NOT asserted absent: when the 409 comes
+                # from kube AlreadyExists — lock released before the loser
+                # acquired it — the reference keeps the loser's tuples as
+                # converged state, workflow.go isSuccessfulCreateOrUpdate.)
+                winner = paul if r1.status in (200, 201) else chani
+                assert (await winner.get(
+                    f"/api/v1/namespaces/{ns_name}")).status == 200
+        run(go())
+
+
+CRD_RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-testresources}
+match: [{apiVersion: example.com/v1, resource: testresources, verbs: [get]}]
+check: [{tpl: "testresource:{{namespacedName}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-testresources}
+match: [{apiVersion: example.com/v1, resource: testresources, verbs: [list]}]
+prefilter:
+- fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  lookupMatchingResources: {tpl: "testresource:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-testresources}
+match: [{apiVersion: example.com/v1, resource: testresources, verbs: [create]}]
+update:
+  creates:
+  - tpl: "testresource:{{namespacedName}}#creator@user:{{user.name}}"
+"""
+
+
+class TestCustomResourceType:
+    """CRD-equivalent scenario: a new ResourceType registered at runtime
+    (the reference applies e2e/testresource-crd.yaml) gets its own rules;
+    get/list filtering and dual-write creation all work through the proxy,
+    including discovery via the RESTMapper for the new group."""
+
+    def _make(self):
+        kube = FakeKubeApiServer(types=list(BUILTIN_TYPES) + [
+            ResourceType("example.com", "v1", "testresources",
+                         "TestResource", namespaced=True,
+                         short_names=("tr",)),
+        ])
+        for name, ns in (("alpha", "team-a"), ("beta", "team-b")):
+            kube.seed("example.com", "v1", "testresources", {
+                "metadata": {"name": name, "namespace": ns},
+                "spec": {"message": f"hello {name}"}})
+        proxy = ProxyServer(Options(
+            spicedb_endpoint="embedded://",
+            bootstrap=Bootstrap(schema_text=SCHEMA),
+            rules_yaml=CRD_RULES,
+            upstream_transport=HandlerTransport(kube),
+        ))
+        proxy.enable_dual_writes()
+        proxy.endpoint.store.bulk_load([parse_relationship(
+            "testresource:team-a/alpha#viewer@user:alice")])
+        return proxy, kube
+
+    def test_get_and_list_filtered(self):
+        proxy, _ = self._make()
+
+        async def go():
+            alice = proxy.get_embedded_client(user="alice")
+            base = "/apis/example.com/v1"
+            resp = await alice.get(
+                f"{base}/namespaces/team-a/testresources/alpha")
+            assert resp.status == 200, (resp.status, resp.body[:200])
+            assert json.loads(resp.body)["spec"]["message"] == "hello alpha"
+            assert (await alice.get(
+                f"{base}/namespaces/team-b/testresources/beta")).status == 403
+
+            resp = await alice.get(f"{base}/testresources")
+            assert resp.status == 200, (resp.status, resp.body[:200])
+            names = {i["metadata"]["name"]
+                     for i in json.loads(resp.body)["items"]}
+            assert names == {"alpha"}
+        run(go())
+
+    def test_dual_write_create(self):
+        proxy, kube = self._make()
+
+        async def go():
+            bob = proxy.get_embedded_client(user="bob")
+            base = "/apis/example.com/v1"
+            tr = {"apiVersion": "example.com/v1", "kind": "TestResource",
+                  "metadata": {"name": "gamma", "namespace": "team-c"},
+                  "spec": {"message": "hi"}}
+            resp = await bob.post(
+                f"{base}/namespaces/team-c/testresources", tr)
+            assert resp.status in (200, 201), (resp.status, resp.body[:300])
+            # kube object exists
+            key = ("example.com", "v1", "testresources")
+            assert "gamma" in kube.objects[key]["team-c"]
+            # tuple written -> bob can get + list it
+            resp = await bob.get(
+                f"{base}/namespaces/team-c/testresources/gamma")
+            assert resp.status == 200
+            resp = await bob.get(f"{base}/testresources")
+            names = {i["metadata"]["name"]
+                     for i in json.loads(resp.body)["items"]}
+            assert names == {"gamma"}
+        run(go())
+
+    def test_unmatched_custom_group_forbidden(self):
+        proxy, _ = self._make()
+
+        async def go():
+            alice = proxy.get_embedded_client(user="alice")
+            # no rule matches anothertestresources (reference
+            # proxy_test.go:371-399: unmatched custom GVR is forbidden)
+            resp = await alice.get(
+                "/apis/example.com/v1/anothertestresources")
+            assert resp.status == 403
+        run(go())

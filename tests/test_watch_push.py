@@ -1,0 +1,166 @@
+"""Push-based watch bridge tests (VERDICT r2 item 6).
+
+The store wakes async watch consumers directly (WatchQueue.next) — no
+0.5s executor poll — and the per-watch buffered-frames dict is bounded.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.authz import responsefilterer as rf_mod
+from spicedb_kubeapi_proxy_tpu.authz.responsefilterer import (
+    WatchResponseFilterer,
+)
+from spicedb_kubeapi_proxy_tpu.authz.watch import ResultChange, WatchTracker
+from spicedb_kubeapi_proxy_tpu.spicedb.schema import parse_schema
+from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipUpdate,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = parse_schema("""
+definition user {}
+definition pod { relation viewer: user
+                 permission view = viewer }
+""")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def touch(store, rel):
+    store.write([RelationshipUpdate(UpdateOp.TOUCH,
+                                    parse_relationship(rel))])
+
+
+class TestAsyncNext:
+    def test_push_latency_beats_poll_interval(self):
+        """The event must arrive well under the old 0.5s poll interval —
+        proof the consumer is woken, not polling."""
+        store = TupleStore(SCHEMA)
+        w = store.subscribe(["pod"])
+
+        async def go():
+            loop = asyncio.get_running_loop()
+            t_write = {}
+
+            def writer():
+                time.sleep(0.05)
+                t_write["t"] = loop.time()
+                touch(store, "pod:a/p1#viewer@user:alice")
+
+            threading.Thread(target=writer, daemon=True).start()
+            update = await asyncio.wait_for(w.next(), 5)
+            latency = loop.time() - t_write["t"]
+            assert update is not None
+            assert update.updates[0].rel.resource.id == "a/p1"
+            assert latency < 0.25, f"woke after {latency:.3f}s — polling?"
+        run(go())
+        w.close()
+
+    def test_next_returns_none_on_close(self):
+        store = TupleStore(SCHEMA)
+        w = store.subscribe(["pod"])
+
+        async def go():
+            task = asyncio.ensure_future(w.next())
+            await asyncio.sleep(0.02)
+            w.close()
+            assert await asyncio.wait_for(task, 2) is None
+        run(go())
+
+    def test_next_drains_backlog_then_blocks(self):
+        store = TupleStore(SCHEMA)
+        w = store.subscribe(["pod"])
+        touch(store, "pod:a/p1#viewer@user:alice")
+        touch(store, "pod:a/p2#viewer@user:alice")
+
+        async def go():
+            u1 = await w.next()
+            u2 = await w.next()
+            assert {u1.updates[0].rel.resource.id,
+                    u2.updates[0].rel.resource.id} == {"a/p1", "a/p2"}
+            assert await w.next(timeout=0.05) is None  # empty -> timeout
+        run(go())
+        w.close()
+
+    def test_many_concurrent_watches_all_woken(self):
+        """100 concurrent async watchers all receive one write promptly —
+        with thread-polling this would need 100 threads; here it's one
+        wake fan-out."""
+        store = TupleStore(SCHEMA)
+        watchers = [store.subscribe(["pod"]) for _ in range(100)]
+
+        async def go():
+            tasks = [asyncio.ensure_future(w.next()) for w in watchers]
+            await asyncio.sleep(0.05)  # all parked
+            touch(store, "pod:a/p9#viewer@user:alice")
+            results = await asyncio.wait_for(asyncio.gather(*tasks), 5)
+            assert all(r is not None and
+                       r.updates[0].rel.resource.id == "a/p9"
+                       for r in results)
+        run(go())
+        for w in watchers:
+            w.close()
+
+    def test_sync_poll_still_works(self):
+        """The workflow engine and tests still use blocking poll()."""
+        store = TupleStore(SCHEMA)
+        w = store.subscribe(["pod"])
+        touch(store, "pod:a/p1#viewer@user:alice")
+        assert w.poll(timeout=1).updates[0].rel.resource.id == "a/p1"
+        assert w.poll(timeout=0.01) is None
+        w.close()
+
+
+class TestWatchBufferCap:
+    def _frame(self, ns, name):
+        return (json.dumps({"type": "ADDED", "object": {
+            "kind": "Pod", "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": ns}}}) + "\n").encode()
+
+    def test_overflow_drops_oldest(self, monkeypatch):
+        """With the cap at 3, buffering 5 unauthorized frames keeps only
+        the 3 newest; granting a dropped one yields nothing, granting a
+        kept one flushes it."""
+        monkeypatch.setattr(rf_mod, "WATCH_BUFFER_CAP", 3)
+
+        filterer = WatchResponseFilterer.__new__(WatchResponseFilterer)
+        filterer._tracker = WatchTracker()
+        filterer._watch_task = None
+
+        async def upstream():
+            for i in range(5):
+                yield self._frame("ns", f"p{i}")
+            await asyncio.sleep(30)  # hold the stream open
+
+        async def go():
+            out = filterer._filtered_stream(upstream())
+            got = []
+
+            async def consume():
+                async for frame in out:
+                    got.append(json.loads(frame)["object"]["metadata"]
+                               ["name"])
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.1)  # frames buffered, cap enforced
+            # p0/p1 were dropped (oldest); granting p0 yields nothing
+            await filterer._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p0"))
+            await asyncio.sleep(0.1)
+            assert got == []
+            # granting p4 (still buffered) flushes it
+            await filterer._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p4"))
+            await asyncio.sleep(0.1)
+            assert got == ["p4"]
+            task.cancel()
+        run(go())
