@@ -1,0 +1,24 @@
+# Container image for the TPU-native kube authz proxy
+# (reference Dockerfile:1-13 builds a static Go binary; here the runtime is
+# Python + JAX, with the CPU wheel by default — swap in the TPU wheel via
+# the JAX_VARIANT build arg on TPU node pools).
+FROM python:3.12-slim AS runtime
+
+ARG JAX_VARIANT="jax[cpu]"
+RUN pip install --no-cache-dir "${JAX_VARIANT}" \
+        pyyaml cryptography grpcio numpy einops
+
+WORKDIR /app
+COPY spicedb_kubeapi_proxy_tpu/ spicedb_kubeapi_proxy_tpu/
+COPY deploy/rules.yaml deploy/bootstrap.yaml deploy/
+
+# native columnar parser (optional acceleration; falls back to Python)
+RUN python -c "from spicedb_kubeapi_proxy_tpu import native" || true
+
+EXPOSE 8443
+ENTRYPOINT ["python", "-m", "spicedb_kubeapi_proxy_tpu"]
+CMD ["--secure-port", "8443", \
+     "--rule-config", "deploy/rules.yaml", \
+     "--spicedb-bootstrap", "deploy/bootstrap.yaml", \
+     "--spicedb-endpoint", "jax://", \
+     "--use-in-cluster-config"]

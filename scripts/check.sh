@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Pre-snapshot check gate (the reference gates merges on unit + e2e suites,
+# magefiles/test.go:19-56 and .github/workflows/build-test.yaml:56-92).
+# Run this before every commit/snapshot:
+#
+#   scripts/check.sh            # full gate
+#   scripts/check.sh --fast     # skip the bench smoke
+#
+# Everything runs on the virtual CPU mesh — no TPU required.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== syntax gate (compileall)"
+python -m compileall -q spicedb_kubeapi_proxy_tpu tests bench.py __graft_entry__.py
+
+echo "== unit + e2e suites (pytest)"
+python -m pytest tests/ -q
+
+echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"
+JAX_PLATFORMS=cpu python __graft_entry__.py 8
+
+if [[ "${1:-}" != "--fast" ]]; then
+  echo "== bench smoke (pods-depth1, CPU)"
+  JAX_PLATFORMS=cpu python bench.py --config pods-depth1 --batch 64 \
+      --rounds 2 --oracle-queries 1
+fi
+
+echo "check.sh: ALL GREEN"

@@ -73,6 +73,10 @@ def with_authorization(handler: Handler, failed: Handler,
     async def authorized(req: Request) -> Response:
         info: RequestInfo = req.context["request_info"]
         user = req.context["user"]
+        # structured request logging (reference requestlogger.go +
+        # rules.go:242-279): the logging middleware reads these back out
+        # of the request context after the chain completes
+        req.context["authz_outcome"] = "denied"
         try:
             if input_extractor is not None:
                 input = input_extractor(req, info, user)
@@ -81,8 +85,10 @@ def with_authorization(handler: Handler, failed: Handler,
                     info, user, req.body, req.headers.to_dict())
         except ResolveError as e:
             return forbidden_response(str(e))
+        req.context["resolve_input"] = input
 
         if always_allow(info):
+            req.context["authz_outcome"] = "always_allow"
             req.context[FILTERER_KEY] = EmptyResponseFilterer()
             return await handler(req)
 
@@ -97,6 +103,7 @@ def with_authorization(handler: Handler, failed: Handler,
             return await failed(req)
         if not filtered_rules:
             return await failed(req)
+        req.context["matched_rules"] = [r.name for r in filtered_rules]
 
         try:
             await run_all_matching_checks(endpoint, filtered_rules, input)
@@ -118,6 +125,7 @@ def with_authorization(handler: Handler, failed: Handler,
                     "message": "update engine not configured"})
             from .update import perform_update
             try:
+                req.context["authz_outcome"] = "allowed"
                 return await perform_update(update_rule, input, req,
                                             workflow_client)
             except Exception as e:
@@ -137,6 +145,7 @@ def with_authorization(handler: Handler, failed: Handler,
             except Exception:
                 return await failed(req)
             req.context[FILTERER_KEY] = filterer
+            req.context["authz_outcome"] = "allowed"
             return await handler(req)
 
         filterer = StandardResponseFilterer(rest_mapper, input,
@@ -155,6 +164,7 @@ def with_authorization(handler: Handler, failed: Handler,
                                                        filtered_rules, input)
                 except (UnauthorizedError, ResolveError):
                     return await failed(req)
+            req.context["authz_outcome"] = "allowed"
             return resp
         if should_run_post_filters(info.verb, filtered_rules):
             resp = await handler(req)
@@ -167,7 +177,9 @@ def with_authorization(handler: Handler, failed: Handler,
                 resp.body = body
                 resp.headers.set("Content-Type", "application/json")
                 resp.headers.set("Content-Length", str(len(body)))
+            req.context["authz_outcome"] = "allowed"
             return resp
+        req.context["authz_outcome"] = "allowed"
         return await handler(req)
 
     return authorized

@@ -291,18 +291,65 @@ class WatchResponseFilterer(ResponseFilterer):
         if resp.stream is None:
             return  # error responses pass through
         upstream = resp.stream
-        resp.stream = self._filtered_stream(upstream)
+        # the upstream Content-Type decides the stream framing/codec, the
+        # analog of the reference's negotiated streaming serializer
+        # (responsefilterer.go:500-506)
+        content_type = resp.headers.get("Content-Type", "")
+        proto = "protobuf" in content_type
+        resp.stream = self._filtered_stream(upstream, proto=proto)
 
-    async def _filtered_stream(self, upstream):
+    @staticmethod
+    def _decode_frame(raw: bytes, proto: bool) -> tuple:
+        """(event_type, namespace, name, is_status) for one raw frame.
+        Raises ValueError when the frame cannot be decoded — the caller
+        must DROP such frames (fail closed), never relay them."""
+        if proto:
+            from ..proxy import k8sproto
+
+            try:
+                ev, api_version, kind, obj_raw = k8sproto.decode_watch_event(
+                    raw[4:])
+                if ev == "ERROR" or kind == "Status":
+                    return ev, "", "", True
+                # Table event unwrapping (responsefilterer.go:667-677)
+                if kind == "Table" and "meta.k8s.io" in api_version:
+                    namespace, name = k8sproto.table_first_row_meta(obj_raw)
+                else:
+                    namespace, name = k8sproto.object_meta(obj_raw)
+            except k8sproto.K8sProtoError as e:
+                raise ValueError(str(e)) from e
+            return ev, namespace, name, False
+        event = json.loads(raw)  # ValueError propagates to the caller
+        if not isinstance(event, dict):
+            raise ValueError("watch frame is not a JSON object")
+        obj = event.get("object") or {}
+        ev = event.get("type", "")
+        if ev == "ERROR" or obj.get("kind") == "Status":
+            return ev, "", "", True
+        meta = obj.get("metadata") or {}
+        name = meta.get("name", "")
+        namespace = meta.get("namespace", "")
+        # Table event unwrapping (responsefilterer.go:667-677)
+        if (obj.get("kind") == "Table"
+                and "meta.k8s.io" in obj.get("apiVersion", "")):
+            for r in obj.get("rows") or []:
+                rmeta = (r.get("object") or {}).get("metadata") or {}
+                name = rmeta.get("name", "")
+                namespace = rmeta.get("namespace", "")
+                break
+        return ev, namespace, name, False
+
+    async def _filtered_stream(self, upstream, proto: bool = False):
         """Replay / buffer / revoke raw frames
         (reference responsefilterer.go:487-714)."""
-        from .frames import frame_lines
+        from .frames import frame_length_delimited, frame_lines
 
+        framer = frame_length_delimited if proto else frame_lines
         merged: asyncio.Queue = asyncio.Queue()
 
         async def pump_upstream():
             try:
-                async for raw in frame_lines(upstream):
+                async for raw in framer(upstream):
                     await merged.put(("frame", raw))
             finally:
                 await merged.put(("eof", None))
@@ -338,27 +385,25 @@ class WatchResponseFilterer(ResponseFilterer):
                     continue
                 raw = payload
                 try:
-                    event = json.loads(raw)
-                except ValueError:
-                    yield raw  # pass through undecodable frames
+                    ev, namespace, name, is_status = self._decode_frame(
+                        raw, proto)
+                except ValueError as e:
+                    # FAIL CLOSED: an undecodable frame may carry an object
+                    # we cannot authorize — drop it with an error, never
+                    # relay it (this path previously passed frames through
+                    # unfiltered, an authorization bypass)
+                    import logging
+                    logging.getLogger(__name__).error(
+                        "dropping undecodable watch frame (%d bytes, "
+                        "proto=%s): %s", len(raw), proto, e)
                     continue
-                obj = event.get("object") or {}
-                if obj.get("kind") == "Status":
-                    # status events pass through directly, then the stream ends
+                if is_status:
+                    # status events pass through and the stream CONTINUES
+                    # (reference responsefilterer.go:645-651 writes the
+                    # chunk and keeps reading)
                     yield raw
-                    return
-                if event.get("type") in ("ADDED", "MODIFIED"):
-                    meta = obj.get("metadata") or {}
-                    name = meta.get("name", "")
-                    namespace = meta.get("namespace", "")
-                    # Table event unwrapping (responsefilterer.go:667-677)
-                    if (obj.get("kind") == "Table"
-                            and "meta.k8s.io" in obj.get("apiVersion", "")):
-                        for r in obj.get("rows") or []:
-                            rmeta = (r.get("object") or {}).get("metadata") or {}
-                            name = rmeta.get("name", "")
-                            namespace = rmeta.get("namespace", "")
-                            break
+                    continue
+                if ev in ("ADDED", "MODIFIED"):
                     nn = (namespace or "", name)
                     if nn in allowed:
                         yield raw

@@ -140,6 +140,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p.add_argument("-v", "--verbosity", type=int, default=3,
                    help="log verbosity (reference defaults to 3)")
+    p.add_argument("--feature-gates", default="",
+                   help="comma-separated name=true|false feature gate "
+                        "overrides (reference features.go:10-27); known "
+                        "gates: see utils/features.py")
     return p
 
 
@@ -177,6 +181,13 @@ def complete(args: argparse.Namespace,
     logging.basicConfig(
         level=level,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+
+    if getattr(args, "feature_gates", ""):
+        from .utils.features import GATES, FeatureGateError
+        try:
+            GATES.apply_flag(args.feature_gates)
+        except FeatureGateError as e:
+            raise OptionsError(f"invalid --feature-gates: {e}") from e
 
     rule_configs: list = []
     if args.rule_config:

@@ -45,6 +45,7 @@ BUILTIN_TYPES = [
     ResourceType("", "v1", "namespaces", "Namespace", namespaced=False),
     ResourceType("", "v1", "pods", "Pod"),
     ResourceType("", "v1", "configmaps", "ConfigMap"),
+    ResourceType("", "v1", "events", "Event"),
     ResourceType("", "v1", "secrets", "Secret"),
     ResourceType("", "v1", "services", "Service"),
     ResourceType("", "v1", "nodes", "Node", namespaced=False),
@@ -328,28 +329,46 @@ class FakeKubeApiServer:
             initial = [copy.deepcopy(o) for o in self._all_in_scope(key, ns)]
 
         wants_table = self._wants_table(req)
+        wants_proto = self._wants_proto(req)
 
         async def stream():
             try:
                 for obj in initial:
-                    yield self._frame("ADDED", obj, t, wants_table)
+                    yield self._frame("ADDED", obj, t, wants_table,
+                                      wants_proto)
                 while True:
                     ev = await q.get()
                     obj = ev["object"]
                     if ns and obj.get("metadata", {}).get("namespace", "") != ns:
                         continue
-                    yield self._frame(ev["type"], obj, t, wants_table)
+                    yield self._frame(ev["type"], obj, t, wants_table,
+                                      wants_proto)
             finally:
                 watchers = self._watchers.get(key, [])
                 if q in watchers:
                     watchers.remove(q)
 
         resp = Response(status=200, stream=stream())
-        resp.headers.set("Content-Type", "application/json")
+        resp.headers.set(
+            "Content-Type",
+            "application/vnd.kubernetes.protobuf;stream=watch"
+            if wants_proto else "application/json")
         return resp
 
     def _frame(self, event_type: str, obj: dict, t: ResourceType,
-               wants_table: bool) -> bytes:
+               wants_table: bool, wants_proto: bool = False) -> bytes:
+        if wants_proto:
+            # length-delimited raw WatchEvent, object re-enveloped — the
+            # real apiserver's negotiated streaming serializer shape
+            from ..proxy import k8sproto
+            meta = obj.get("metadata", {})
+            env = k8sproto.encode_unknown(
+                t.group_version, t.kind,
+                k8sproto.encode_object(t.group_version, t.kind,
+                                       meta.get("name", ""),
+                                       meta.get("namespace", "")),
+                "application/vnd.kubernetes.protobuf")
+            return k8sproto.encode_watch_event(event_type, env)
         payload = self._to_table(t, [obj]) if wants_table else obj
         return (json.dumps({"type": event_type, "object": payload},
                            separators=(",", ":")) + "\n").encode()

@@ -423,7 +423,9 @@ class JaxEndpoint(PermissionsEndpoint):
         else:
             schema_text = bootstrap.schema_text
             rel_text = bootstrap.relationships_text
-        ep = cls(sch.parse_schema(schema_text), **kwargs)
+        from ..spicedb.endpoints import merge_internal_definitions
+        ep = cls(merge_internal_definitions(sch.parse_schema(schema_text)),
+                 **kwargs)
         if rel_text.strip():
             # columnar bulk path (native parser when available)
             ep.store.bulk_load_text(rel_text)
@@ -837,7 +839,7 @@ class JaxEndpoint(PermissionsEndpoint):
 
     async def write_relationships(self, updates: Iterable[RelationshipUpdate],
                                   preconditions: Iterable[Precondition] = ()) -> int:
-        return self.store.write(updates, preconditions)
+        return self.store.write(self._validate_updates(updates), preconditions)
 
     async def delete_relationships(self, flt: RelationshipFilter,
                                    preconditions: Iterable[Precondition] = ()) -> int:

@@ -214,6 +214,49 @@ def filter_table_raw(raw: bytes,
     return bytes(out)
 
 
+# -- watch stream support -----------------------------------------------------
+# Protobuf watch streams are length-delimited frames (4-byte big-endian
+# length prefix, k8s.io/apimachinery/pkg/util/framer); each payload is a
+# RAW-serialized metav1.WatchEvent { type = 1; object(RawExtension) = 2 }
+# whose object.raw is a full `k8s\x00` envelope (the apiserver's embedded
+# watch encoder re-envelopes the object with the negotiated serializer).
+# The reference decodes these via its negotiated streaming codec
+# (responsefilterer.go:500-506); this is the wire-level equivalent.
+
+def decode_watch_event(payload: bytes) -> tuple:
+    """(event_type, api_version, kind, obj_raw) from a raw-serialized
+    metav1.WatchEvent payload (no length prefix, no envelope).  The embedded
+    object's `k8s\\x00` envelope is stripped when present so `obj_raw` is
+    directly usable with object_meta()."""
+    event_type = ""
+    obj_raw = b""
+    api_version = kind = ""
+    for f, wt, _, _, v in records(payload):
+        if f == 1 and wt == 2:
+            event_type = v.decode("utf-8")
+        elif f == 2 and wt == 2:
+            obj_raw = field_bytes(v, 1) or b""
+    if obj_raw.startswith(K8S_MAGIC):
+        api_version, kind, obj_raw, _ = decode_unknown(obj_raw)
+    return event_type, api_version, kind, obj_raw
+
+
+def table_first_row_meta(table_raw: bytes) -> tuple:
+    """(namespace, name) of the first row's object in a serialized
+    meta/v1 Table (watch Table events carry one row per event)."""
+    for f, wt, _, _, v in records(table_raw):
+        if f == 3 and wt == 2:
+            return _table_row_meta(v)
+    return "", ""
+
+
+def encode_watch_event(event_type: str, obj_envelope: bytes) -> bytes:
+    """A framed watch event (4-byte length prefix included) for the fake
+    apiserver / tests.  `obj_envelope` is a full `k8s\\x00` envelope."""
+    payload = _ld(1, event_type.encode()) + _ld(2, _ld(1, obj_envelope))
+    return len(payload).to_bytes(4, "big") + payload
+
+
 # -- encode helpers (used by the fake apiserver to SERVE protobuf) ------------
 
 def encode_object_meta(name: str, namespace: str = "",

@@ -16,6 +16,7 @@ import asyncio
 import json
 import logging
 import ssl
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -51,6 +52,42 @@ from .kube import UserInfo, parse_request_info
 from .restmapper import CachingRESTMapper
 
 logger = logging.getLogger("spicedb_kubeapi_proxy_tpu.proxy")
+
+_KV_TRUNCATE = 200  # keep object/body values from flooding the log line
+
+
+def format_request_kv(req) -> str:
+    """Structured key-values for the request log line (reference
+    pkg/authz/requestlogger.go + rules.go:242-279 ToKeyValues): user,
+    groups, verb/GVR, name/namespace, matched rules, authz outcome."""
+    parts = []
+    user = req.context.get("user")
+    if user is not None:
+        parts += [("user", user.name), ("groups", ",".join(user.groups))]
+    inp = req.context.get("resolve_input")
+    if inp is not None:
+        kv = inp.to_key_values()
+        for k, v in zip(kv[::2], kv[1::2]):
+            lk = k.lower()
+            # never log payloads: `body`/`object` can carry Secret data;
+            # credential-bearing headers are redacted
+            if lk in ("body", "object"):
+                continue
+            if lk in ("authorization", "cookie", "proxy-authorization"):
+                v = "[redacted]"
+            s = str(v)
+            if len(s) > _KV_TRUNCATE:
+                s = s[:_KV_TRUNCATE] + "…"
+            parts.append((k, s))
+    rules = req.context.get("matched_rules")
+    if rules is not None:
+        parts.append(("rules", ",".join(rules)))
+    outcome = req.context.get("authz_outcome")
+    if outcome is not None:
+        parts.append(("authz", outcome))
+    if not parts:
+        return ""
+    return " " + " ".join(f"{k}={v!r}" for k, v in parts)
 
 
 @dataclass
@@ -155,17 +192,28 @@ class ProxyServer:
                 "proxy_http_requests_total",
                 "Proxied HTTP requests by verb and status code",
                 labels=("verb", "code"))
+            request_latency = REGISTRY.histogram(
+                "proxy_http_request_seconds",
+                "Proxied HTTP request latency by verb",
+                labels=("verb",))
         else:
             request_counter = None
+            request_latency = None
 
         async def with_logging(req: Request) -> Response:
+            from ..utils.features import GATES
+            start = time.monotonic()
             resp = await with_request_info(req)
-            logger.info("%s %s -> %d", req.method, req.target, resp.status)
+            elapsed = time.monotonic() - start
+            kv = (format_request_kv(req)
+                  if GATES.enabled("StructuredRequestLog") else "")
+            logger.info("%s %s -> %d (%.1fms)%s", req.method, req.target,
+                        resp.status, elapsed * 1e3, kv)
             if request_counter is not None:
                 info = req.context.get("request_info")
-                request_counter.inc(
-                    verb=(info.verb if info else req.method.lower()),
-                    code=resp.status)
+                verb = info.verb if info else req.method.lower()
+                request_counter.inc(verb=verb, code=resp.status)
+                request_latency.observe(elapsed, verb=verb)
             return resp
 
         async def with_panic_recovery(req: Request) -> Response:

@@ -31,6 +31,7 @@ from .types import (
     Precondition,
     RelationshipFilter,
     RelationshipUpdate,
+    SchemaError,
     SubjectRef,
     parse_relationship,
 )
@@ -38,6 +39,17 @@ from .types import (
 
 class PermissionsEndpoint:
     """The endpoint contract (PermissionsService + WatchService subset)."""
+
+    def _validate_updates(self, updates: Iterable[RelationshipUpdate]) -> list:
+        """Schema-validate writes (SpiceDB WriteRelationships semantics)
+        for any endpoint that carries a schema; shared by the embedded and
+        jax backends so the rule set cannot diverge."""
+        updates = list(updates)
+        schema = getattr(self, "schema", None)
+        if schema is not None:
+            for u in updates:
+                sch.validate_relationship(schema, u.rel)
+        return updates
 
     async def check_permission(self, req: CheckRequest) -> CheckResult:
         raise NotImplementedError
@@ -123,12 +135,29 @@ class Bootstrap:
         return rels
 
 
+# The proxy's own definitions (dual-write locks, workflow idempotency keys)
+# are merged into every user-supplied bootstrap schema — the reference
+# always loads its embedded bootstrap.yaml into embedded SpiceDB alongside
+# user content (spicedb.go:63-67), so lock/workflow tuples validate there
+# regardless of the user's schema.
+INTERNAL_SCHEMA = """
+use expiration
+
+definition lock {
+  relation workflow: workflow
+}
+
+definition workflow {
+  relation idempotency_key: activity with expiration
+}
+
+definition activity {}
+"""
+
 # The default bootstrap schema applied when none is supplied: the proxy's own
 # workflow/lock/idempotency definitions plus the demo cluster/namespace/pod
 # types (behavioral equivalent of the reference's embedded bootstrap.yaml).
-DEFAULT_BOOTSTRAP_SCHEMA = """
-use expiration
-
+DEFAULT_BOOTSTRAP_SCHEMA = INTERNAL_SCHEMA + """
 definition cluster {}
 definition user {}
 definition namespace {
@@ -155,16 +184,39 @@ definition testresource {
   permission edit = creator
   permission view = viewer + creator
 }
-definition lock {
-  relation workflow: workflow
-}
-
-definition workflow {
-  relation idempotency_key: activity with expiration
-}
-
-definition activity {}
 """
+
+
+def merge_internal_definitions(schema: "sch.Schema") -> "sch.Schema":
+    """Add the proxy-internal definitions to `schema`.  A user definition
+    reusing one of the internal type names must carry the relations the
+    dual-write engine writes — otherwise every update rule would fail at
+    runtime once write validation runs — so collisions that drop an
+    internal relation are a loud bootstrap error, not a silent shadow."""
+    internal = sch.parse_schema(INTERNAL_SCHEMA)
+    for name, d in internal.definitions.items():
+        existing = schema.definitions.get(name)
+        if existing is None:
+            schema.definitions[name] = d
+            continue
+        # the user's redefinition must accept every subject-type annotation
+        # the engine writes (same relation name is not enough: `relation
+        # workflow: user` would still reject lock tuples at runtime)
+        missing = [
+            f"{rel}: {ref.type}"
+            for rel, refs in d.relations.items()
+            for ref in refs
+            if ref not in (existing.relations.get(rel) or ())
+        ]
+        if missing:
+            raise SchemaError(
+                f"definition `{name}` is reserved for the proxy's dual-write "
+                f"engine; a bootstrap schema may redefine it only if it "
+                f"keeps the engine's relation annotations (missing: "
+                f"{missing})")
+    if "expiration" not in schema.uses:
+        schema.uses = tuple(schema.uses) + ("expiration",)
+    return schema
 
 
 class EmbeddedEndpoint(PermissionsEndpoint):
@@ -185,7 +237,7 @@ class EmbeddedEndpoint(PermissionsEndpoint):
         else:
             schema_text = bootstrap.schema_text
             rel_text = bootstrap.relationships_text
-        endpoint = cls(sch.parse_schema(schema_text))
+        endpoint = cls(merge_internal_definitions(sch.parse_schema(schema_text)))
         if rel_text.strip():
             # columnar bulk path (native parser when available)
             endpoint.store.bulk_load_text(rel_text)
@@ -220,7 +272,7 @@ class EmbeddedEndpoint(PermissionsEndpoint):
 
     async def write_relationships(self, updates: Iterable[RelationshipUpdate],
                                   preconditions: Iterable[Precondition] = ()) -> int:
-        return self.store.write(updates, preconditions)
+        return self.store.write(self._validate_updates(updates), preconditions)
 
     async def delete_relationships(self, flt: RelationshipFilter,
                                    preconditions: Iterable[Precondition] = ()) -> int:
@@ -272,9 +324,13 @@ def create_endpoint(url: str,
         ep: PermissionsEndpoint = JaxEndpoint.from_bootstrap(bootstrap,
                                                              **kwargs)
         # cross-request batched dispatch is on by default for the device
-        # backend (`jax://?dispatch=direct` to bypass); the batch IS the
-        # kernel invocation (SURVEY.md §2 parallelism table)
-        dispatch = (params.get("dispatch") or ["batched"])[0]
+        # backend (`jax://?dispatch=direct` to bypass, or the
+        # CrossRequestBatching feature gate); the batch IS the kernel
+        # invocation (SURVEY.md §2 parallelism table)
+        from ..utils.features import GATES
+        default_dispatch = ("batched" if GATES.enabled("CrossRequestBatching")
+                            else "direct")
+        dispatch = (params.get("dispatch") or [default_dispatch])[0]
         if dispatch == "batched":
             from .dispatch import BatchingEndpoint
             try:

@@ -68,14 +68,33 @@ class _RemoteWatcher(WatchQueue):
     def __init__(self, target: str, object_types: Optional[list],
                  channel_factory):
         super().__init__()
-        self._channel = channel_factory()
+        # channel creation happens ON the stream thread: the factory may
+        # fetch/pin the server certificate (blocking socket I/O) and
+        # watch() is called synchronously from async code
+        # (responsefilterer.py run_watcher) — the event loop must not block
+        self._target = target
+        self._channel = None
+        self._channel_lock = threading.Lock()
+        self._closed_early = False
         self._thread = threading.Thread(
-            target=self._run, args=(object_types,), daemon=True)
+            target=self._run, args=(object_types, channel_factory),
+            daemon=True)
         self._thread.start()
 
-    def _run(self, object_types) -> None:
+    def _run(self, object_types, channel_factory) -> None:
         try:
-            call = self._channel.unary_stream(
+            with self._channel_lock:
+                if self._closed_early:
+                    return
+            # the factory may block (TCP dial, cert-pin fetch): run it
+            # OUTSIDE the lock so close() never waits on it
+            channel = channel_factory()
+            with self._channel_lock:
+                if self._closed_early:
+                    channel.close()
+                    return
+                self._channel = channel
+            call = channel.unary_stream(
                 _WATCH, request_serializer=_identity,
                 response_deserializer=_identity,
             )(wire.enc_watch_request(object_types))
@@ -87,12 +106,21 @@ class _RemoteWatcher(WatchQueue):
                                        revision=revision))
         except grpc.RpcError:
             pass  # channel closed / server gone: surface as closed watcher
+        except Exception:
+            import logging
+            logging.getLogger(__name__).exception(
+                "remote watch setup failed for %s — watch delivers no "
+                "events", self._target)
         finally:
             self._mark_closed()
 
     def close(self) -> None:
         self._mark_closed()
-        self._channel.close()
+        with self._channel_lock:
+            self._closed_early = True
+            channel = self._channel
+        if channel is not None:
+            channel.close()
 
 
 class RemoteEndpoint(PermissionsEndpoint):
@@ -117,6 +145,20 @@ class RemoteEndpoint(PermissionsEndpoint):
         return ([("authorization", f"Bearer {self.token}")]
                 if self.token else [])
 
+    @staticmethod
+    def _parse_target(target: str) -> tuple:
+        """(host, port) from a gRPC dial target.  Handles `[::1]:443`
+        bracketed IPv6 (brackets stripped for the socket dial), bare IPv6
+        addresses with no port, and `host[:port]` (default port 443)."""
+        if target.startswith("["):
+            host, _, rest = target[1:].partition("]")
+            port = rest[1:] if rest.startswith(":") else ""
+        elif target.count(":") > 1:  # bare IPv6 literal, no port
+            host, port = target, ""
+        else:
+            host, _, port = target.partition(":")
+        return host, int(port) if port.isdigit() else 443
+
     def _pin_server_cert(self) -> tuple:
         """skip_verify support (reference options.go:349-355
         `WithInsecureSkipVerify`): gRPC-python has no "don't verify" knob,
@@ -124,32 +166,49 @@ class RemoteEndpoint(PermissionsEndpoint):
         cached), pin it as the trust root, and override the TLS target name
         with the certificate's own subject so hostname verification passes
         for IP dials / SAN mismatches.  Returns (pem bytes, channel options).
+
+        Blocking socket I/O: async callers go through _ensure_pinned(),
+        which runs this in an executor; only the sync watch thread and
+        channel setup with the result already cached reach it directly.
         """
-        if self._pinned is None:
-            import ssl
-            import tempfile
-            host, _, port = self.target.rpartition(":")
-            if not port.isdigit():
-                host, port = self.target, "443"
-            pem = ssl.get_server_certificate((host, int(port)), timeout=10.0)
-            options = []
+        if self._pinned is not None:
+            return self._pinned
+        import ssl
+        host, port = self._parse_target(self.target)
+        pem = ssl.get_server_certificate((host, port), timeout=10.0)
+        options = []
+        try:
+            from cryptography import x509
+            from cryptography.x509.oid import NameOID
+
+            cert = x509.load_pem_x509_certificate(pem.encode())
+            names = []
             try:
-                with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
-                    f.write(pem)
-                    f.flush()
-                    decoded = ssl._ssl._test_decode_cert(f.name)
-                names = [v for k, v in decoded.get("subjectAltName", ())
-                         if k == "DNS"]
-                for field in decoded.get("subject", ()):
-                    for k, v in field:
-                        if k == "commonName":
-                            names.append(v)
-                if names and names[0] != host:
-                    options = [("grpc.ssl_target_name_override", names[0])]
-            except Exception:
-                pass  # no name override; pinning alone may still suffice
-            self._pinned = (pem.encode(), options)
+                san = cert.extensions.get_extension_for_class(
+                    x509.SubjectAlternativeName)
+                names = list(san.value.get_values_for_type(x509.DNSName))
+            except x509.ExtensionNotFound:
+                pass
+            names += [a.value for a in
+                      cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)]
+            if names and names[0] != host:
+                options = [("grpc.ssl_target_name_override", names[0])]
+        except Exception:
+            pass  # no name override; pinning alone may still suffice
+        # benign race: two concurrent fetchers produce the same certificate
+        self._pinned = (pem.encode(), options)
         return self._pinned
+
+    def _needs_pin(self) -> bool:
+        return (not self.insecure and self.skip_verify
+                and self.ca_pem is None and self._pinned is None)
+
+    async def _ensure_pinned(self) -> None:
+        """Fetch/pin the server certificate off-loop, before channel
+        creation, so no blocking socket I/O ever runs on the event loop."""
+        if self._needs_pin():
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, self._pin_server_cert)
 
     def _creds(self) -> tuple:
         """(channel credentials, channel options) for TLS channels."""
@@ -178,6 +237,7 @@ class RemoteEndpoint(PermissionsEndpoint):
         return grpc.secure_channel(self.target, creds, options=options)
 
     async def _unary(self, method: str, payload: bytes) -> bytes:
+        await self._ensure_pinned()
         fn = self._channel().unary_unary(
             _PERMS + method, request_serializer=_identity,
             response_deserializer=_identity)
@@ -188,6 +248,7 @@ class RemoteEndpoint(PermissionsEndpoint):
 
     async def _unary_stream(self, method: str, payload: bytes):
         """Open a server-stream and yield raw frames as they arrive."""
+        await self._ensure_pinned()
         fn = self._channel().unary_stream(
             _PERMS + method, request_serializer=_identity,
             response_deserializer=_identity)

@@ -516,3 +516,57 @@ def _validate_expr(schema: Schema, d: Definition, perm: str, e: Expr) -> None:
 
 def parse_schema(src: str) -> Schema:
     return _P(_tokenize(src), src).parse_schema()
+
+
+def validate_relationship(schema: Schema, rel) -> None:
+    """Reject writes the schema does not permit — the behavior of SpiceDB's
+    WriteRelationships validation behind the reference's embedded server:
+    undefined resource/subject types, writes to permissions or undeclared
+    relations, subject types/sub-relations a relation does not accept,
+    wildcard subjects without a `type:*` annotation, and caveats that are
+    not declared in the schema.  Raises SchemaError."""
+    d = schema.definition(rel.resource.type)
+    relation = rel.relation
+    if relation in d.permissions:
+        raise SchemaError(
+            f"cannot write relationship to permission "
+            f"`{rel.resource.type}#{relation}`")
+    refs = d.relations.get(relation)
+    if refs is None:
+        raise SchemaError(
+            f"relation `{relation}` not found on definition "
+            f"`{rel.resource.type}`")
+    schema.definition(rel.subject.type)  # subject type must exist
+    caveat = getattr(rel, "caveat", None)
+    if caveat is not None and caveat.name not in schema.caveats:
+        raise SchemaError(f"caveat `{caveat.name}` not found in schema")
+    sub_rel = rel.subject.relation or ""
+    wildcard = rel.subject.id == "*"
+    # the traits the written tuple carries must be exactly what a matching
+    # type annotation requires: `user with c` accepts only c-caveated
+    # tuples, plain `user` only trait-free ones (SpiceDB semantics —
+    # permit both by declaring `user | user with c`)
+    tuple_traits = set()
+    if caveat is not None:
+        tuple_traits.add(caveat.name)
+    if getattr(rel, "expires_at", None) is not None:
+        tuple_traits.add("expiration")
+    for ref in refs:
+        if ref.type != rel.subject.type:
+            continue
+        if set(ref.traits) != tuple_traits:
+            continue
+        if wildcard:
+            if ref.wildcard:
+                break
+            continue
+        if not ref.wildcard and (ref.relation or "") == sub_rel:
+            break
+    else:
+        want = (f"{rel.subject.type}:*" if wildcard
+                else rel.subject.type + (f"#{sub_rel}" if sub_rel else ""))
+        if tuple_traits:
+            want += " with " + " and ".join(sorted(tuple_traits))
+        raise SchemaError(
+            f"subject `{want}` is not allowed on relation "
+            f"`{rel.resource.type}#{relation}`")

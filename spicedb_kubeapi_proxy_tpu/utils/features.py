@@ -1,0 +1,86 @@
+"""Feature-gate registry (reference pkg/proxy/features.go:10-27).
+
+The reference registers the component-base logging gates
+(LoggingAlphaOptions/LoggingBetaOptions/ContextualLogging) into a mutable
+gate map consulted at runtime.  This build keeps the same contract: named
+boolean gates with a maturity stage and default, settable from the CLI
+(`--feature-gates name=true,other=false`) or programmatically, consulted
+via `enabled()`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+ALPHA = "ALPHA"
+BETA = "BETA"
+GA = "GA"
+
+
+class FeatureGateError(ValueError):
+    pass
+
+
+@dataclass
+class _Gate:
+    name: str
+    stage: str
+    default: bool
+    value: bool
+
+
+class FeatureGates:
+    def __init__(self):
+        self._gates: Dict[str, _Gate] = {}
+
+    def register(self, name: str, stage: str = ALPHA,
+                 default: bool = False) -> None:
+        if name in self._gates:
+            raise FeatureGateError(f"feature gate {name!r} already registered")
+        self._gates[name] = _Gate(name, stage, default, default)
+
+    def enabled(self, name: str) -> bool:
+        gate = self._gates.get(name)
+        if gate is None:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        return gate.value
+
+    def set(self, name: str, value: bool) -> None:
+        gate = self._gates.get(name)
+        if gate is None:
+            raise FeatureGateError(f"unknown feature gate {name!r}")
+        gate.value = value
+
+    def apply_flag(self, spec: str) -> None:
+        """Parse a `name=true,name2=false` CLI value (component-base
+        syntax; a bare name means true)."""
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, raw = part.partition("=")
+            raw = raw.strip().lower() or "true"
+            if raw not in ("true", "false"):
+                raise FeatureGateError(
+                    f"invalid feature gate value {part!r}: want name=true|false")
+            self.set(name.strip(), raw == "true")
+
+    def known(self) -> dict:
+        return {g.name: (g.stage, g.value) for g in self._gates.values()}
+
+    def reset(self) -> None:
+        for g in self._gates.values():
+            g.value = g.default
+
+
+# process-wide gates, mirroring the reference's global gate map
+GATES = FeatureGates()
+
+# logging gates the reference registers (features.go:17-26)
+GATES.register("ContextualLogging", stage=ALPHA, default=True)
+GATES.register("LoggingAlphaOptions", stage=ALPHA, default=False)
+GATES.register("LoggingBetaOptions", stage=BETA, default=True)
+# build-specific gates
+GATES.register("StructuredRequestLog", stage=BETA, default=True)
+GATES.register("CrossRequestBatching", stage=GA, default=True)

@@ -1,0 +1,60 @@
+"""Feature-gate registry (reference pkg/proxy/features.go:10-27)."""
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.utils.features import (
+    ALPHA,
+    GATES,
+    FeatureGateError,
+    FeatureGates,
+)
+
+
+@pytest.fixture(autouse=True)
+def reset_global():
+    yield
+    GATES.reset()
+
+
+class TestFeatureGates:
+    def test_register_and_defaults(self):
+        g = FeatureGates()
+        g.register("X", stage=ALPHA, default=False)
+        assert g.enabled("X") is False
+        g.set("X", True)
+        assert g.enabled("X") is True
+
+    def test_duplicate_registration_rejected(self):
+        g = FeatureGates()
+        g.register("X")
+        with pytest.raises(FeatureGateError, match="already"):
+            g.register("X")
+
+    def test_unknown_gate_rejected(self):
+        g = FeatureGates()
+        with pytest.raises(FeatureGateError, match="unknown"):
+            g.enabled("nope")
+
+    def test_apply_flag_syntax(self):
+        g = FeatureGates()
+        g.register("A")
+        g.register("B", default=True)
+        g.apply_flag("A=true, B=false")
+        assert g.enabled("A") and not g.enabled("B")
+        with pytest.raises(FeatureGateError, match="invalid"):
+            g.apply_flag("A=maybe")
+        with pytest.raises(FeatureGateError, match="unknown"):
+            g.apply_flag("C=true")
+
+    def test_reference_gates_registered(self):
+        known = GATES.known()
+        for name in ("ContextualLogging", "LoggingAlphaOptions",
+                     "LoggingBetaOptions"):
+            assert name in known
+
+    def test_cli_flag_applies(self):
+        from spicedb_kubeapi_proxy_tpu import cli
+        args = cli.build_parser().parse_args(
+            ["--feature-gates", "LoggingAlphaOptions=true",
+             "--use-in-cluster-config"])
+        assert args.feature_gates == "LoggingAlphaOptions=true"

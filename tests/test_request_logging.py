@@ -1,0 +1,102 @@
+"""Structured request logging (reference pkg/authz/requestlogger.go,
+rules.go:242-279): the proxy log line carries user/rule/GVR context and
+the authz outcome; per-verb latency lands in a histogram."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import FakeKubeApiServer
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
+from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipUpdate,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: ns-read}
+match: [{apiVersion: v1, resource: namespaces, verbs: [get]}]
+check: [{tpl: "namespace:{{name}}#view@user:{{user.name}}"}]
+"""
+
+
+def make_proxy():
+    kube = FakeKubeApiServer()
+    kube.seed("", "v1", "namespaces", {"metadata": {"name": "ns1"}})
+    proxy = ProxyServer(Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=HandlerTransport(kube),
+    ))
+    proxy.endpoint.store.bulk_load(
+        [parse_relationship("namespace:ns1#viewer@user:alice")])
+    return proxy
+
+
+class TestStructuredLogging:
+    def test_allowed_request_logs_kv_fields(self, caplog):
+        proxy = make_proxy()
+        client = proxy.get_embedded_client(user="alice", groups=["devs"])
+        with caplog.at_level(logging.INFO,
+                             logger="spicedb_kubeapi_proxy_tpu.proxy"):
+            resp = asyncio.run(client.get("/api/v1/namespaces/ns1"))
+        assert resp.status == 200
+        line = next(r.message for r in caplog.records
+                    if "/api/v1/namespaces/ns1" in r.message)
+        assert "user='alice'" in line
+        assert "groups='devs'" in line
+        assert "request.verb='get'" in line
+        assert "request.resource='namespaces'" in line
+        assert "name='ns1'" in line
+        assert "rules='ns-read'" in line
+        assert "authz='allowed'" in line
+        assert "ms)" in line  # latency recorded
+
+    def test_denied_request_logs_denied_outcome(self, caplog):
+        proxy = make_proxy()
+        client = proxy.get_embedded_client(user="mallory")
+        with caplog.at_level(logging.INFO,
+                             logger="spicedb_kubeapi_proxy_tpu.proxy"):
+            resp = asyncio.run(client.get("/api/v1/namespaces/ns1"))
+        assert resp.status == 403
+        line = next(r.message for r in caplog.records
+                    if "/api/v1/namespaces/ns1" in r.message)
+        assert "user='mallory'" in line
+        assert "authz='denied'" in line
+
+    def test_authorization_header_redacted(self, caplog):
+        proxy = make_proxy()
+        client = proxy.get_embedded_client(user="alice")
+        with caplog.at_level(logging.INFO,
+                             logger="spicedb_kubeapi_proxy_tpu.proxy"):
+            asyncio.run(client.get(
+                "/api/v1/namespaces/ns1",
+                headers=[("Authorization", "Bearer supersecret")]))
+        line = next(r.message for r in caplog.records
+                    if "/api/v1/namespaces/ns1" in r.message)
+        assert "supersecret" not in line
+        assert "[redacted]" in line
+
+    def test_per_verb_latency_histogram(self):
+        from spicedb_kubeapi_proxy_tpu.utils.metrics import REGISTRY
+        proxy = make_proxy()
+        client = proxy.get_embedded_client(user="alice")
+        asyncio.run(client.get("/api/v1/namespaces/ns1"))
+        rendered = REGISTRY.render()
+        assert "proxy_http_request_seconds" in rendered
+        assert 'verb="get"' in rendered

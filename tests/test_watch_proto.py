@@ -1,0 +1,282 @@
+"""Protobuf watch-stream filtering + fail-closed framing (round-4).
+
+The reference decodes watch events with the negotiated streaming codec,
+including protobuf (responsefilterer.go:500-506), and a Status event is
+written through without terminating the stream (responsefilterer.go:645-651).
+Round 3 relayed undecodable frames unfiltered — an authorization bypass.
+These tests pin the fixed semantics:
+
+- proto frames are decoded at the wire level and filtered like JSON ones;
+- undecodable frames (either framing) are DROPPED, never relayed;
+- Status/ERROR events pass through and the stream continues;
+- allowed frames replay byte-exactly (length prefix included).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.authz.frames import frame_length_delimited
+from spicedb_kubeapi_proxy_tpu.authz.responsefilterer import (
+    WatchResponseFilterer,
+)
+from spicedb_kubeapi_proxy_tpu.authz.watch import ResultChange, WatchTracker
+from spicedb_kubeapi_proxy_tpu.proxy import k8sproto
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import Request, Response
+
+
+def pod_envelope(name, namespace):
+    return k8sproto.encode_unknown(
+        "v1", "Pod", k8sproto.encode_object("v1", "Pod", name, namespace),
+        "application/vnd.kubernetes.protobuf")
+
+
+def pod_event(event_type, name, namespace):
+    """A framed (length-prefixed) protobuf watch event."""
+    return k8sproto.encode_watch_event(event_type,
+                                       pod_envelope(name, namespace))
+
+
+def status_event_proto():
+    env = k8sproto.encode_unknown("v1", "Status", b"",
+                                  "application/vnd.kubernetes.protobuf")
+    return k8sproto.encode_watch_event("ERROR", env)
+
+
+def json_event(event_type, name, namespace):
+    return (json.dumps({"type": event_type, "object": {
+        "kind": "Pod", "apiVersion": "v1",
+        "metadata": {"name": name, "namespace": namespace}}}) + "\n").encode()
+
+
+def make_filterer():
+    f = WatchResponseFilterer.__new__(WatchResponseFilterer)
+    f._tracker = WatchTracker()
+    f._watch_task = None
+    return f
+
+
+async def collect(stream, n, timeout=5):
+    """Pull up to n frames from an async generator with a deadline."""
+    got = []
+
+    async def consume():
+        async for frame in stream:
+            got.append(frame)
+            if len(got) >= n:
+                return
+
+    try:
+        await asyncio.wait_for(consume(), timeout)
+    except asyncio.TimeoutError:
+        pass
+    return got
+
+
+class TestProtoWatchFiltering:
+    def test_allowed_frame_replayed_byte_exact(self):
+        filt = make_filterer()
+        frame = pod_event("ADDED", "p1", "ns")
+
+        async def upstream():
+            yield frame
+            await asyncio.sleep(30)
+
+        async def go():
+            out = filt._filtered_stream(upstream(), proto=True)
+            await filt._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p1"))
+            got = await collect(out, 1)
+            assert got == [frame]  # byte-exact, prefix included
+        asyncio.run(go())
+
+    def test_disallowed_frame_not_leaked_then_flushed_on_grant(self):
+        filt = make_filterer()
+        frame = pod_event("ADDED", "secret", "ns")
+
+        async def upstream():
+            yield frame
+            await asyncio.sleep(30)
+
+        async def go():
+            out = filt._filtered_stream(upstream(), proto=True)
+            got = []
+
+            async def consume():
+                async for f in out:
+                    got.append(f)
+
+            task = asyncio.ensure_future(consume())
+            await asyncio.sleep(0.2)
+            assert got == []  # buffered, not leaked
+            await filt._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="secret"))
+            await asyncio.sleep(0.2)
+            assert got == [frame]
+            task.cancel()
+        asyncio.run(go())
+
+    def test_undecodable_proto_frame_dropped_not_relayed(self):
+        """The round-3 bypass: garbage frames must be dropped, and later
+        authorized traffic still flows."""
+        filt = make_filterer()
+        garbage = len(b"\xff\xfe\xfd\xfc").to_bytes(4, "big") + b"\xff\xfe\xfd\xfc"
+        good = pod_event("ADDED", "p1", "ns")
+
+        async def upstream():
+            yield garbage
+            yield good
+            await asyncio.sleep(30)
+
+        async def go():
+            out = filt._filtered_stream(upstream(), proto=True)
+            await filt._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p1"))
+            got = await collect(out, 2, timeout=1)
+            assert got == [good]  # garbage dropped, good one through
+        asyncio.run(go())
+
+    def test_status_event_passes_through_and_stream_continues(self):
+        filt = make_filterer()
+        status = status_event_proto()
+        after = pod_event("ADDED", "p2", "ns")
+
+        async def upstream():
+            yield status
+            yield after
+            await asyncio.sleep(30)
+
+        async def go():
+            out = filt._filtered_stream(upstream(), proto=True)
+            await filt._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p2"))
+            got = await collect(out, 2)
+            assert got == [status, after]
+        asyncio.run(go())
+
+    def test_table_event_unwrapped(self):
+        """Watch Table events carry the row object's meta
+        (responsefilterer.go:667-677)."""
+        filt = make_filterer()
+        table = k8sproto.encode_table([pod_envelope("p9", "ns")])
+        _, _, raw, ct = k8sproto.decode_unknown(table)
+        env = k8sproto.encode_unknown("meta.k8s.io/v1", "Table", raw, ct)
+        frame = k8sproto.encode_watch_event("ADDED", env)
+
+        async def upstream():
+            yield frame
+            await asyncio.sleep(30)
+
+        async def go():
+            out = filt._filtered_stream(upstream(), proto=True)
+            await filt._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p9"))
+            got = await collect(out, 1)
+            assert got == [frame]
+        asyncio.run(go())
+
+    def test_oversized_length_prefix_terminates_stream(self):
+        """A corrupt 4-byte length (e.g. 0xFFFFFFFF) must terminate the
+        watch instead of buffering the rest of the stream forever."""
+        good = pod_event("ADDED", "p1", "ns")
+
+        async def upstream():
+            yield good
+            yield (0xFFFFFFFF).to_bytes(4, "big") + b"garbage"
+            yield good  # never reached: framer bails out
+
+        async def go():
+            got = [f async for f in frame_length_delimited(upstream())]
+            assert got == [good]
+        asyncio.run(go())
+
+    def test_truncated_trailing_frame_dropped(self):
+        async def upstream():
+            frame = pod_event("ADDED", "p1", "ns")
+            yield frame[: len(frame) - 3]  # stream dies mid-frame
+
+        async def go():
+            got = [f async for f in frame_length_delimited(upstream())]
+            assert got == []
+        asyncio.run(go())
+
+    def test_frames_split_across_chunks(self):
+        f1 = pod_event("ADDED", "p1", "ns")
+        f2 = pod_event("MODIFIED", "p2", "ns")
+        blob = f1 + f2
+
+        async def upstream():
+            yield blob[:5]
+            yield blob[5:17]
+            yield blob[17:]
+
+        async def go():
+            got = [f async for f in frame_length_delimited(upstream())]
+            assert got == [f1, f2]
+        asyncio.run(go())
+
+
+class TestJsonWatchFailClosed:
+    def test_garbage_json_line_dropped_not_relayed(self):
+        filt = make_filterer()
+        good = json_event("ADDED", "p1", "ns")
+
+        async def upstream():
+            yield b"\x00\x01 this is not json\n"
+            yield good
+            await asyncio.sleep(30)
+
+        async def go():
+            out = filt._filtered_stream(upstream())
+            await filt._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p1"))
+            got = await collect(out, 2, timeout=1)
+            assert got == [good]
+        asyncio.run(go())
+
+    def test_status_event_does_not_terminate_json_stream(self):
+        filt = make_filterer()
+        status = (json.dumps({"type": "ERROR", "object": {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "code": 500}}) + "\n").encode()
+        after = json_event("ADDED", "p3", "ns")
+
+        async def upstream():
+            yield status
+            yield after
+            await asyncio.sleep(30)
+
+        async def go():
+            out = filt._filtered_stream(upstream())
+            await filt._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p3"))
+            got = await collect(out, 2)
+            assert got == [status, after]
+        asyncio.run(go())
+
+
+class TestContentTypeSelectsFraming:
+    def test_filter_resp_detects_proto_stream(self):
+        """filter_resp must pick length-delimited framing from the
+        upstream Content-Type, not assume newline JSON."""
+        filt = make_filterer()
+        frame = pod_event("ADDED", "p1", "ns")
+
+        async def upstream():
+            yield frame
+            await asyncio.sleep(30)
+
+        resp = Response(status=200)
+        resp.headers.set(
+            "Content-Type",
+            "application/vnd.kubernetes.protobuf;stream=watch")
+        resp.stream = upstream()
+
+        async def go():
+            await filt.filter_resp(resp, Request(method="GET", target="/"))
+            await filt._tracker.changes.put(
+                ResultChange(allowed=True, namespace="ns", name="p1"))
+            got = await collect(resp.stream, 1)
+            assert got == [frame]
+        asyncio.run(go())

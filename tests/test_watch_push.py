@@ -16,19 +16,12 @@ from spicedb_kubeapi_proxy_tpu.authz.responsefilterer import (
     WatchResponseFilterer,
 )
 from spicedb_kubeapi_proxy_tpu.authz.watch import ResultChange, WatchTracker
-from spicedb_kubeapi_proxy_tpu.spicedb.schema import parse_schema
 from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
 from spicedb_kubeapi_proxy_tpu.spicedb.types import (
     RelationshipUpdate,
     UpdateOp,
     parse_relationship,
 )
-
-SCHEMA = parse_schema("""
-definition user {}
-definition pod { relation viewer: user
-                 permission view = viewer }
-""")
 
 
 def run(coro):
@@ -44,7 +37,7 @@ class TestAsyncNext:
     def test_push_latency_beats_poll_interval(self):
         """The event must arrive well under the old 0.5s poll interval —
         proof the consumer is woken, not polling."""
-        store = TupleStore(SCHEMA)
+        store = TupleStore()
         w = store.subscribe(["pod"])
 
         async def go():
@@ -66,7 +59,7 @@ class TestAsyncNext:
         w.close()
 
     def test_next_returns_none_on_close(self):
-        store = TupleStore(SCHEMA)
+        store = TupleStore()
         w = store.subscribe(["pod"])
 
         async def go():
@@ -77,7 +70,7 @@ class TestAsyncNext:
         run(go())
 
     def test_next_drains_backlog_then_blocks(self):
-        store = TupleStore(SCHEMA)
+        store = TupleStore()
         w = store.subscribe(["pod"])
         touch(store, "pod:a/p1#viewer@user:alice")
         touch(store, "pod:a/p2#viewer@user:alice")
@@ -95,7 +88,7 @@ class TestAsyncNext:
         """100 concurrent async watchers all receive one write promptly —
         with thread-polling this would need 100 threads; here it's one
         wake fan-out."""
-        store = TupleStore(SCHEMA)
+        store = TupleStore()
         watchers = [store.subscribe(["pod"]) for _ in range(100)]
 
         async def go():
@@ -112,7 +105,7 @@ class TestAsyncNext:
 
     def test_sync_poll_still_works(self):
         """The workflow engine and tests still use blocking poll()."""
-        store = TupleStore(SCHEMA)
+        store = TupleStore()
         w = store.subscribe(["pod"])
         touch(store, "pod:a/p1#viewer@user:alice")
         assert w.poll(timeout=1).updates[0].rel.resource.id == "a/p1"

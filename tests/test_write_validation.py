@@ -1,0 +1,122 @@
+"""Schema validation of relationship writes (SpiceDB WriteRelationships
+semantics behind the reference's embedded server, spicedb.go:18-71):
+undefined types, permission writes, undeclared relations, disallowed
+subject types, and unknown caveats are rejected; the proxy's internal
+lock/workflow definitions are always merged so dual-write bookkeeping
+validates against any user schema."""
+
+import asyncio
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+    Bootstrap,
+    create_endpoint,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipUpdate,
+    SchemaError,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = """
+caveat on_tuesday(day string) { day == "tuesday" }
+definition user {}
+definition group { relation member: user | group#member }
+definition doc {
+  relation viewer: user | group#member | user:* | user with on_tuesday
+  permission view = viewer
+}
+"""
+
+
+def write(ep, rel):
+    return asyncio.run(ep.write_relationships(
+        [RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(rel))]))
+
+
+@pytest.fixture(params=["embedded://", "jax://"])
+def ep(request):
+    return create_endpoint(request.param, Bootstrap(schema_text=SCHEMA))
+
+
+class TestWriteValidation:
+    def test_valid_writes_accepted(self, ep):
+        write(ep, "doc:d1#viewer@user:alice")
+        write(ep, "doc:d1#viewer@group:eng#member")
+        write(ep, "doc:d1#viewer@user:*")
+        write(ep, "doc:d1#viewer@user:bob[caveat:on_tuesday]")
+
+    def test_undefined_resource_type(self, ep):
+        with pytest.raises(SchemaError, match="not found"):
+            write(ep, "widget:w1#viewer@user:alice")
+
+    def test_undefined_subject_type(self, ep):
+        with pytest.raises(SchemaError, match="not found"):
+            write(ep, "doc:d1#viewer@robot:r2")
+
+    def test_write_to_permission_rejected(self, ep):
+        with pytest.raises(SchemaError, match="permission"):
+            write(ep, "doc:d1#view@user:alice")
+
+    def test_undeclared_relation(self, ep):
+        with pytest.raises(SchemaError, match="relation"):
+            write(ep, "doc:d1#owner@user:alice")
+
+    def test_subject_relation_mismatch(self, ep):
+        # group#member is allowed; bare group is not
+        with pytest.raises(SchemaError, match="not allowed"):
+            write(ep, "doc:d1#viewer@group:eng")
+
+    def test_wildcard_needs_annotation(self, ep):
+        with pytest.raises(SchemaError, match="not allowed"):
+            write(ep, "group:eng#member@user:*")
+
+    def test_unknown_caveat(self, ep):
+        with pytest.raises(SchemaError, match="caveat"):
+            write(ep, "doc:d1#viewer@user:a[caveat:nonexistent]")
+
+    def test_internal_lock_workflow_always_valid(self, ep):
+        """The dual-write engine's bookkeeping tuples validate against ANY
+        user schema because the internal definitions are merged in.  The
+        idempotency key is declared `activity with expiration`, and the
+        engine always writes it with one (activity.py 24h expiry)."""
+        write(ep, "lock:abc123#workflow@workflow:wf-1")
+        write(ep, "workflow:wf-1#idempotency_key@activity:k1"
+                  "[expiration:4102444800]")
+        # an expiration-less idempotency key is NOT what the ref declares
+        with pytest.raises(SchemaError, match="not allowed"):
+            write(ep, "workflow:wf-1#idempotency_key@activity:k1")
+
+    def test_reserved_internal_name_collision_is_loud(self):
+        """A user schema redefining `workflow` without the relations the
+        dual-write engine writes fails at bootstrap, not at runtime."""
+        with pytest.raises(SchemaError, match="reserved"):
+            create_endpoint("embedded://", Bootstrap(schema_text="""
+definition user {}
+definition workflow { relation owner: user }
+"""))
+
+    def test_reserved_name_collision_wrong_subject_type_is_loud(self):
+        """Same relation name with the wrong subject type would reject the
+        engine's tuples at runtime — caught at bootstrap instead."""
+        with pytest.raises(SchemaError, match="reserved"):
+            create_endpoint("embedded://", Bootstrap(schema_text="""
+definition user {}
+definition workflow { relation idempotency_key: user }
+definition lock { relation workflow: workflow }
+definition activity {}
+"""))
+
+    def test_reserved_name_ok_when_relations_compatible(self):
+        ep = create_endpoint("embedded://", Bootstrap(schema_text="""
+use expiration
+definition user {}
+definition activity {}
+definition workflow {
+  relation idempotency_key: activity with expiration
+  relation owner: user
+}
+"""))
+        write(ep, "workflow:wf#owner@user:alice")
