@@ -21,7 +21,7 @@ JAX_PLATFORMS=cpu python __graft_entry__.py 8
 
 if [[ "${1:-}" != "--fast" ]]; then
   echo "== bench smoke (pods-depth1, CPU)"
-  JAX_PLATFORMS=cpu python bench.py --config pods-depth1 --batch 64 \
+  JAX_PLATFORMS=cpu python bench.py --config pods-depth1 --single --batch 64 \
       --rounds 2 --oracle-queries 1
 fi
 

@@ -78,6 +78,20 @@ definition pod {
 }
 """
 
+CAVEATED_RBAC_SCHEMA = """
+caveat within_quota(used int, quota int) { used < quota }
+definition user {}
+definition group {
+  relation member: user | group#member | user with within_quota
+}
+definition pod {
+  relation assigned: user | group#member | user with within_quota
+  relation approved: group#member
+  relation banned: user | group#member | user with within_quota
+  permission view = assigned & approved - banned
+}
+"""
+
 MULTITENANT_SCHEMA = """
 definition user {}
 definition group {
@@ -191,6 +205,54 @@ def rbac_deny(n_pods: int = 10_000, n_users: int = 2_000,
     return Workload(
         name="rbac-deny",
         schema_text=RBAC_DENY_SCHEMA,
+        relationships=sorted(rels),
+        subjects=[f"u{i}" for i in range(n_users)],
+        resource_type="pod",
+        permission="view",
+        expected_objects=n_pods,
+    )
+
+
+def caveated_rbac(n_pods: int = 10_000, n_users: int = 2_000,
+                  n_groups: int = 100, caveat_fraction: float = 0.15,
+                  seed: int = 7) -> Workload:
+    """Caveat-heavy variant of config 4 (round-4 VERDICT item 5): a
+    `caveat_fraction` share of membership/assignment/ban tuples carry an
+    UNDECIDABLE caveat (context lacks the quota), exercising the tri-state
+    definite/maybe bitplane path of the ELL kernel — previously these
+    queries dropped to the recursive host oracle at ~1.8e3 checks/s."""
+    rng = random.Random(seed)
+
+    def maybe_caveat():
+        if rng.random() < caveat_fraction:
+            # one in three carries a DECIDED context (compile-time resolve)
+            roll = rng.random()
+            if roll < 0.2:
+                return '[caveat:within_quota:{"used": 1, "quota": 5}]'
+            if roll < 0.33:
+                return '[caveat:within_quota:{"used": 9, "quota": 5}]'
+            return '[caveat:within_quota:{"used": 1}]'  # undecidable
+        return ""
+
+    rels = set()
+    for u in range(n_users):
+        rels.add(f"group:g{rng.randrange(n_groups)}#member@user:u{u}"
+                 f"{maybe_caveat()}")
+        if rng.random() < 0.05:
+            rels.add(f"group:blocked#member@user:u{u}")
+    for p in range(n_pods):
+        g = rng.randrange(n_groups)
+        rels.add(f"pod:ns{p % 100}/p{p}#assigned@group:g{g}#member")
+        rels.add(f"pod:ns{p % 100}/p{p}#approved@group:"
+                 f"g{(g + rng.randrange(2)) % n_groups}#member")
+        if rng.random() < 0.3:
+            rels.add(f"pod:ns{p % 100}/p{p}#banned@group:blocked#member")
+        if rng.random() < 0.1:
+            rels.add(f"pod:ns{p % 100}/p{p}#banned@user:"
+                     f"u{rng.randrange(n_users)}{maybe_caveat()}")
+    return Workload(
+        name="caveats-rbac",
+        schema_text=CAVEATED_RBAC_SCHEMA,
         relationships=sorted(rels),
         subjects=[f"u{i}" for i in range(n_users)],
         resource_type="pod",

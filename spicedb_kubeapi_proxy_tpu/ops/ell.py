@@ -56,6 +56,9 @@ from .graph_compile import (
 K_MAIN = 8
 # Aux-node fanin: wider is better for hubs (fewer tree levels).
 K_AUX = 32
+# Caveat (MAYBE-plane) table fanin; caveated tuples are typically sparse,
+# hubs tree-split inside the same table
+K_CAV = 8
 
 MAX_ITERATIONS = 50  # matches embedded reference dispatch depth cap
 
@@ -131,32 +134,92 @@ def build_tables(prog: GraphProgram) -> EllTables:
                      tree_depth=tree_depth)
 
 
+@dataclass
+class CavTables:
+    """MAYBE-plane adjacency: one [NT, K_CAV] gather table whose OR is the
+    one-step closure over UNDECIDABLE caveated edges only.  Caveat hubs
+    tree-split into aux rows appended after the shared aux rows (their
+    children live in this same table); rows the shared tables own are
+    dead-padded here and vice versa."""
+    idx_cav: np.ndarray   # int32 [NT, K_CAV]
+    n_aux_cav: int
+    tree_depth: int
+
+
+def build_cav_tables(prog: GraphProgram, n_aux_shared: int) -> CavTables:
+    """Destination-major fixed-fanin table for the program's caveat edges.
+    Python-loop build is fine: caveated tuples are sparse by nature."""
+    dead = prog.dead_index
+    base = prog.state_size + n_aux_shared
+    groups: dict[int, list] = {}
+    for s, d in zip(prog.cav_src, prog.cav_dst):
+        groups.setdefault(int(d), []).append(int(s))
+    aux_rows: list[list] = []
+    roots: dict[int, list] = {}
+    tree_depth = 0
+    for dst, children in groups.items():
+        depth = 0
+        while len(children) > K_CAV:
+            nxt = []
+            for i in range(0, len(children), K_CAV):
+                aux_rows.append(children[i: i + K_CAV])
+                nxt.append(base + len(aux_rows) - 1)
+            children = nxt
+            depth += 1
+        roots[dst] = children
+        tree_depth = max(tree_depth, depth)
+    nt = base + len(aux_rows)
+    idx_cav = np.full((nt, K_CAV), dead, np.int32)
+    for dst, children in roots.items():
+        idx_cav[dst, : len(children)] = children
+    for j, children in enumerate(aux_rows):
+        idx_cav[base + j, : len(children)] = children
+    return CavTables(idx_cav=idx_cav, n_aux_cav=len(aux_rows),
+                     tree_depth=tree_depth)
+
+
 # -- packed expression program ----------------------------------------------
 
-def _apply_perm_expr_packed(expr, x: jnp.ndarray) -> jnp.ndarray:
+def _apply_perm_expr_packed(expr, x: jnp.ndarray,
+                            half: Optional[int] = None) -> jnp.ndarray:
+    """Evaluate a permission expression over packed state.
+
+    With `half` set, x carries TWO bitplanes side by side: words [0, half)
+    are the DEFINITE plane, words [half, 2*half) the MAYBE plane
+    (maybe ⊇ definite always).  Union/intersection act planewise (Kleene:
+    T∨U=T via the def plane, T∧U=U via the maybe plane); exclusion mixes
+    planes —  def(A−B) = def(A) ∧ ¬maybe(B),  maybe(A−B) = maybe(A) ∧
+    ¬def(B) — which is exactly `base & ~swap(sub)` with the halves of the
+    subtrahend swapped."""
     if isinstance(expr, PRead):
         return jax.lax.dynamic_slice_in_dim(x, expr.offset, expr.length, axis=0)
     if isinstance(expr, PZero):
         return jnp.zeros((expr.length, x.shape[1]), dtype=x.dtype)
     if isinstance(expr, PUnion):
-        out = _apply_perm_expr_packed(expr.children[0], x)
+        out = _apply_perm_expr_packed(expr.children[0], x, half)
         for c in expr.children[1:]:
-            out = out | _apply_perm_expr_packed(c, x)
+            out = out | _apply_perm_expr_packed(c, x, half)
         return out
     if isinstance(expr, PIntersect):
-        out = _apply_perm_expr_packed(expr.children[0], x)
+        out = _apply_perm_expr_packed(expr.children[0], x, half)
         for c in expr.children[1:]:
-            out = out & _apply_perm_expr_packed(c, x)
+            out = out & _apply_perm_expr_packed(c, x, half)
         return out
     if isinstance(expr, PExclude):
-        base = _apply_perm_expr_packed(expr.base, x)
-        sub = _apply_perm_expr_packed(expr.subtract, x)
+        base = _apply_perm_expr_packed(expr.base, x, half)
+        sub = _apply_perm_expr_packed(expr.subtract, x, half)
+        if half is not None:
+            sub = jnp.concatenate([sub[:, half:], sub[:, :half]], axis=1)
         return base & ~sub
     raise TypeError(f"unknown perm expr {expr!r}")
 
 
-def make_ell_step(prog: GraphProgram, n_aux_rows: int):
-    """Per-iteration transition over packed state x: [NT, W] uint32."""
+def make_ell_step(prog: GraphProgram, n_aux_rows: int,
+                  half: Optional[int] = None):
+    """Per-iteration transition over packed state x: [NT, W] uint32 —
+    or [NT, 2*half] when the tri-state (definite/maybe bitplane) path is
+    active (`half` = words per plane; an idx_cav table feeds the MAYBE
+    half with the undecidable caveated edges)."""
     n = prog.state_size
     dead = prog.dead_index
     perm_ops = tuple(prog.perm_ops)
@@ -167,7 +230,7 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int):
         m[np.asarray(term.mask_indices, np.int64)] = np.uint32(0xFFFFFFFF)
         wc_masks.append(jnp.asarray(m))
 
-    def step(x, x0, idx_main, idx_aux):
+    def step(x, x0, idx_main, idx_aux, idx_cav=None):
         # one-step closure: K gathers + OR per table, concatenated in row
         # order (main rows first, aux rows after) — no scatter anywhere
         y_main = x[idx_main[:, 0]]
@@ -180,6 +243,15 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int):
             y = jnp.concatenate([y_main, y_aux], axis=0)
         else:
             y = y_main
+        if idx_cav is not None:
+            # caveat edges reach the MAYBE plane only: gather their
+            # closure and OR it into the maybe half (definite half is
+            # untouched — an undecided caveat can never DEFINITELY grant)
+            extra = x[idx_cav[:, 0]]
+            for k in range(1, K_CAV):
+                extra = extra | x[idx_cav[:, k]]
+            y = jnp.concatenate([y[:, :half], y[:, half:] | extra[:, half:]],
+                                axis=1)
         for term, mask in zip(wc_terms, wc_masks):
             live = jax.lax.dynamic_slice_in_dim(
                 x, term.self_offset, term.self_length, axis=0)
@@ -188,7 +260,7 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int):
             y = y | (mask & any_live)
         x1 = y | x0
         for op in perm_ops:
-            vec = _apply_perm_expr_packed(op.expr, x1)
+            vec = _apply_perm_expr_packed(op.expr, x1, half)
             seed = jax.lax.dynamic_slice_in_dim(x0, op.offset, op.length, axis=0)
             x1 = jax.lax.dynamic_update_slice_in_dim(
                 x1, vec | seed, op.offset, axis=0)
@@ -200,8 +272,10 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int):
 
 
 def init_packed_state(prog: GraphProgram, n_aux_rows: int, q_idx,
-                      n_words: int) -> jnp.ndarray:
-    """Packed one-hot [NT, W] from per-query state indices.
+                      n_words: int, planes: bool = False) -> jnp.ndarray:
+    """Packed one-hot [NT, W] from per-query state indices ([NT, 2W] with
+    both planes seeded when the tri-state path is active: the query
+    subject itself is definite, hence also maybe).
 
     Column c of the batch is bit (c % 32) of word (c // 32); columns are
     distinct, so the scatter-add below never carries (each target bit is
@@ -212,19 +286,25 @@ def init_packed_state(prog: GraphProgram, n_aux_rows: int, q_idx,
     cols = jnp.arange(b)
     word = cols // 32
     bit = (cols % 32).astype(jnp.uint32)
-    x0 = jnp.zeros((nt, n_words), jnp.uint32)
+    width = 2 * n_words if planes else n_words
+    x0 = jnp.zeros((nt, width), jnp.uint32)
     x0 = x0.at[q_idx, word].add(jnp.uint32(1) << bit)
+    if planes:
+        x0 = x0.at[q_idx, n_words + word].add(jnp.uint32(1) << bit)
     return x0.at[prog.dead_index].set(np.uint32(0))
 
 
 def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
-                      num_iters: int, use_while: bool = True):
-    """fn(q_idx, idx_main, idx_aux) -> packed x_final [NT, W] uint32."""
-    step = make_ell_step(prog, n_aux_rows)
+                      num_iters: int, use_while: bool = True,
+                      planes: bool = False):
+    """fn(q_idx, idx_main, idx_aux[, idx_cav]) -> packed x_final
+    [NT, W] uint32 ([NT, 2W] on the tri-state plane path)."""
+    step = make_ell_step(prog, n_aux_rows,
+                         half=n_words if planes else None)
 
     if use_while:
-        def evaluate(q_idx, idx_main, idx_aux):
-            x0 = init_packed_state(prog, n_aux_rows, q_idx, n_words)
+        def evaluate(q_idx, idx_main, idx_aux, idx_cav=None):
+            x0 = init_packed_state(prog, n_aux_rows, q_idx, n_words, planes)
 
             def cond(state):
                 x, prev_changed, i = state
@@ -232,18 +312,18 @@ def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
 
             def body(state):
                 x, _, i = state
-                x1 = step(x, x0, idx_main, idx_aux)
+                x1 = step(x, x0, idx_main, idx_aux, idx_cav)
                 return (x1, jnp.any(x1 != x), i + 1)
 
             x_final, _, _ = jax.lax.while_loop(
                 cond, body, (x0, jnp.bool_(True), jnp.int32(0)))
             return x_final
     else:
-        def evaluate(q_idx, idx_main, idx_aux):
-            x0 = init_packed_state(prog, n_aux_rows, q_idx, n_words)
+        def evaluate(q_idx, idx_main, idx_aux, idx_cav=None):
+            x0 = init_packed_state(prog, n_aux_rows, q_idx, n_words, planes)
 
             def body(x, _):
-                return step(x, x0, idx_main, idx_aux), None
+                return step(x, x0, idx_main, idx_aux, idx_cav), None
 
             x_final, _ = jax.lax.scan(body, x0, None, length=num_iters)
             return x_final
@@ -253,12 +333,19 @@ def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
 
 class EllKernelCache:
     """Jitted packed check/lookup entry points for one (program, tables)
-    pair.  Jit cache keys on (batch-word bucket, table shapes)."""
+    pair.  Jit cache keys on (batch-word bucket, table shapes).
+
+    With `planes=True` the state carries definite/maybe bitplanes and the
+    call signatures grow an `idx_cav` table: checks return tri-state
+    {0,1,2} (NO / CONDITIONAL / HAS), lookups return the DEFINITE plane
+    only (LookupResources skips conditional results, reference
+    lookups.go:85-88)."""
 
     def __init__(self, prog: GraphProgram, n_aux_rows: int, tree_depth: int,
-                 num_iters: Optional[int] = None):
+                 num_iters: Optional[int] = None, planes: bool = False):
         self.prog = prog
         self.n_aux_rows = n_aux_rows
+        self.planes = planes
         # hub OR-trees add tree_depth effective levels per original hop;
         # generous cap — while_loop exits at the true fixpoint anyway
         base = num_iters or MAX_ITERATIONS
@@ -267,10 +354,27 @@ class EllKernelCache:
 
     def _fns(self, n_words: int) -> tuple:
         fns = self._jits.get(n_words)
-        if fns is None:
-            evaluate = make_ell_evaluate(self.prog, self.n_aux_rows, n_words,
-                                         self.num_iters)
+        if fns is not None:
+            return fns
+        evaluate = make_ell_evaluate(self.prog, self.n_aux_rows, n_words,
+                                     self.num_iters, planes=self.planes)
+        if self.planes:
+            def run_checks(q_idx, gather_idx, gather_word, gather_bit,
+                           idx_main, idx_aux, idx_cav):
+                x = evaluate(q_idx, idx_main, idx_aux, idx_cav)
+                dw = x[gather_idx, gather_word]
+                mw = x[gather_idx, n_words + gather_word]
+                d = (dw >> gather_bit) & jnp.uint32(1)
+                m = (mw >> gather_bit) & jnp.uint32(1)
+                # 2=HAS, 1=CONDITIONAL (maybe without definite), 0=NO
+                return d * 2 + (m & (d ^ jnp.uint32(1)))
 
+            def run_lookup(slot_offset, slot_length, q_idx,
+                           idx_main, idx_aux, idx_cav):
+                x = evaluate(q_idx, idx_main, idx_aux, idx_cav)
+                return jax.lax.dynamic_slice(
+                    x, (slot_offset, 0), (slot_length, n_words))
+        else:
             def run_checks(q_idx, gather_idx, gather_word, gather_bit,
                            idx_main, idx_aux):
                 x = evaluate(q_idx, idx_main, idx_aux)
@@ -284,33 +388,80 @@ class EllKernelCache:
                 return jax.lax.dynamic_slice_in_dim(
                     x, slot_offset, slot_length, axis=0)       # [L, W] uint32
 
-            fns = (jax.jit(run_checks),
-                   jax.jit(run_lookup, static_argnums=(0, 1)))
-            self._jits[n_words] = fns
+        fns = (jax.jit(run_checks),
+               jax.jit(run_lookup, static_argnums=(0, 1)))
+        self._jits[n_words] = fns
         return fns
+
+    def iterations(self, q_idx: np.ndarray, n_words: int, idx_main, idx_aux,
+                   idx_cav=None) -> int:
+        """Executed while_loop trips to the fixpoint for this batch — the
+        bench's roofline probe (bytes-per-iteration x iterations =
+        modeled HBM traffic).  Jitted separately; same step function."""
+        key = ("iters", n_words)
+        fn = self._jits.get(key)
+        if fn is None:
+            step = make_ell_step(self.prog, self.n_aux_rows,
+                                 half=n_words if self.planes else None)
+            num_iters = self.num_iters
+            prog, n_aux, planes = self.prog, self.n_aux_rows, self.planes
+
+            def run(q_idx, idx_main, idx_aux, idx_cav=None):
+                x0 = init_packed_state(prog, n_aux, q_idx, n_words, planes)
+
+                def cond(state):
+                    x, prev_changed, i = state
+                    return jnp.logical_and(prev_changed, i < num_iters)
+
+                def body(state):
+                    x, _, i = state
+                    x1 = step(x, x0, idx_main, idx_aux, idx_cav)
+                    return (x1, jnp.any(x1 != x), i + 1)
+
+                _, _, i = jax.lax.while_loop(
+                    cond, body, (x0, jnp.bool_(True), jnp.int32(0)))
+                return i
+
+            fn = jax.jit(run)
+            self._jits[key] = fn
+        if self.planes:
+            return int(fn(jnp.asarray(q_idx), idx_main, idx_aux, idx_cav))
+        return int(fn(jnp.asarray(q_idx), idx_main, idx_aux))
 
     # -- host-facing ---------------------------------------------------------
 
     def checks(self, q_idx: np.ndarray, n_words: int, gather_idx: np.ndarray,
-               gather_col: np.ndarray, idx_main, idx_aux) -> np.ndarray:
+               gather_col: np.ndarray, idx_main, idx_aux,
+               idx_cav=None) -> np.ndarray:
+        """bool allowed per gather slot — or int {0,1,2} tri-state when the
+        plane path is active."""
         run_checks, _ = self._fns(n_words)
         gcol = np.asarray(gather_col, np.int64)
-        out = run_checks(jnp.asarray(q_idx), jnp.asarray(gather_idx),
-                         jnp.asarray(gcol // 32),
-                         jnp.asarray((gcol % 32).astype(np.uint32)),
-                         idx_main, idx_aux)
-        return np.asarray(out) != 0
+        args = [jnp.asarray(q_idx), jnp.asarray(gather_idx),
+                jnp.asarray(gcol // 32),
+                jnp.asarray((gcol % 32).astype(np.uint32)),
+                idx_main, idx_aux]
+        if self.planes:
+            out = run_checks(*args, idx_cav)
+            return np.asarray(out).astype(np.int8)
+        return np.asarray(run_checks(*args)) != 0
 
     def lookup(self, slot_offset: int, slot_length: int, q_idx: np.ndarray,
-               n_words: int, idx_main, idx_aux) -> np.ndarray:
+               n_words: int, idx_main, idx_aux, idx_cav=None) -> np.ndarray:
         """bool [slot_length, n_words*32] allowed bitmap (columns beyond the
-        real batch are padding).  The device returns packed uint32 words;
-        unpacking happens host-side with np.unpackbits (the packed transfer
-        is 32x smaller, and transfer bandwidth — not compute — dominates)."""
+        real batch are padding; DEFINITE plane when planes are active).
+        The device returns packed uint32 words; unpacking happens host-side
+        with np.unpackbits (the packed transfer is 32x smaller, and
+        transfer bandwidth — not compute — dominates)."""
         _, run_lookup = self._fns(n_words)
-        packed = np.ascontiguousarray(
-            run_lookup(slot_offset, slot_length,
-                       jnp.asarray(q_idx), idx_main, idx_aux))
+        if self.planes:
+            packed = np.ascontiguousarray(
+                run_lookup(slot_offset, slot_length,
+                           jnp.asarray(q_idx), idx_main, idx_aux, idx_cav))
+        else:
+            packed = np.ascontiguousarray(
+                run_lookup(slot_offset, slot_length,
+                           jnp.asarray(q_idx), idx_main, idx_aux))
         # uint32 little-endian: bit b of word w lands at column w*32 + b
         return np.unpackbits(packed.view(np.uint8).reshape(slot_length, -1),
                              axis=1, bitorder="little").astype(bool)

@@ -99,6 +99,18 @@ class GraphProgram:
     state_size: int                      # includes trailing dead index
     edge_src: np.ndarray                 # int32 [E] (sorted by dst)
     edge_dst: np.ndarray                 # int32 [E]
+    # MAYBE-plane edges from caveated tuples whose stored context cannot
+    # decide the caveat (tri-state device path; tuples whose context
+    # decides True are ordinary definite edges, False-deciding tuples are
+    # dropped entirely — matching Evaluator._caveat_value)
+    cav_src: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.int32))
+    cav_dst: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, np.int32))
+    # False when a caveat shape has no device lowering (caveated wildcard,
+    # unknown caveat name, non-bool caveat body): queries on affected
+    # pairs must fall back to the host oracle
+    caveats_device_ok: bool = True
     perm_ops: list = field(default_factory=list)       # topo-ordered PermOp
     wildcard_terms: list = field(default_factory=list)
     # (resource_type, left_relation) -> [(perm, occurrence, target, aux_slot)]
@@ -179,15 +191,39 @@ def _assign_slots(prog: GraphProgram, schema: sch.Schema) -> tuple:
 
 def _emit_tuple_edges(prog: GraphProgram, schema: sch.Schema,
                       arrow_slots: dict, arrows_by_left: dict, rel,
-                      srcs: list, dsts: list, wildcard_map: dict) -> None:
+                      srcs: list, dsts: list, wildcard_map: dict,
+                      cav_srcs: Optional[list] = None,
+                      cav_dsts: Optional[list] = None,
+                      cav_flags: Optional[dict] = None) -> None:
     """Per-tuple edge emission (object path; also used for overlay tuples
-    on top of a columnar base)."""
-    if getattr(rel, "caveat", None) is not None:
-        # caveated tuples are host-evaluated residuals: queries on any
-        # (type, permission) whose closure can traverse them route to the
-        # oracle (caveat_affected_pairs); the device graph holds only
-        # definite edges
-        return
+    on top of a columnar base).
+
+    Caveated tuples (SURVEY.md hard part (c)): a stored context that
+    DECIDES the caveat resolves at compile time — True emits ordinary
+    definite edges, False emits nothing.  Undecidable tuples emit
+    MAYBE-plane edges (`cav_srcs`/`cav_dsts`) consumed by the tri-state
+    ELL kernel; shapes with no device lowering (wildcards, unknown
+    caveats) clear `cav_flags['ok']` so affected queries fall back to the
+    host oracle (the pre-round-4 behavior for ALL caveats)."""
+    cav = getattr(rel, "caveat", None)
+    if cav is not None:
+        c = schema.caveats.get(cav.name)
+        try:
+            value = c.evaluate(cav.context()) if c is not None else None
+        except Exception:
+            value = None
+            c = None  # evaluation error: no device story for this caveat
+        if value is False:
+            return
+        if value is None:
+            if cav_srcs is None or c is None or rel.subject.id == WILDCARD:
+                if cav_flags is not None:
+                    cav_flags["ok"] = False
+                return
+            # MAYBE: route every edge this tuple contributes to the
+            # caveat plane
+            srcs, dsts = cav_srcs, cav_dsts
+        # value is True: definite — fall through unchanged
     rt = rel.resource.type
     if rt not in schema.definitions:
         return
@@ -221,8 +257,15 @@ def _emit_tuple_edges(prog: GraphProgram, schema: sch.Schema,
 
 def _finalize_program(prog: GraphProgram, schema: sch.Schema,
                       src_arr: np.ndarray, dst_arr: np.ndarray,
-                      wildcard_map: dict, arrow_slots: dict) -> GraphProgram:
+                      wildcard_map: dict, arrow_slots: dict,
+                      cav_srcs: Optional[list] = None,
+                      cav_dsts: Optional[list] = None,
+                      caveats_device_ok: bool = True) -> GraphProgram:
     """Sort edges, materialize wildcard terms and the permission program."""
+    if cav_srcs:
+        prog.cav_src = np.asarray(cav_srcs, np.int32)
+        prog.cav_dst = np.asarray(cav_dsts, np.int32)
+    prog.caveats_device_ok = caveats_device_ok
     if len(src_arr):
         order = np.argsort(dst_arr, kind="stable")
         prog.edge_src = np.ascontiguousarray(src_arr[order])
@@ -282,15 +325,20 @@ def compile_graph(schema: sch.Schema, tuples: list,
 
     srcs: list[int] = []
     dsts: list[int] = []
+    cav_srcs: list[int] = []
+    cav_dsts: list[int] = []
+    cav_flags = {"ok": True}
     wildcard_map: dict[str, list] = {}  # subject type -> [state indices]
     for rel in tuples:
         _emit_tuple_edges(prog, schema, arrow_slots, arrows_by_left, rel,
-                          srcs, dsts, wildcard_map)
+                          srcs, dsts, wildcard_map,
+                          cav_srcs, cav_dsts, cav_flags)
 
     return _finalize_program(prog, schema,
                              np.asarray(srcs, np.int32),
                              np.asarray(dsts, np.int32),
-                             wildcard_map, arrow_slots)
+                             wildcard_map, arrow_slots,
+                             cav_srcs, cav_dsts, cav_flags["ok"])
 
 
 def compile_graph_columnar(schema: sch.Schema, snap, rows: np.ndarray,
@@ -432,12 +480,18 @@ def compile_graph_columnar(schema: sch.Schema, snap, rows: np.ndarray,
                     src_parts.append((a_src_off + src_loc[ok]).astype(np.int32))
                     dst_parts.append((a_dst_off + dst_loc[ok]).astype(np.int32))
 
-    # overlay tuples via the per-tuple path
+    # overlay tuples via the per-tuple path (the columnar base layer is
+    # caveat-free by construction — store.py bulk_load_text — so caveated
+    # tuples only ever arrive here)
     srcs_o: list[int] = []
     dsts_o: list[int] = []
+    cav_srcs: list[int] = []
+    cav_dsts: list[int] = []
+    cav_flags = {"ok": True}
     for r in overlay:
         _emit_tuple_edges(prog, schema, arrow_slots, arrows_by_left, r,
-                          srcs_o, dsts_o, wildcard_map)
+                          srcs_o, dsts_o, wildcard_map,
+                          cav_srcs, cav_dsts, cav_flags)
     if srcs_o:
         src_parts.append(np.asarray(srcs_o, np.int32))
         dst_parts.append(np.asarray(dsts_o, np.int32))
@@ -445,7 +499,8 @@ def compile_graph_columnar(schema: sch.Schema, snap, rows: np.ndarray,
     src_arr = np.concatenate(src_parts) if src_parts else np.zeros(0, np.int32)
     dst_arr = np.concatenate(dst_parts) if dst_parts else np.zeros(0, np.int32)
     return _finalize_program(prog, schema, src_arr, dst_arr,
-                             wildcard_map, arrow_slots)
+                             wildcard_map, arrow_slots,
+                             cav_srcs, cav_dsts, cav_flags["ok"])
 
 
 def caveat_affected_pairs(schema: sch.Schema, caveated_rels: set) -> set:

@@ -95,6 +95,11 @@ class _SegmentGraph:
         self.prog = prog
         self.num_iters = num_iters
         self._edge_endpoints = edge_endpoints
+        # the segment kernel has no MAYBE plane: it can skip the oracle
+        # for caveat-affected pairs only when every caveat resolved at
+        # compile time (no undecidable edges)
+        self.tri_state_capable = (prog.caveats_device_ok
+                                  and not len(prog.cav_src))
         capacity = bucket(max(len(prog.edge_src) * 2, _MIN_EDGE_BUCKET))
         src, dst = pad_edges(prog, capacity)
         self.edge_src = jnp.asarray(src)
@@ -188,6 +193,9 @@ class _SegmentGraph:
         return self._kernel().checks(q_arr, gi, gc, self.edge_src,
                                      self.edge_dst)
 
+    def run_checks3(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+        return np.where(self.run_checks(q_arr, gather_idx, gather_col), 2, 0)
+
     def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
         return self._kernel().lookup(offset, length, q_arr, self.edge_src,
                                      self.edge_dst)
@@ -208,13 +216,37 @@ class _EllGraph:
         self.prog = prog
         self._edge_endpoints = edge_endpoints
         t = build_tables(prog)
+        # tri-state device path (VERDICT r3 item 5): undecidable caveated
+        # edges live in a separate MAYBE-plane gather table; queries on
+        # caveat-affected pairs stay on the kernel instead of dropping to
+        # the recursive host oracle
+        self.has_cav = bool(len(prog.cav_src)) and prog.caveats_device_ok
+        self.tri_state_capable = prog.caveats_device_ok
+        tree_depth = t.tree_depth
+        if self.has_cav:
+            from .ell import K_AUX, build_cav_tables
+            ct = build_cav_tables(prog, t.idx_aux.shape[0])
+            if ct.n_aux_cav:
+                # caveat OR-tree nodes get dead rows in the shared aux
+                # table so the one-step concat covers every state row
+                t.idx_aux = np.vstack([
+                    t.idx_aux,
+                    np.full((ct.n_aux_cav, K_AUX), prog.dead_index,
+                            np.int32)])
+            self.host_cav = ct.idx_cav
+            self.dev_cav = jnp.asarray(ct.idx_cav)
+            tree_depth = max(tree_depth, ct.tree_depth)
+        else:
+            self.host_cav = None
+            self.dev_cav = None
         self.host_main = t.idx_main
         self.host_aux = t.idx_aux
         self.dev_main = jnp.asarray(t.idx_main)
         self.dev_aux = jnp.asarray(t.idx_aux)
         self.kernel = EllKernelCache(prog, n_aux_rows=t.idx_aux.shape[0],
-                                     tree_depth=t.tree_depth,
-                                     num_iters=num_iters)
+                                     tree_depth=tree_depth,
+                                     num_iters=num_iters,
+                                     planes=self.has_cav)
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
 
@@ -301,19 +333,27 @@ class _EllGraph:
         return batch_words(n) * 32
 
     def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+        out = self.run_checks3(q_arr, gather_idx, gather_col)
+        return out == 2
+
+    def run_checks3(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+        """Tri-state check values {0: NO, 1: CONDITIONAL, 2: HAS}."""
         g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
         gi = np.zeros(g, np.int32)
         gc = np.zeros(g, np.int32)
         gi[: len(gather_idx)] = gather_idx
         gc[: len(gather_col)] = gather_col
         n_words = max(1, len(q_arr) // 32)
-        return self.kernel.checks(q_arr, n_words, gi, gc, self.dev_main,
-                                  self.dev_aux)
+        out = self.kernel.checks(q_arr, n_words, gi, gc, self.dev_main,
+                                 self.dev_aux, self.dev_cav)
+        if not self.has_cav:
+            return np.where(out, 2, 0)
+        return out
 
     def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
         n_words = max(1, len(q_arr) // 32)
         return self.kernel.lookup(offset, length, q_arr, n_words,
-                                  self.dev_main, self.dev_aux)
+                                  self.dev_main, self.dev_aux, self.dev_cav)
 
 
 class _ShardedEllGraph(_EllGraph):
@@ -334,6 +374,11 @@ class _ShardedEllGraph(_EllGraph):
 
         self.prog = prog
         self._edge_endpoints = edge_endpoints
+        # the sharded kernel carries no MAYBE plane yet: undecidable
+        # caveated edges force affected pairs back to the host oracle
+        self.has_cav = False
+        self.tri_state_capable = (prog.caveats_device_ok
+                                  and not len(prog.cav_src))
         t = _build(prog)
         self.host_main = t.idx_main
         self.host_aux = t.idx_aux
@@ -363,6 +408,9 @@ class _ShardedEllGraph(_EllGraph):
         return self.kernel.checks(np.asarray(q_arr, np.int32),
                                   np.asarray(gather_idx, np.int32),
                                   np.asarray(gather_col, np.int64))
+
+    def run_checks3(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+        return np.where(self.run_checks(q_arr, gather_idx, gather_col), 2, 0)
 
     def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
         return self.kernel.lookup(offset, length, np.asarray(q_arr, np.int32))
@@ -582,32 +630,28 @@ class JaxEndpoint(PermissionsEndpoint):
                         break
                     self._set_expiry(key, None)
                     if key in self._caveated_keys:
-                        # caveated tuples never entered the device graph
-                        self._caveated_keys.discard(key)
-                        continue
+                        # caveated tuples CAN be in the device tables now:
+                        # context-decided-True ones as definite edges,
+                        # undecidable ones in the MAYBE plane — only a
+                        # rebuild removes either shape correctly
+                        needs_rebuild = True
+                        break
                     if not graph.remove_key(key):
                         needs_rebuild = True
                         break
                 elif u.rel.caveat is not None:  # TOUCH, caveated
-                    pair = (u.rel.resource.type, u.rel.relation)
-                    if pair not in self._caveated_pairs:
-                        # first caveat on this relation: the affected-pair
-                        # closure changes — recompute via rebuild
-                        needs_rebuild = True
-                        break
-                    self._set_expiry(key, u.rel.expires_at)
-                    # a previously-definite tuple may have been replaced by
-                    # a caveated one: its device edges must go
-                    if key not in self._caveated_keys:
-                        self._caveated_keys.add(key)
-                        if not graph.remove_key(key):
-                            # graph can't remove incrementally: stale
-                            # definite edges would over-grant
-                            needs_rebuild = True
-                            break
+                    # caveat state changes reshape the MAYBE tables, the
+                    # affected-pair closure, or compile-time-resolved
+                    # definite edges — all rebuild-only
+                    needs_rebuild = True
+                    break
                 else:  # TOUCH, definite
                     self._set_expiry(key, u.rel.expires_at)
-                    self._caveated_keys.discard(key)
+                    if key in self._caveated_keys:
+                        # previously-caveated tuple replaced by a definite
+                        # one: its old plane placement must be undone
+                        needs_rebuild = True
+                        break
                     if not graph.add_rel(u.rel):
                         needs_rebuild = True
                         break
@@ -625,8 +669,10 @@ class JaxEndpoint(PermissionsEndpoint):
                 continue
             del self._expiry_meta[key]
             if key in self._caveated_keys:
-                self._caveated_keys.discard(key)
-                continue  # was never in the device graph
+                # may occupy the definite tables (decided True) or the
+                # MAYBE plane — rebuild removes either
+                needs_rebuild = True
+                break
             if key[4] == WILDCARD:
                 needs_rebuild = True
                 break
@@ -700,9 +746,14 @@ class JaxEndpoint(PermissionsEndpoint):
             gather_col: list[int] = []
             kernel_rows: list[int] = []  # positions in reqs served by kernel
             results: list[Optional[int]] = [None] * len(reqs)  # tri-state
+            tri = getattr(graph, "tri_state_capable", False)
             for i, r in enumerate(reqs):
-                if (r.resource.type, r.permission) in self._caveat_affected:
-                    # caveat residual: host tri-state evaluation
+                if (not tri and (r.resource.type, r.permission)
+                        in self._caveat_affected):
+                    # caveat residual with no device plane: host tri-state
+                    # evaluation (pre-round-4 behavior; only the sharded /
+                    # segment kernels and unsupported caveat shapes land
+                    # here now)
                     results[i] = self._oracle.check3(r.resource, r.permission,
                                                      r.subject)
                     self.stats["oracle_residual_checks"] += 1
@@ -728,10 +779,10 @@ class JaxEndpoint(PermissionsEndpoint):
                 gather_col.append(cols[r.subject])
                 kernel_rows.append(i)
             if kernel_rows:
-                out = graph.run_checks(q_arr, gather_idx, gather_col)
+                out = graph.run_checks3(q_arr, gather_idx, gather_col)
                 self.stats["kernel_calls"] += 1
                 for j, row in enumerate(kernel_rows):
-                    results[row] = 2 if out[j] else 0
+                    results[row] = int(out[j])
         return [CheckResult(permissionship=self._TRISTATE[r], checked_at=rev)
                 for r in results]
 
@@ -748,9 +799,12 @@ class JaxEndpoint(PermissionsEndpoint):
         self.schema.definition(resource_type)  # raises like the oracle
         with self._lock:
             graph = self._current_graph()
-            if (resource_type, permission) in self._caveat_affected:
-                # caveat residual: the oracle already skips CONDITIONAL
-                # results (reference lookups.go:85-88)
+            if ((resource_type, permission) in self._caveat_affected
+                    and not getattr(graph, "tri_state_capable", False)):
+                # caveat residual with no device plane: the oracle already
+                # skips CONDITIONAL results (reference lookups.go:85-88);
+                # plane-capable kernels return the DEFINITE plane, which
+                # skips them by construction
                 return self._oracle.lookup_resources(resource_type,
                                                      permission, subject)
             rng = graph.prog.slot_range(resource_type, permission)
@@ -795,7 +849,8 @@ class JaxEndpoint(PermissionsEndpoint):
         self.schema.definition(resource_type)
         with self._lock:
             graph = self._current_graph()
-            if (resource_type, permission) in self._caveat_affected:
+            if ((resource_type, permission) in self._caveat_affected
+                    and not getattr(graph, "tri_state_capable", False)):
                 return [self._oracle.lookup_resources(resource_type,
                                                       permission, s)
                         for s in subjects]

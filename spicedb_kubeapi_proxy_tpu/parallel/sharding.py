@@ -145,6 +145,33 @@ class ShardedKernel:
 
 # -- packed (ELL) sharded kernel ---------------------------------------------
 
+def comm_model(state_size: int, n_aux_rows: int, n_data: int, n_graph: int,
+               batch: int) -> dict:
+    """Per-iteration ICI traffic of the sharded ELL layout — the SINGLE
+    source of the communication model consumed by bench.py and
+    __graft_entry__.dryrun_multichip, mirroring ShardedEllKernel's padding
+    exactly: row blocks are reassembled by a tiled all_gather along the
+    `graph` axis each iteration; the `data` (packed word) axis is pure
+    throughput parallelism with zero communication."""
+    from ..ops.ell import batch_words
+
+    n_pad = _ceil_mult(state_size, n_graph)
+    a_pad = _ceil_mult(max(n_aux_rows, 1), n_graph)
+    w = batch_words(batch, minimum=n_data)
+    if w % n_data:
+        w += n_data - (w % n_data)
+    w_local = max(1, w // n_data)
+    rows = n_pad + a_pad
+    return {
+        "mesh": f"{n_data}x{n_graph} (data x graph)",
+        "padded_rows": rows,
+        "words_per_device": w_local,
+        "all_gather_recv_bytes_per_device_per_iter":
+            rows * w_local * 4 * (n_graph - 1) // n_graph,
+        "data_axis_comm_bytes": 0,
+    }
+
+
 def _ceil_mult(n: int, m: int) -> int:
     return ((max(n, 1) + m - 1) // m) * m
 
