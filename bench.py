@@ -451,10 +451,25 @@ def roofline_probe(ep, workload, batch: int) -> dict:
                        + (nt * K_CAV if kern.planes else 0))
     per_iter = gather_bytes + 2 * state_bytes + table_bytes
     device_s = t1 - t0
-    compute_s = max(device_s - rtt, 1e-6)
     total_bytes = per_iter * max(iters, 1)
     peak = {"tpu": 819.0}.get(_STATE.get("platform", ""), None)
-    achieved = total_bytes / device_s / 1e9
+    # Under the axon tunnel, execution can be LAZY: block_until_ready may
+    # return in <1ms and the real device work happens inside the host
+    # transfer (observed: 0.1ms "device" + 12s "transfer" on the 1M
+    # config).  When the separate device timing is implausible (< the
+    # measured rtt), fall back to the whole pipeline (run + to-host)
+    # minus rtt as the compute+traffic window — coarser but honest.
+    timing_basis = "device (block_until_ready)"
+    compute_s = device_s - rtt
+    if compute_s < rtt:
+        compute_s = max((t2 - t0) - rtt, 1e-6)
+        timing_basis = ("device+transfer pipeline minus rtt (lazy tunnel "
+                        "execution: block_until_ready returned early)")
+    lazy = timing_basis != "device (block_until_ready)"
+    # raw device-time-based numbers are garbage under lazy execution:
+    # null them rather than publish a >100% "achieved" figure
+    achieved = (None if lazy
+                else total_bytes / max(device_s, 1e-6) / 1e9)
     achieved_net = total_bytes / compute_s / 1e9
     return {
         "state_rows": nt,
@@ -467,13 +482,15 @@ def roofline_probe(ep, workload, batch: int) -> dict:
         "device_time_ms": round(device_s * 1e3, 3),
         "dispatch_rtt_ms": round(rtt * 1e3, 3),
         "kernel_compute_ms": round(compute_s * 1e3, 3),
+        "timing_basis": timing_basis,
         "transfer_unpack_ms": round((t2 - t1) * 1e3, 3),
         "id_materialize_sample_ms": round((t3 - t2) * 1e3, 3),
-        "modeled_achieved_hbm_gbps": round(achieved, 2),
+        "modeled_achieved_hbm_gbps": (round(achieved, 2)
+                                      if achieved is not None else None),
         "modeled_achieved_hbm_gbps_net_of_rtt": round(achieved_net, 2),
         "hbm_peak_gbps_v5e": 819.0,
         "modeled_peak_fraction": (round(achieved / peak, 4)
-                                  if peak else None),
+                                  if peak and achieved is not None else None),
         "modeled_peak_fraction_net_of_rtt": (round(achieved_net / peak, 4)
                                              if peak else None),
         "model_note": ("bytes model counts gather outputs + state "
@@ -497,7 +514,8 @@ def sharded_comm_model(ep, workload, batch: int,
     if not hasattr(graph, "dev_main"):
         return {"skipped": "needs the ELL graph"}
     out = comm_model(graph.prog.state_size, graph.dev_aux.shape[0],
-                     n_data, n_graph, batch)
+                     n_data, n_graph, batch,
+                     planes=bool(getattr(graph, "has_cav", False)))
     out["note"] = ("per-iteration tiled all_gather over ICI reassembles "
                    "row blocks; measured wall time for this layout is "
                    "recorded by dryrun_multichip (MULTICHIP artifact)")
@@ -683,6 +701,7 @@ def main() -> None:
                 ep_head, workload, args.batch)
         except Exception as e:
             payload["sharded_comm_model"] = {"error": repr(e)}
+        ep_head = None  # release: the pops below are no-ops while this lives
 
     # -- sweep: every other config, fewer rounds, no oracle ------------------
     if args.all:
@@ -705,12 +724,18 @@ def main() -> None:
                     "error": repr(e)}
         payload["configs"] = _STATE["partial"].get("configs", {})
         # caveat-path health: within ~10x of the definite rbac path
+        # (the headline config's number lives in payload["value"], not
+        # the sweep table — read whichever slot holds each config)
         cfgs = payload["configs"]
-        if "caveats-rbac" in cfgs and "rbac-deny" in cfgs and \
-                "checks_per_s" in cfgs.get("caveats-rbac", {}) and \
-                "checks_per_s" in cfgs.get("rbac-deny", {}):
-            ratio = (cfgs["rbac-deny"]["checks_per_s"]
-                     / max(cfgs["caveats-rbac"]["checks_per_s"], 1e-9))
+
+        def value_of(name):
+            if name == args.config:
+                return payload["value"]
+            return cfgs.get(name, {}).get("checks_per_s")
+
+        definite, caveated = value_of("rbac-deny"), value_of("caveats-rbac")
+        if definite and caveated:
+            ratio = definite / max(caveated, 1e-9)
             payload["definite_over_caveated_ratio"] = round(ratio, 2)
             log(f"definite/caveated throughput ratio: {ratio:.2f} "
                 f"(target <~10)")

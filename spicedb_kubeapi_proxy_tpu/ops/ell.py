@@ -181,34 +181,42 @@ def build_cav_tables(prog: GraphProgram, n_aux_shared: int) -> CavTables:
 # -- packed expression program ----------------------------------------------
 
 def _apply_perm_expr_packed(expr, x: jnp.ndarray,
-                            half: Optional[int] = None) -> jnp.ndarray:
+                            half: Optional[int] = None,
+                            plane_last: bool = False) -> jnp.ndarray:
     """Evaluate a permission expression over packed state.
 
-    With `half` set, x carries TWO bitplanes side by side: words [0, half)
-    are the DEFINITE plane, words [half, 2*half) the MAYBE plane
-    (maybe ⊇ definite always).  Union/intersection act planewise (Kleene:
-    T∨U=T via the def plane, T∧U=U via the maybe plane); exclusion mixes
-    planes —  def(A−B) = def(A) ∧ ¬maybe(B),  maybe(A−B) = maybe(A) ∧
-    ¬def(B) — which is exactly `base & ~swap(sub)` with the halves of the
-    subtrahend swapped."""
+    Tri-state (definite/maybe bitplane) modes — maybe ⊇ definite always;
+    union/intersection act planewise (Kleene: T∨U=T via the def plane,
+    T∧U=U via the maybe plane); exclusion mixes planes:
+    def(A−B) = def(A) ∧ ¬maybe(B),  maybe(A−B) = maybe(A) ∧ ¬def(B) —
+    i.e. `base & ~swap(sub)` with the subtrahend's planes swapped.
+
+    - `half` set: planes side by side on the WORD axis (single-chip
+      layout; words [0, half) definite, [half, 2*half) maybe) — swap is a
+      word-halves concat.
+    - `plane_last`: planes on a trailing size-2 axis (sharded layout, so
+      the swap stays device-local under a word-sharded mesh) — swap is a
+      flip of the last axis."""
     if isinstance(expr, PRead):
         return jax.lax.dynamic_slice_in_dim(x, expr.offset, expr.length, axis=0)
     if isinstance(expr, PZero):
-        return jnp.zeros((expr.length, x.shape[1]), dtype=x.dtype)
+        return jnp.zeros((expr.length,) + x.shape[1:], dtype=x.dtype)
     if isinstance(expr, PUnion):
-        out = _apply_perm_expr_packed(expr.children[0], x, half)
+        out = _apply_perm_expr_packed(expr.children[0], x, half, plane_last)
         for c in expr.children[1:]:
-            out = out | _apply_perm_expr_packed(c, x, half)
+            out = out | _apply_perm_expr_packed(c, x, half, plane_last)
         return out
     if isinstance(expr, PIntersect):
-        out = _apply_perm_expr_packed(expr.children[0], x, half)
+        out = _apply_perm_expr_packed(expr.children[0], x, half, plane_last)
         for c in expr.children[1:]:
-            out = out & _apply_perm_expr_packed(c, x, half)
+            out = out & _apply_perm_expr_packed(c, x, half, plane_last)
         return out
     if isinstance(expr, PExclude):
-        base = _apply_perm_expr_packed(expr.base, x, half)
-        sub = _apply_perm_expr_packed(expr.subtract, x, half)
-        if half is not None:
+        base = _apply_perm_expr_packed(expr.base, x, half, plane_last)
+        sub = _apply_perm_expr_packed(expr.subtract, x, half, plane_last)
+        if plane_last:
+            sub = sub[..., ::-1]
+        elif half is not None:
             sub = jnp.concatenate([sub[:, half:], sub[:, :half]], axis=1)
         return base & ~sub
     raise TypeError(f"unknown perm expr {expr!r}")

@@ -374,16 +374,19 @@ class _ShardedEllGraph(_EllGraph):
 
         self.prog = prog
         self._edge_endpoints = edge_endpoints
-        # the sharded kernel carries no MAYBE plane yet: undecidable
-        # caveated edges force affected pairs back to the host oracle
-        self.has_cav = False
-        self.tri_state_capable = (prog.caveats_device_ok
-                                  and not len(prog.cav_src))
         t = _build(prog)
-        self.host_main = t.idx_main
-        self.host_aux = t.idx_aux
         self.kernel = ShardedEllKernel(prog, mesh, num_iters=num_iters,
                                        tables=t)
+        # AFTER kernel construction: the kernel extends t.idx_aux with
+        # dead rows for caveat OR-tree nodes, and the host tables must
+        # match that row space for tree-walk delta edits
+        self.host_main = t.idx_main
+        self.host_aux = t.idx_aux
+        # the sharded kernel carries the same MAYBE plane as the
+        # single-chip path (trailing plane axis); only unsupported caveat
+        # shapes (wildcards etc.) fall back to the host oracle
+        self.has_cav = self.kernel.planes
+        self.tri_state_capable = prog.caveats_device_ok
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
 
@@ -405,12 +408,18 @@ class _ShardedEllGraph(_EllGraph):
         return self.kernel.padded_batch_words(n) * 32
 
     def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
-        return self.kernel.checks(np.asarray(q_arr, np.int32),
-                                  np.asarray(gather_idx, np.int32),
-                                  np.asarray(gather_col, np.int64))
+        out = self.kernel.checks(np.asarray(q_arr, np.int32),
+                                 np.asarray(gather_idx, np.int32),
+                                 np.asarray(gather_col, np.int64))
+        return (out == 2) if self.kernel.planes else out
 
     def run_checks3(self, q_arr, gather_idx, gather_col) -> np.ndarray:
-        return np.where(self.run_checks(q_arr, gather_idx, gather_col), 2, 0)
+        out = self.kernel.checks(np.asarray(q_arr, np.int32),
+                                 np.asarray(gather_idx, np.int32),
+                                 np.asarray(gather_col, np.int64))
+        if self.kernel.planes:
+            return out
+        return np.where(out, 2, 0)
 
     def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
         return self.kernel.lookup(offset, length, np.asarray(q_arr, np.int32))

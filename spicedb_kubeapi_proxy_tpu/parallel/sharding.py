@@ -145,29 +145,42 @@ class ShardedKernel:
 
 # -- packed (ELL) sharded kernel ---------------------------------------------
 
+def padded_batch_words_for(n_data: int, batch: int) -> int:
+    """uint32 word count for a `batch`-column query under a data axis of
+    size n_data: the SINGLE source of the padding formula, used by
+    ShardedEllKernel.padded_batch_words and comm_model."""
+    from ..ops.ell import batch_words
+
+    w = batch_words(batch, minimum=n_data)
+    if w % n_data:
+        w += n_data - (w % n_data)
+    return w
+
+
 def comm_model(state_size: int, n_aux_rows: int, n_data: int, n_graph: int,
-               batch: int) -> dict:
+               batch: int, planes: bool = False) -> dict:
     """Per-iteration ICI traffic of the sharded ELL layout — the SINGLE
     source of the communication model consumed by bench.py and
     __graft_entry__.dryrun_multichip, mirroring ShardedEllKernel's padding
     exactly: row blocks are reassembled by a tiled all_gather along the
     `graph` axis each iteration; the `data` (packed word) axis is pure
-    throughput parallelism with zero communication."""
-    from ..ops.ell import batch_words
+    throughput parallelism with zero communication.
 
+    With the tri-state plane path active (`planes`), each gathered row
+    carries 2 planes AND the step all_gathers the extra y_cav closure
+    over the same row count — 4x the definite-path traffic."""
     n_pad = _ceil_mult(state_size, n_graph)
     a_pad = _ceil_mult(max(n_aux_rows, 1), n_graph)
-    w = batch_words(batch, minimum=n_data)
-    if w % n_data:
-        w += n_data - (w % n_data)
-    w_local = max(1, w // n_data)
+    w_local = max(1, padded_batch_words_for(n_data, batch) // n_data)
     rows = n_pad + a_pad
+    factor = 4 if planes else 1
     return {
         "mesh": f"{n_data}x{n_graph} (data x graph)",
         "padded_rows": rows,
         "words_per_device": w_local,
+        "bitplanes": 2 if planes else 1,
         "all_gather_recv_bytes_per_device_per_iter":
-            rows * w_local * 4 * (n_graph - 1) // n_graph,
+            rows * w_local * 4 * (n_graph - 1) // n_graph * factor,
         "data_axis_comm_bytes": 0,
     }
 
@@ -199,7 +212,7 @@ class ShardedEllKernel:
 
     def __init__(self, prog: GraphProgram, mesh: Mesh,
                  num_iters: Optional[int] = None, tables=None):
-        from ..ops.ell import K_AUX, K_MAIN, build_tables
+        from ..ops.ell import K_AUX, K_CAV, K_MAIN, build_cav_tables, build_tables
         from ..ops.ell import MAX_ITERATIONS as ELL_MAX
 
         self.prog = prog
@@ -208,8 +221,24 @@ class ShardedEllKernel:
         n = prog.state_size
         dead = prog.dead_index
         n_graph = mesh.shape["graph"]
-        self.n_pad = _ceil_mult(n, n_graph)
+        # tri-state plane path: undecidable caveated edges feed a MAYBE
+        # plane carried on a trailing size-2 axis (plane swap at exclusion
+        # stays device-local; see _apply_perm_expr_packed plane_last)
+        self.planes = bool(len(prog.cav_src)) and prog.caveats_device_ok
         a = t.idx_aux.shape[0]
+        tree_depth = t.tree_depth
+        cav = None
+        if self.planes:
+            cav = build_cav_tables(prog, a)
+            if cav.n_aux_cav:
+                # caveat OR-tree nodes live in the aux block (dead rows in
+                # the shared aux table; children in the cav table)
+                t.idx_aux = np.vstack([
+                    t.idx_aux,
+                    np.full((cav.n_aux_cav, K_AUX), dead, np.int32)])
+                a += cav.n_aux_cav
+            tree_depth = max(tree_depth, cav.tree_depth)
+        self.n_pad = _ceil_mult(n, n_graph)
         self.a_pad = _ceil_mult(max(a, 1), n_graph)
         main = np.full((self.n_pad, K_MAIN), dead, np.int32)
         main[:n] = t.idx_main
@@ -220,10 +249,22 @@ class ShardedEllKernel:
             main[main >= n] += self.n_pad - n
             aux[aux >= n] += self.n_pad - n
         base = num_iters or ELL_MAX
-        self.num_iters = base * (1 + t.tree_depth)
+        self.num_iters = base * (1 + tree_depth)
         self._row_spec = NamedSharding(mesh, P("graph", None))
         self.idx_main = jax.device_put(main, self._row_spec)
         self.idx_aux = jax.device_put(aux, self._row_spec)
+        self.idx_cav = None
+        if self.planes:
+            # reindex the cav table from compile row space ([0,n) main +
+            # [n, n+a) aux) to the padded device row space, values incl.
+            cav_dev = np.full((self.n_pad + self.a_pad, K_CAV), dead,
+                              np.int32)
+            cav_dev[:n] = cav.idx_cav[:n]
+            cav_dev[self.n_pad: self.n_pad + (cav.idx_cav.shape[0] - n)] = \
+                cav.idx_cav[n:]
+            if self.n_pad != n:
+                cav_dev[cav_dev >= n] += self.n_pad - n
+            self.idx_cav = jax.device_put(cav_dev, self._row_spec)
         self._jits: dict = {}
 
     # -- incremental row updates ---------------------------------------------
@@ -254,28 +295,37 @@ class ShardedEllKernel:
     # -- the sharded program -------------------------------------------------
 
     def _evaluate_shard_fn(self):
-        from ..ops.ell import (K_AUX, K_MAIN, _apply_perm_expr_packed)
+        from ..ops.ell import K_AUX, K_CAV, K_MAIN, _apply_perm_expr_packed
 
         prog = self.prog
         n_pad = self.n_pad
         dead = prog.dead_index
+        planes = self.planes
         perm_ops = tuple(prog.perm_ops)
         wc_masks = []
         for term in prog.wildcard_terms:
-            m = np.zeros((n_pad, 1), np.uint32)
+            shape = (n_pad, 1, 1) if planes else (n_pad, 1)
+            m = np.zeros(shape, np.uint32)
             m[np.asarray(term.mask_indices, np.int64)] = np.uint32(0xFFFFFFFF)
             wc_masks.append((term, jnp.asarray(m)))
         num_iters = self.num_iters
 
-        def shard_fn(q_local, main_local, aux_local):
+        def shard_fn(q_local, main_local, aux_local, cav_local=None):
             wl = q_local.shape[0] // 32
             cols = jnp.arange(q_local.shape[0])
             word = cols // 32
             bit = (cols % 32).astype(jnp.uint32)
-            x0_main = jnp.zeros((n_pad, wl), jnp.uint32)
-            x0_main = x0_main.at[q_local, word].add(jnp.uint32(1) << bit)
+            # planes: trailing size-2 axis (0=definite, 1=maybe); the
+            # query subject seeds BOTH planes (broadcast add)
+            shape = (n_pad, wl, 2) if planes else (n_pad, wl)
+            x0_main = jnp.zeros(shape, jnp.uint32)
+            if planes:
+                x0_main = x0_main.at[q_local, word, :].add(
+                    jnp.uint32(1) << bit[:, None])
+            else:
+                x0_main = x0_main.at[q_local, word].add(jnp.uint32(1) << bit)
             x0_main = x0_main.at[dead].set(np.uint32(0))
-            x0_aux = jnp.zeros((self.a_pad, wl), jnp.uint32)
+            x0_aux = jnp.zeros((self.a_pad,) + shape[1:], jnp.uint32)
 
             def step(x_main, x_aux):
                 x = jnp.concatenate([x_main, x_aux], axis=0)
@@ -286,21 +336,36 @@ class ShardedEllKernel:
                 for k in range(1, K_AUX):
                     y_aux_l = y_aux_l | x[aux_local[:, k]]
                 # reassemble row blocks across the graph axis (tiled ICI
-                # all-gather; payload is rows x local words)
+                # all-gather; payload is rows x local words [x planes])
                 y_main = jax.lax.all_gather(y_main_l, "graph", axis=0,
                                             tiled=True)
                 y_aux = jax.lax.all_gather(y_aux_l, "graph", axis=0,
                                            tiled=True)
+                if cav_local is not None:
+                    # undecidable caveated edges: closure feeds the MAYBE
+                    # plane only
+                    y_cav_l = x[cav_local[:, 0]]
+                    for k in range(1, K_CAV):
+                        y_cav_l = y_cav_l | x[cav_local[:, k]]
+                    y_cav = jax.lax.all_gather(y_cav_l, "graph", axis=0,
+                                               tiled=True)
+                    y_main = jnp.stack(
+                        [y_main[..., 0],
+                         y_main[..., 1] | y_cav[:n_pad, ..., 1]], axis=-1)
+                    y_aux = jnp.stack(
+                        [y_aux[..., 0],
+                         y_aux[..., 1] | y_cav[n_pad:, ..., 1]], axis=-1)
                 for term, mask in wc_masks:
                     live = jax.lax.dynamic_slice_in_dim(
                         y_main | x0_main, term.self_offset, term.self_length,
                         axis=0)
                     any_live = jax.lax.reduce(
-                        live, np.uint32(0), jax.lax.bitwise_or, (0,))[None, :]
+                        live, np.uint32(0), jax.lax.bitwise_or, (0,))[None]
                     y_main = y_main | (mask & any_live)
                 x1 = y_main | x0_main
                 for op in perm_ops:
-                    vec = _apply_perm_expr_packed(op.expr, x1)
+                    vec = _apply_perm_expr_packed(op.expr, x1,
+                                                  plane_last=planes)
                     seed = jax.lax.dynamic_slice_in_dim(
                         x0_main, op.offset, op.length, axis=0)
                     x1 = jax.lax.dynamic_update_slice_in_dim(
@@ -324,9 +389,17 @@ class ShardedEllKernel:
                 cond, body, (x0_main, x0_aux, jnp.bool_(True), jnp.int32(0)))
             return x_main
 
+        row = P("graph", None)
+        if planes:
+            return jax.shard_map(
+                shard_fn, mesh=self.mesh,
+                in_specs=(P("data"), row, row, row),
+                out_specs=P(None, "data", None),
+                check_vma=False,  # state replicated along `graph` by design
+            )
         return jax.shard_map(
             shard_fn, mesh=self.mesh,
-            in_specs=(P("data"), P("graph", None), P("graph", None)),
+            in_specs=(P("data"), row, row),
             out_specs=P(None, "data"),
             check_vma=False,  # state is replicated along `graph` by design
         )
@@ -334,16 +407,35 @@ class ShardedEllKernel:
     def _fns(self) -> tuple:
         if not self._jits:
             evaluate = self._evaluate_shard_fn()
+            if self.planes:
+                def run_lookup(slot_offset, slot_length, q, idx_main,
+                               idx_aux, idx_cav):
+                    x = evaluate(q, idx_main, idx_aux, idx_cav)
+                    # DEFINITE plane only: LookupResources skips
+                    # conditional results (reference lookups.go:85-88)
+                    return jax.lax.dynamic_slice_in_dim(
+                        x[..., 0], slot_offset, slot_length, axis=0)
 
-            def run_lookup(slot_offset, slot_length, q, idx_main, idx_aux):
-                x = evaluate(q, idx_main, idx_aux)
-                return jax.lax.dynamic_slice_in_dim(
-                    x, slot_offset, slot_length, axis=0)
+                def run_checks(q, gather_idx, gather_word, gather_bit,
+                               idx_main, idx_aux, idx_cav):
+                    x = evaluate(q, idx_main, idx_aux, idx_cav)
+                    d = (x[gather_idx, gather_word, 0] >> gather_bit) \
+                        & jnp.uint32(1)
+                    m = (x[gather_idx, gather_word, 1] >> gather_bit) \
+                        & jnp.uint32(1)
+                    # 2=HAS, 1=CONDITIONAL, 0=NO
+                    return d * 2 + (m & (d ^ jnp.uint32(1)))
+            else:
+                def run_lookup(slot_offset, slot_length, q, idx_main, idx_aux):
+                    x = evaluate(q, idx_main, idx_aux)
+                    return jax.lax.dynamic_slice_in_dim(
+                        x, slot_offset, slot_length, axis=0)
 
-            def run_checks(q, gather_idx, gather_word, gather_bit,
-                           idx_main, idx_aux):
-                x = evaluate(q, idx_main, idx_aux)
-                return (x[gather_idx, gather_word] >> gather_bit) & jnp.uint32(1)
+                def run_checks(q, gather_idx, gather_word, gather_bit,
+                               idx_main, idx_aux):
+                    x = evaluate(q, idx_main, idx_aux)
+                    return (x[gather_idx, gather_word] >> gather_bit) \
+                        & jnp.uint32(1)
 
             self._jits = (jax.jit(run_lookup, static_argnums=(0, 1)),
                           jax.jit(run_checks))
@@ -353,15 +445,10 @@ class ShardedEllKernel:
 
     def padded_batch_words(self, batch: int) -> int:
         """uint32 word count for a `batch`-column query: a multiple of the
-        data-axis size so every chip gets whole words.  The single source of
-        the padding formula (the endpoint's batch_bucket calls this too)."""
-        from ..ops.ell import batch_words
-
-        n_data = self.mesh.shape["data"]
-        w = batch_words(batch, minimum=n_data)
-        if w % n_data:
-            w += n_data - (w % n_data)
-        return w
+        data-axis size so every chip gets whole words (formula lives in
+        padded_batch_words_for; the endpoint's batch_bucket calls this
+        too)."""
+        return padded_batch_words_for(self.mesh.shape["data"], batch)
 
     def _pad_q(self, q_idx: np.ndarray) -> np.ndarray:
         w = self.padded_batch_words(len(q_idx))
@@ -369,21 +456,28 @@ class ShardedEllKernel:
         out[: len(q_idx)] = q_idx
         return out
 
+    def _table_args(self) -> tuple:
+        if self.planes:
+            return (self.idx_main, self.idx_aux, self.idx_cav)
+        return (self.idx_main, self.idx_aux)
+
     def lookup(self, slot_offset: int, slot_length: int,
                q_idx: np.ndarray) -> np.ndarray:
-        """bool [slot_length, B] allowed bitmap over the real batch."""
+        """bool [slot_length, B] allowed bitmap over the real batch
+        (DEFINITE plane under the tri-state path)."""
         run_lookup, _ = self._fns()
         q = jax.device_put(self._pad_q(np.asarray(q_idx, np.int32)),
                            NamedSharding(self.mesh, P("data")))
         packed = np.ascontiguousarray(
-            run_lookup(slot_offset, slot_length, q, self.idx_main,
-                       self.idx_aux))
+            run_lookup(slot_offset, slot_length, q, *self._table_args()))
         bits = np.unpackbits(packed.view(np.uint8).reshape(slot_length, -1),
                              axis=1, bitorder="little").astype(bool)
         return bits[:, : len(q_idx)]
 
     def checks(self, q_idx: np.ndarray, gather_idx: np.ndarray,
                gather_col: np.ndarray) -> np.ndarray:
+        """bool allowed per gather slot — or int {0,1,2} tri-state when
+        the plane path is active."""
         run_lookup, run_checks = self._fns()
         q = jax.device_put(self._pad_q(np.asarray(q_idx, np.int32)),
                            NamedSharding(self.mesh, P("data")))
@@ -395,5 +489,7 @@ class ShardedEllKernel:
         out = np.asarray(run_checks(
             q, jnp.asarray(gi), jnp.asarray(gcol // 32),
             jnp.asarray((gcol % 32).astype(np.uint32)),
-            self.idx_main, self.idx_aux))
+            *self._table_args()))
+        if self.planes:
+            return out[: len(gather_idx)].astype(np.int8)
         return (out[: len(gather_idx)] != 0)

@@ -205,6 +205,49 @@ class TestRandomizedDifferential:
                        [SubjectRef("user", u) for u in users])
         assert ep.stats["oracle_residual_checks"] == 0
 
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_random_graphs_sharded_mesh(self, seed):
+        """The sharded kernel carries the same MAYBE plane (trailing plane
+        axis, exclusion swap device-local): differential vs the oracle on
+        the virtual 2x4 mesh."""
+        from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+            Bootstrap,
+            create_endpoint,
+        )
+
+        rng = random.Random(seed + 100)
+        users = [f"u{i}" for i in range(5)]
+        docs = [f"d{i}" for i in range(6)]
+        folders = ["f0", "f1"]
+        rels = set()
+        for _ in range(40):
+            kind = rng.randrange(4)
+            u = rng.choice(users)
+            if kind == 0:
+                suf = rng.choice(["", UNDECIDED, TRUE_CTX, FALSE_CTX])
+                rel = rng.choice(["reader", "blocked", "required"])
+                rels.add(f"doc:{rng.choice(docs)}#{rel}@user:{u}{suf}")
+            elif kind == 1:
+                rels.add(f"doc:{rng.choice(docs)}#folder@folder:"
+                         f"{rng.choice(folders)}")
+            elif kind == 2:
+                suf = rng.choice(["", UNDECIDED])
+                rels.add(f"folder:{rng.choice(folders)}#viewer@user:{u}{suf}")
+            else:
+                rels.add(f"folder:{rng.choice(folders)}#owner@user:{u}")
+        ep = create_endpoint("jax://?mesh=2x4&dispatch=direct",
+                             Bootstrap(schema_text=SCHEMA))
+        parsed = [parse_relationship(r) for r in sorted(rels)]
+        ep.store.bulk_load(parsed)
+        oracle = Evaluator(sch.parse_schema(SCHEMA), ep.store)
+        assert_matches(ep, oracle, "doc", docs,
+                       ["base", "gated", "view", "strict"],
+                       [SubjectRef("user", u) for u in users])
+        assert ep.stats["oracle_residual_checks"] == 0
+        from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import _ShardedEllGraph
+        assert isinstance(ep._graph, _ShardedEllGraph)
+        assert ep._graph.kernel.planes  # the MAYBE plane really engaged
+
     def test_wildcard_caveat_falls_back_to_oracle(self):
         """No device lowering for caveated wildcards: affected pairs route
         to the host oracle exactly as before round 4."""

@@ -1,0 +1,125 @@
+"""CachingRESTMapper concurrency + cache discipline (reference
+pkg/proxy/restmapper_test.go:108-179: the discovery mapper is not
+concurrency-safe, so the wrapper must serialize it; GVR->GVK hits are
+memoized with a TTL; errors are never cached)."""
+
+import asyncio
+import json
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import Request, Response, Transport
+from spicedb_kubeapi_proxy_tpu.proxy.restmapper import (
+    CachingRESTMapper,
+    NoKindMatchError,
+)
+
+
+class CountingDiscovery(Transport):
+    """Fake discovery endpoint that records concurrency and call counts."""
+
+    def __init__(self, fail_times: int = 0, delay: float = 0.01):
+        self.calls = 0
+        self.in_flight = 0
+        self.max_in_flight = 0
+        self.fail_times = fail_times
+        self.delay = delay
+
+    async def round_trip(self, req: Request) -> Response:
+        self.calls += 1
+        self.in_flight += 1
+        self.max_in_flight = max(self.max_in_flight, self.in_flight)
+        try:
+            await asyncio.sleep(self.delay)
+            if self.fail_times > 0:
+                self.fail_times -= 1
+                return Response(status=503)
+            return Response(status=200, body=json.dumps({
+                "resources": [{"name": "pods", "kind": "Pod"},
+                              {"name": "services", "kind": "Service"}],
+            }).encode())
+        finally:
+            self.in_flight -= 1
+
+
+class TestConcurrency:
+    def test_concurrent_lookups_serialize_discovery(self):
+        """100 concurrent kind_for calls: the non-concurrency-safe
+        discovery transport must never see overlapping requests, and the
+        cache must collapse them into one call."""
+        disc = CountingDiscovery()
+        mapper = CachingRESTMapper(disc)
+
+        async def go():
+            out = await asyncio.gather(
+                *[mapper.kind_for("", "v1", "pods") for _ in range(100)])
+            assert all(g.kind == "Pod" for g in out)
+        asyncio.run(go())
+        assert disc.max_in_flight == 1  # serialized
+        assert disc.calls == 1          # cached after the first
+
+    def test_mixed_keys_under_concurrency(self):
+        disc = CountingDiscovery()
+        mapper = CachingRESTMapper(disc)
+
+        async def go():
+            out = await asyncio.gather(
+                *[mapper.kind_for("", "v1",
+                                  "pods" if i % 2 else "services")
+                  for i in range(50)])
+            kinds = {g.kind for g in out}
+            assert kinds == {"Pod", "Service"}
+        asyncio.run(go())
+        assert disc.max_in_flight == 1
+        assert disc.calls == 2  # one discovery per distinct GVR
+
+
+class TestCacheDiscipline:
+    def test_errors_never_cached(self):
+        """A failed discovery must not poison the cache: the next call
+        retries and succeeds (reference restmapper.go 'never cache
+        errors')."""
+        disc = CountingDiscovery(fail_times=1)
+        mapper = CachingRESTMapper(disc)
+
+        async def go():
+            with pytest.raises(NoKindMatchError):
+                await mapper.kind_for("", "v1", "pods")
+            gvk = await mapper.kind_for("", "v1", "pods")
+            assert gvk.kind == "Pod"
+        asyncio.run(go())
+        assert disc.calls == 2
+
+    def test_ttl_expiry_refetches(self):
+        now = [0.0]
+        disc = CountingDiscovery()
+        mapper = CachingRESTMapper(disc, ttl=10.0, clock=lambda: now[0])
+
+        async def go():
+            await mapper.kind_for("", "v1", "pods")
+            await mapper.kind_for("", "v1", "pods")
+            assert disc.calls == 1  # within TTL
+            now[0] = 11.0
+            await mapper.kind_for("", "v1", "pods")
+            assert disc.calls == 2  # expired -> refetched
+        asyncio.run(go())
+
+    def test_invalidate_clears(self):
+        disc = CountingDiscovery()
+        mapper = CachingRESTMapper(disc)
+
+        async def go():
+            await mapper.kind_for("", "v1", "pods")
+            mapper.invalidate()
+            await mapper.kind_for("", "v1", "pods")
+        asyncio.run(go())
+        assert disc.calls == 2
+
+    def test_unknown_resource_raises(self):
+        disc = CountingDiscovery()
+        mapper = CachingRESTMapper(disc)
+
+        async def go():
+            with pytest.raises(NoKindMatchError):
+                await mapper.kind_for("", "v1", "widgets")
+        asyncio.run(go())
