@@ -167,13 +167,14 @@ def comm_model(state_size: int, n_aux_rows: int, n_data: int, n_graph: int,
     throughput parallelism with zero communication.
 
     With the tri-state plane path active (`planes`), each gathered row
-    carries 2 planes AND the step all_gathers the extra y_cav closure
-    over the same row count — 4x the definite-path traffic."""
+    carries 2 planes, plus the step all_gathers the extra y_cav closure
+    (maybe plane only) over the same row count — 3x the definite-path
+    traffic."""
     n_pad = _ceil_mult(state_size, n_graph)
     a_pad = _ceil_mult(max(n_aux_rows, 1), n_graph)
     w_local = max(1, padded_batch_words_for(n_data, batch) // n_data)
     rows = n_pad + a_pad
-    factor = 4 if planes else 1
+    factor = 3 if planes else 1
     return {
         "mesh": f"{n_data}x{n_graph} (data x graph)",
         "padded_rows": rows,
@@ -343,18 +344,19 @@ class ShardedEllKernel:
                                            tiled=True)
                 if cav_local is not None:
                     # undecidable caveated edges: closure feeds the MAYBE
-                    # plane only
-                    y_cav_l = x[cav_local[:, 0]]
+                    # plane only — slice the plane BEFORE the all_gather
+                    # so only maybe-plane words cross ICI
+                    y_cav_l = x[cav_local[:, 0], :, 1]
                     for k in range(1, K_CAV):
-                        y_cav_l = y_cav_l | x[cav_local[:, k]]
+                        y_cav_l = y_cav_l | x[cav_local[:, k], :, 1]
                     y_cav = jax.lax.all_gather(y_cav_l, "graph", axis=0,
                                                tiled=True)
                     y_main = jnp.stack(
                         [y_main[..., 0],
-                         y_main[..., 1] | y_cav[:n_pad, ..., 1]], axis=-1)
+                         y_main[..., 1] | y_cav[:n_pad]], axis=-1)
                     y_aux = jnp.stack(
                         [y_aux[..., 0],
-                         y_aux[..., 1] | y_cav[n_pad:, ..., 1]], axis=-1)
+                         y_aux[..., 1] | y_cav[n_pad:]], axis=-1)
                 for term, mask in wc_masks:
                     live = jax.lax.dynamic_slice_in_dim(
                         y_main | x0_main, term.self_offset, term.self_length,

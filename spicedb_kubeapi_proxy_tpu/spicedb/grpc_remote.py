@@ -286,6 +286,16 @@ class RemoteEndpoint(PermissionsEndpoint):
             rid, ship = wire.dec_lookup_response(chunk)
             yield rid
 
+    async def lookup_resources_batch(self, resource_type: str,
+                                     permission: str, subjects: list) -> list:
+        """Concurrent LR streams (not sequential): a permsd server wrapping
+        a TPU backend fuses concurrent callers into device batches
+        (spicedb/dispatch.py), so issuing the whole batch at once lets the
+        SERVER batch it — sequential awaits would serialize the kernel."""
+        return list(await asyncio.gather(
+            *[self.lookup_resources(resource_type, permission, s)
+              for s in subjects]))
+
     async def read_relationships(self, flt: Optional[RelationshipFilter]) -> list:
         return [rel async for rel in self.read_relationships_stream(flt)]
 

@@ -18,6 +18,8 @@ directions for the rest:
   ObjectReference        { object_type=1, object_id=2 }
   SubjectReference       { object=1, optional_relation=2 }
   Relationship           { resource=1, relation=2, subject=3,
+                           optional_caveat=4 (ContextualizedCaveat{
+                             caveat_name=1, context=2 (Struct) }),
                            optional_expires_at=5 (Timestamp) }
   ZedToken               { token=1 }
   Consistency            { fully_consistent=4 }   (always sent)
@@ -54,6 +56,7 @@ Precondition.Operation: 1=MUST_NOT_MATCH, 2=MUST_MATCH.
 from __future__ import annotations
 
 import math
+import struct
 from typing import Iterator, Optional
 
 from .types import (
@@ -201,21 +204,104 @@ def _dec_timestamp(buf: bytes) -> float:
     return float(seconds) + float(nanos) / 1e9
 
 
+# -- google.protobuf.Struct (caveat context) ---------------------------------
+# Value oneof: null_value=1 (varint), number_value=2 (double/fixed64),
+# string_value=3, bool_value=4 (varint), struct_value=5, list_value=6.
+# Oneof fields must be emitted even for zero values, so the generic
+# zero-dropping helpers are bypassed here.
+
+def _enc_value(v) -> bytes:
+    if v is None:
+        return _tag(1, 0) + _varint(0)
+    if isinstance(v, bool):
+        return _tag(4, 0) + _varint(1 if v else 0)
+    if isinstance(v, (int, float)):
+        return _tag(2, 1) + struct.pack("<d", float(v))
+    if isinstance(v, str):
+        return _len_field_present(3, v.encode("utf-8"))
+    if isinstance(v, dict):
+        return _len_field_present(5, _enc_struct(v))
+    if isinstance(v, (list, tuple)):
+        payload = b"".join(_len_field_present(1, _enc_value(x)) for x in v)
+        return _len_field_present(6, payload)
+    raise ValueError(f"unsupported caveat context value {type(v).__name__}")
+
+
+def _dec_value(buf: bytes):
+    for f, wt, v in fields(buf):
+        if f == 1:
+            return None
+        if f == 2:
+            num = struct.unpack("<d", v)[0]
+            # integral doubles come back as ints so JSON contexts
+            # round-trip exactly ({"n": 1} -> 1, not 1.0)
+            return int(num) if num.is_integer() else num
+        if f == 3:
+            return v.decode("utf-8")
+        if f == 4:
+            return bool(v)
+        if f == 5:
+            return _dec_struct(v)
+        if f == 6:
+            return [_dec_value(x) for x in _submessages(v, 1)]
+    return None
+
+
+def _enc_struct(d: dict) -> bytes:
+    # Struct{ map<string, Value> fields = 1 }; map entries are
+    # { key=1, value=2 } submessages
+    out = b""
+    for k, v in d.items():
+        entry = _str_field(1, k) + _len_field_present(2, _enc_value(v))
+        out += _len_field_present(1, entry)
+    return out
+
+
+def _dec_struct(buf: bytes) -> dict:
+    out = {}
+    for entry in _submessages(buf, 1):
+        key = _first_str(entry, 1)
+        val = _first(entry, 2, b"")
+        out[key] = _dec_value(val)
+    return out
+
+
+def _enc_caveat(caveat) -> bytes:
+    """ContextualizedCaveat{ caveat_name=1, context=2 (Struct) }."""
+    out = _str_field(1, caveat.name)
+    ctx = caveat.context()
+    if ctx:
+        out += _len_field_present(2, _enc_struct(ctx))
+    return out
+
+
+def _dec_caveat(buf: bytes):
+    from .types import CaveatRef
+    ctx_buf = _first(buf, 2)
+    return CaveatRef.make(
+        _first_str(buf, 1),
+        _dec_struct(ctx_buf) if ctx_buf is not None else None)
+
+
 def enc_relationship(rel: Relationship) -> bytes:
     out = (_len_field(1, enc_object(rel.resource))
            + _str_field(2, rel.relation)
            + _len_field(3, enc_subject(rel.subject)))
+    if rel.caveat is not None:
+        out += _len_field_present(4, _enc_caveat(rel.caveat))
     if rel.expires_at is not None:
         out += _len_field(5, _enc_timestamp(rel.expires_at))
     return out
 
 
 def dec_relationship(buf: bytes) -> Relationship:
+    cav = _first(buf, 4)
     ts = _first(buf, 5)
     return Relationship(
         resource=dec_object(_first(buf, 1, b"")),
         relation=_first_str(buf, 2),
         subject=dec_subject(_first(buf, 3, b"")),
+        caveat=_dec_caveat(cav) if cav is not None else None,
         expires_at=_dec_timestamp(ts) if ts is not None else None,
     )
 

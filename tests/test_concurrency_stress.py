@@ -98,7 +98,12 @@ def test_mixed_concurrent_traffic(endpoint_url):
             while True:
                 upd = await watcher.next(timeout=2.0)
                 if upd is None:
-                    return
+                    # next() returns None on timeout AND close — only a
+                    # real close ends the stream (a slow box / cold JIT
+                    # can stall >2s mid-run without losing events)
+                    if watcher.closed:
+                        return
+                    continue
                 for u in upd.updates:
                     seen.append(u.rel.rel_string())
 
