@@ -100,6 +100,13 @@ class _SegmentGraph:
         # compile time (no undecidable edges)
         self.tri_state_capable = (prog.caveats_device_ok
                                   and not len(prog.cav_src))
+        # context-decided caveats delta incrementally (they are ordinary
+        # definite edges here); an undecidable caveat arrives through
+        # add_cav_rel, which reports failure and forces a rebuild — the
+        # rebuilt segment graph STAYS plane-less, so correctness comes
+        # from tri_state_capable flipping False and routing caveat-
+        # affected pairs to the host oracle
+        self.supports_cav_deltas = True
         capacity = bucket(max(len(prog.edge_src) * 2, _MIN_EDGE_BUCKET))
         src, dst = pad_edges(prog, capacity)
         self.edge_src = jnp.asarray(src)
@@ -200,6 +207,13 @@ class _SegmentGraph:
         return self._kernel().lookup(offset, length, q_arr, self.edge_src,
                                      self.edge_dst)
 
+    # no MAYBE plane: removals are vacuous, insertions force a rebuild
+    def remove_cav_key(self, key: tuple) -> bool:
+        return True
+
+    def add_cav_rel(self, rel: Relationship) -> bool:
+        return False
+
 
 class _EllGraph:
     """Bit-packed fixed-fanin tables + gather-only kernel (ops/ell.py).
@@ -222,10 +236,14 @@ class _EllGraph:
         # the recursive host oracle
         self.has_cav = bool(len(prog.cav_src)) and prog.caveats_device_ok
         self.tri_state_capable = prog.caveats_device_ok
+        # caveated tuples delta incrementally: decided ones through the
+        # definite tables, undecidable ones through the cav (MAYBE) table
+        self.supports_cav_deltas = True
         tree_depth = t.tree_depth
+        a_shared = t.idx_aux.shape[0]
         if self.has_cav:
             from .ell import K_AUX, build_cav_tables
-            ct = build_cav_tables(prog, t.idx_aux.shape[0])
+            ct = build_cav_tables(prog, a_shared)
             if ct.n_aux_cav:
                 # caveat OR-tree nodes get dead rows in the shared aux
                 # table so the one-step concat covers every state row
@@ -249,6 +267,10 @@ class _EllGraph:
                                      planes=self.has_cav)
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
+        self._dirty_cav: set = set()
+        # first cav-aux row index: values >= this in the cav table are
+        # OR-tree nodes whose children live in the cav table itself
+        self._cav_aux_base = prog.state_size + a_shared
 
     def index_tuples(self, tuples: list) -> None:
         pass  # positionless — nothing to index
@@ -311,6 +333,62 @@ class _EllGraph:
             self._set(loc, s)
         return True
 
+    # -- caveat (MAYBE plane) table deltas -----------------------------------
+    # Same positionless tree-walk discipline as the definite tables, over
+    # the cav gather table; callers route a tuple's edges here when its
+    # caveat is undecidable.  Only meaningful when planes were compiled
+    # (has_cav); the endpoint rebuilds otherwise.
+
+    def _walk_cav(self, root_row: int, want: int) -> Optional[tuple]:
+        if self.host_cav is None:
+            return None
+        stack = [root_row]
+        while stack:
+            row = stack.pop()
+            for col, v in enumerate(self.host_cav[row]):
+                v = int(v)
+                if v == want:
+                    return (row, col)
+                if v >= self._cav_aux_base:  # cav OR-tree node: descend
+                    stack.append(v)
+        return None
+
+    def _set_cav(self, loc: tuple, value: int) -> None:
+        row, col = loc
+        self.host_cav[row, col] = value
+        self._dirty_cav.add(row)
+
+    def remove_cav_key(self, key: tuple) -> bool:
+        """Remove a tuple's MAYBE-plane edges (no-op if absent)."""
+        if self.host_cav is None:
+            return True
+        pairs = self._edge_endpoints(self.prog, _rel_from_key(key))
+        if pairs is None:
+            return True  # ids never compiled: cannot be in the table
+        for (s, d) in pairs:
+            loc = self._walk_cav(d, s)
+            if loc is not None:
+                self._set_cav(loc, self.prog.dead_index)
+        return True
+
+    def add_cav_rel(self, rel: Relationship) -> bool:
+        """Insert a tuple's edges into the MAYBE plane; False forces a
+        rebuild (no planes compiled, unknown ids, or a full row/tree)."""
+        if self.host_cav is None:
+            return False
+        pairs = self._edge_endpoints(self.prog, rel)
+        if pairs is None:
+            return False
+        dead = self.prog.dead_index
+        for (s, d) in pairs:
+            if self._walk_cav(d, s) is not None:
+                continue  # already present (re-touch)
+            loc = self._walk_cav(d, dead)
+            if loc is None:
+                return False  # row and tree full: rebuild grows a level
+            self._set_cav(loc, s)
+        return True
+
     def flush(self) -> bool:
         changed = False
         if self._dirty_main:
@@ -324,6 +402,12 @@ class _EllGraph:
             self.dev_aux = self.dev_aux.at[jnp.asarray(rows)].set(
                 jnp.asarray(self.host_aux[rows]))
             self._dirty_aux = set()
+            changed = True
+        if self._dirty_cav:
+            rows = np.asarray(sorted(self._dirty_cav), np.int32)
+            self.dev_cav = self.dev_cav.at[jnp.asarray(rows)].set(
+                jnp.asarray(self.host_cav[rows]))
+            self._dirty_cav = set()
             changed = True
         return changed
 
@@ -387,8 +471,13 @@ class _ShardedEllGraph(_EllGraph):
         # shapes (wildcards etc.) fall back to the host oracle
         self.has_cav = self.kernel.planes
         self.tri_state_capable = prog.caveats_device_ok
+        # cav tables live on-device in padded row space with no host
+        # mirror: caveated deltas rebuild (rare on the serving path)
+        self.supports_cav_deltas = not self.has_cav
+        self.host_cav = None
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
+        self._dirty_cav: set = set()
 
     def flush(self) -> bool:
         changed = False
@@ -604,6 +693,19 @@ class JaxEndpoint(PermissionsEndpoint):
             self._expiry_meta[key] = expires_at
             heapq.heappush(self._expiry_heap, (expires_at, key))
 
+    def _caveat_decidability(self, rel: Relationship):
+        """Mirror of the compiler's caveat resolution (_emit_tuple_edges):
+        True = definite edges, False = no edges, None = MAYBE-plane edges,
+        "unsupported" = no device lowering (wildcard / unknown caveat /
+        evaluation error) — rebuild-only."""
+        c = self.schema.caveats.get(rel.caveat.name)
+        if c is None or rel.subject.id == WILDCARD:
+            return "unsupported"
+        try:
+            return c.evaluate(rel.caveat.context())
+        except Exception:
+            return "unsupported"
+
     def _drain_pending(self) -> list:
         """Atomically take all queued delta batches."""
         out = []
@@ -628,6 +730,7 @@ class JaxEndpoint(PermissionsEndpoint):
             return
 
         needs_rebuild = False
+        cav_deltas = getattr(graph, "supports_cav_deltas", False)
         for batch in batches:
             for u in batch.updates:
                 key = u.rel.key()
@@ -639,28 +742,55 @@ class JaxEndpoint(PermissionsEndpoint):
                         break
                     self._set_expiry(key, None)
                     if key in self._caveated_keys:
-                        # caveated tuples CAN be in the device tables now:
-                        # context-decided-True ones as definite edges,
-                        # undecidable ones in the MAYBE plane — only a
-                        # rebuild removes either shape correctly
-                        needs_rebuild = True
-                        break
+                        # caveated tuples can occupy the definite tables
+                        # (context decided True) or the MAYBE plane
+                        # (undecidable): clear both placements
+                        if not (cav_deltas and graph.remove_key(key)
+                                and graph.remove_cav_key(key)):
+                            needs_rebuild = True
+                            break
+                        self._caveated_keys.discard(key)
+                        continue
                     if not graph.remove_key(key):
                         needs_rebuild = True
                         break
                 elif u.rel.caveat is not None:  # TOUCH, caveated
-                    # caveat state changes reshape the MAYBE tables, the
-                    # affected-pair closure, or compile-time-resolved
-                    # definite edges — all rebuild-only
-                    needs_rebuild = True
-                    break
+                    self._set_expiry(key, u.rel.expires_at)
+                    value = self._caveat_decidability(u.rel)
+                    if value == "unsupported" or not cav_deltas:
+                        needs_rebuild = True
+                        break
+                    # a re-touch may change the caveat's decidability
+                    # (context edits): clear any previous placement, then
+                    # insert per the new value
+                    if not (graph.remove_key(key)
+                            and graph.remove_cav_key(key)):
+                        needs_rebuild = True
+                        break
+                    self._caveated_keys.add(key)
+                    self._caveated_pairs.add(
+                        (u.rel.resource.type, u.rel.relation))
+                    if value is True:
+                        if not graph.add_rel(u.rel):
+                            needs_rebuild = True
+                            break
+                    elif value is None:
+                        # MAYBE: needs compiled bitplanes (add_cav_rel
+                        # fails when the graph has none -> rebuild turns
+                        # them on)
+                        if not graph.add_cav_rel(u.rel):
+                            needs_rebuild = True
+                            break
+                    # value False: no edges at all
                 else:  # TOUCH, definite
                     self._set_expiry(key, u.rel.expires_at)
                     if key in self._caveated_keys:
                         # previously-caveated tuple replaced by a definite
-                        # one: its old plane placement must be undone
-                        needs_rebuild = True
-                        break
+                        # one: undo its old plane placement first
+                        if not (cav_deltas and graph.remove_cav_key(key)):
+                            needs_rebuild = True
+                            break
+                        self._caveated_keys.discard(key)
                     if not graph.add_rel(u.rel):
                         needs_rebuild = True
                         break
@@ -677,14 +807,19 @@ class JaxEndpoint(PermissionsEndpoint):
             if self._expiry_meta.get(key) != exp:
                 continue
             del self._expiry_meta[key]
-            if key in self._caveated_keys:
-                # may occupy the definite tables (decided True) or the
-                # MAYBE plane — rebuild removes either
-                needs_rebuild = True
-                break
             if key[4] == WILDCARD:
                 needs_rebuild = True
                 break
+            if key in self._caveated_keys:
+                # may occupy the definite tables (decided True) or the
+                # MAYBE plane — clear both placements
+                if not (getattr(graph, "supports_cav_deltas", False)
+                        and graph.remove_key(key)
+                        and graph.remove_cav_key(key)):
+                    needs_rebuild = True
+                    break
+                self._caveated_keys.discard(key)
+                continue
             if not graph.remove_key(key):
                 needs_rebuild = True
                 break

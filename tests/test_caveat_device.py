@@ -205,6 +205,99 @@ class TestRandomizedDifferential:
                        [SubjectRef("user", u) for u in users])
         assert ep.stats["oracle_residual_checks"] == 0
 
+    def test_incremental_caveat_deltas_no_rebuild(self):
+        """Caveated writes on the single-chip graph apply incrementally
+        (VERDICT soft spot: they used to force a multi-second rebuild)."""
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipUpdate,
+            UpdateOp,
+        )
+
+        def write(ep, *rels, op=UpdateOp.TOUCH):
+            ep.store.write([RelationshipUpdate(op, parse_relationship(r))
+                            for r in rels])
+
+        # start WITH a caveated tuple so the bitplanes are compiled in
+        ep, oracle = make_pair([
+            "doc:d0#reader@user:a",
+            f"doc:d9#reader@user:z{UNDECIDED}",
+            "doc:d0#folder@folder:f0",
+            "folder:f0#owner@user:a",
+        ])
+        subjects = [SubjectRef("user", u) for u in ("a", "b", "z")]
+        assert_matches(ep, oracle, "doc", ["d0", "d9"], ["base", "view"],
+                       subjects)
+        rebuilds = ep.stats["rebuilds"]
+
+        # undecidable caveats are written against already-compiled ids so
+        # each write exercises the incremental path, not a new-id rebuild
+        write(ep, f"doc:d0#blocked@user:a{UNDECIDED}")
+        assert_matches(ep, oracle, "doc", ["d0"], ["view"], subjects)
+
+        # re-touch flips it to context-decided True (definite edge)
+        write(ep, f"doc:d0#blocked@user:a{TRUE_CTX}")
+        assert_matches(ep, oracle, "doc", ["d0"], ["view"], subjects)
+
+        # then to decided False (no edges)
+        write(ep, f"doc:d0#blocked@user:a{FALSE_CTX}")
+        assert_matches(ep, oracle, "doc", ["d0"], ["view"], subjects)
+
+        # caveated tuple replaced by a definite one
+        write(ep, "doc:d9#reader@user:z")
+        assert_matches(ep, oracle, "doc", ["d9"], ["base"], subjects)
+
+        # and back to caveated, then deleted
+        write(ep, f"doc:d9#reader@user:z{UNDECIDED}")
+        assert_matches(ep, oracle, "doc", ["d9"], ["base"], subjects)
+        write(ep, f"doc:d9#reader@user:z{UNDECIDED}", op=UpdateOp.DELETE)
+        assert_matches(ep, oracle, "doc", ["d9"], ["base"], subjects)
+
+        # an ARROW-carrying tuple turning caveated: both its direct edge
+        # and its aux (folder->view) edge must move to the MAYBE plane,
+        # degrading the arrow branch to CONDITIONAL
+        write(ep, f"doc:d0#folder@folder:f0{UNDECIDED}")
+        assert_matches(ep, oracle, "doc", ["d0"], ["base", "view"],
+                       subjects)
+        write(ep, "doc:d0#folder@folder:f0")  # back to definite
+        assert_matches(ep, oracle, "doc", ["d0"], ["base", "view"],
+                       subjects)
+
+        assert ep.stats["rebuilds"] == rebuilds, "caveat deltas rebuilt"
+
+    def test_first_undecidable_caveat_rebuilds_once(self):
+        """A graph compiled without bitplanes gains them via one rebuild
+        when the first undecidable caveat arrives; decided caveats never
+        rebuild."""
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipUpdate,
+            UpdateOp,
+        )
+        ep, oracle = make_pair(["doc:d0#reader@user:a"])
+        subjects = [SubjectRef("user", u) for u in ("a", "b")]
+        assert_matches(ep, oracle, "doc", ["d0"], ["base"], subjects)
+        rebuilds = ep.stats["rebuilds"]
+
+        # decided-True caveat: ordinary definite edge, no rebuild
+        ep.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            f"doc:d0#blocked@user:a{TRUE_CTX}"))])
+        assert_matches(ep, oracle, "doc", ["d0"], ["view"], subjects)
+        assert ep.stats["rebuilds"] == rebuilds
+
+        # first UNDECIDABLE caveat: exactly one rebuild (turns planes on)
+        ep.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            f"doc:d0#required@user:a{UNDECIDED}"))])
+        assert_matches(ep, oracle, "doc", ["d0"], ["gated"], subjects)
+        assert ep.stats["rebuilds"] == rebuilds + 1
+
+        # subsequent undecidable writes on compiled ids are incremental
+        # (user:a is compiled; user:b would be a new-id rebuild, which is
+        # the same behavior definite deltas have)
+        ep.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            f"doc:d0#blocked@user:a{UNDECIDED}"))])
+        assert_matches(ep, oracle, "doc", ["d0"], ["view", "strict"],
+                       subjects)
+        assert ep.stats["rebuilds"] == rebuilds + 1
+
     @pytest.mark.parametrize("seed", [0, 1])
     def test_random_graphs_sharded_mesh(self, seed):
         """The sharded kernel carries the same MAYBE plane (trailing plane

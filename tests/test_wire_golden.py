@@ -395,8 +395,7 @@ class TestAgainstRealProtobuf:
         assert got["ratio"].number_value == 1.5
         assert got["name"].string_value == "x"
         assert got["on"].bool_value is True
-        # (null round-trips via the decode-side equality check below; the
-        # mirror descriptor declares no oneof, so WhichOneof is unusable)
+        assert got["missing"].WhichOneof("kind") == "null_value"
         assert [v.string_value or v.number_value or v.bool_value
                 for v in got["tags"].list_value.values] == ["a", 2, False]
         assert {e.key: e.value.string_value
