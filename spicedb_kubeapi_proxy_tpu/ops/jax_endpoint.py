@@ -471,10 +471,12 @@ class _ShardedEllGraph(_EllGraph):
         # shapes (wildcards etc.) fall back to the host oracle
         self.has_cav = self.kernel.planes
         self.tri_state_capable = prog.caveats_device_ok
-        # cav tables live on-device in padded row space with no host
-        # mirror: caveated deltas rebuild (rare on the serving path)
-        self.supports_cav_deltas = not self.has_cav
-        self.host_cav = None
+        # caveated deltas are incremental here too: the kernel keeps a
+        # compile-row-space host mirror of the cav table; flush remaps
+        # rows/values into the padded device space
+        self.supports_cav_deltas = True
+        self.host_cav = self.kernel.host_cav_compile
+        self._cav_aux_base = prog.state_size + self.kernel.n_aux_shared
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
         self._dirty_cav: set = set()
@@ -490,6 +492,11 @@ class _ShardedEllGraph(_EllGraph):
             rows = np.asarray(sorted(self._dirty_aux), np.int32)
             self.kernel.update_aux_rows(rows, self.host_aux[rows])
             self._dirty_aux = set()
+            changed = True
+        if self._dirty_cav:
+            rows = np.asarray(sorted(self._dirty_cav), np.int32)
+            self.kernel.update_cav_rows(rows, self.host_cav[rows])
+            self._dirty_cav = set()
             changed = True
         return changed
 

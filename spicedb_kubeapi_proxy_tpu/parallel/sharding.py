@@ -227,10 +227,15 @@ class ShardedEllKernel:
         # stays device-local; see _apply_perm_expr_packed plane_last)
         self.planes = bool(len(prog.cav_src)) and prog.caveats_device_ok
         a = t.idx_aux.shape[0]
+        self.n_aux_shared = a  # cav OR-tree nodes start past this
         tree_depth = t.tree_depth
         cav = None
+        self.host_cav_compile = None
         if self.planes:
             cav = build_cav_tables(prog, a)
+            # compile-row-space copy for the graph wrapper's incremental
+            # tree-walk edits (device copy lives in padded row space)
+            self.host_cav_compile = cav.idx_cav
             if cav.n_aux_cav:
                 # caveat OR-tree nodes live in the aux block (dead rows in
                 # the shared aux table; children in the cav table)
@@ -267,6 +272,14 @@ class ShardedEllKernel:
                 cav_dev[cav_dev >= n] += self.n_pad - n
             self.idx_cav = jax.device_put(cav_dev, self._row_spec)
         self._jits: dict = {}
+
+    def update_cav_rows(self, rows: np.ndarray, vals: np.ndarray) -> None:
+        """Incremental MAYBE-plane table edits.  Host tables are in compile
+        row space; the target rows and the gathered values shift by the
+        SAME aux-block offset (remap_values), since cav-table rows span
+        main+aux exactly like the values they hold."""
+        self.idx_cav = self._scatter_rows(
+            self.idx_cav, self.remap_values(rows), self.remap_values(vals))
 
     # -- incremental row updates ---------------------------------------------
 

@@ -341,6 +341,28 @@ class TestRandomizedDifferential:
         assert isinstance(ep._graph, _ShardedEllGraph)
         assert ep._graph.kernel.planes  # the MAYBE plane really engaged
 
+        # caveated deltas on compiled ids are incremental on the sharded
+        # graph too (host mirror + padded-row remap on flush)
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipUpdate,
+            UpdateOp,
+        )
+        rebuilds = ep.stats["rebuilds"]
+        u0, d0 = users[0], docs[0]
+        for rel in (f"doc:{d0}#blocked@user:{u0}{UNDECIDED}",
+                    f"doc:{d0}#blocked@user:{u0}{TRUE_CTX}",
+                    f"doc:{d0}#blocked@user:{u0}{FALSE_CTX}"):
+            ep.store.write([RelationshipUpdate(UpdateOp.TOUCH,
+                                               parse_relationship(rel))])
+            assert_matches(ep, oracle, "doc", [d0], ["view", "strict"],
+                           [SubjectRef("user", u0)])
+        ep.store.write([RelationshipUpdate(UpdateOp.DELETE,
+                                           parse_relationship(
+            f"doc:{d0}#blocked@user:{u0}{FALSE_CTX}"))])
+        assert_matches(ep, oracle, "doc", [d0], ["view"],
+                       [SubjectRef("user", u0)])
+        assert ep.stats["rebuilds"] == rebuilds, "sharded cav delta rebuilt"
+
     def test_wildcard_caveat_falls_back_to_oracle(self):
         """No device lowering for caveated wildcards: affected pairs route
         to the host oracle exactly as before round 4."""
