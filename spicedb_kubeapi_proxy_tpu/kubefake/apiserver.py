@@ -362,12 +362,24 @@ class FakeKubeApiServer:
             # real apiserver's negotiated streaming serializer shape
             from ..proxy import k8sproto
             meta = obj.get("metadata", {})
-            env = k8sproto.encode_unknown(
-                t.group_version, t.kind,
-                k8sproto.encode_object(t.group_version, t.kind,
-                                       meta.get("name", ""),
-                                       meta.get("namespace", "")),
-                "application/vnd.kubernetes.protobuf")
+            inner = k8sproto.encode_object(t.group_version, t.kind,
+                                           meta.get("name", ""),
+                                           meta.get("namespace", ""))
+            if wants_table:
+                # Table-mode watch: each event carries a one-row Table
+                # whose row object is a nested PartialObjectMetadata
+                # envelope — the same row shape the LIST Table path
+                # serves (proxy unwraps via table_first_row_meta)
+                env = k8sproto.encode_table([k8sproto.encode_unknown(
+                    "meta.k8s.io/v1", "PartialObjectMetadata",
+                    k8sproto.encode_object(
+                        "meta.k8s.io/v1", "PartialObjectMetadata",
+                        meta.get("name", ""), meta.get("namespace", "")),
+                    "application/vnd.kubernetes.protobuf")])
+            else:
+                env = k8sproto.encode_unknown(
+                    t.group_version, t.kind, inner,
+                    "application/vnd.kubernetes.protobuf")
             return k8sproto.encode_watch_event(event_type, env)
         payload = self._to_table(t, [obj]) if wants_table else obj
         return (json.dumps({"type": event_type, "object": payload},

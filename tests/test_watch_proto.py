@@ -256,6 +256,83 @@ class TestJsonWatchFailClosed:
         asyncio.run(go())
 
 
+class TestProtoTableWatchE2E:
+    def test_proto_table_watch_through_proxy(self):
+        """kubefake serves proto+Table watch frames (one-row Table with a
+        nested envelope, the real apiserver's shape); the proxy unwraps
+        the row meta and filters — end to end through the live chain."""
+        from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import (
+            FakeKubeApiServer,
+        )
+        from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
+        from spicedb_kubeapi_proxy_tpu.proxy.server import (
+            Options,
+            ProxyServer,
+        )
+        from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipUpdate,
+            UpdateOp,
+            parse_relationship,
+        )
+
+        SCHEMA = """
+definition user {}
+definition pod { relation viewer: user
+                 permission view = viewer }
+"""
+        RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: watch-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list, watch]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+"""
+        kube = FakeKubeApiServer()
+        kube.seed("", "v1", "pods",
+                  {"metadata": {"name": "p1", "namespace": "ns"}})
+        proxy = ProxyServer(Options(
+            spicedb_endpoint="embedded://",
+            bootstrap=Bootstrap(schema_text=SCHEMA),
+            rules_yaml=RULES,
+            upstream_transport=HandlerTransport(kube),
+        ))
+        client = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            resp = await client.get(
+                "/api/v1/pods?watch=true",
+                headers=[("Accept",
+                          "application/vnd.kubernetes.protobuf;as=Table;"
+                          "v=v1;g=meta.k8s.io;stream=watch")])
+            assert resp.status == 200
+            assert "protobuf" in resp.headers.get("Content-Type", "")
+            frames_q: asyncio.Queue = asyncio.Queue()
+
+            async def consume():
+                async for frame in resp.stream:
+                    await frames_q.put(frame)
+
+            task = asyncio.ensure_future(consume())
+            try:
+                # withheld until granted
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(frames_q.get(), 0.6)
+                await proxy.endpoint.write_relationships([
+                    RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                        "pod:ns/p1#viewer@user:alice"))])
+                frame = await asyncio.wait_for(frames_q.get(), 5)
+                ev, av, kind, raw = k8sproto.decode_watch_event(frame[4:])
+                assert ev == "ADDED" and kind == "Table"
+                assert k8sproto.table_first_row_meta(raw) == ("ns", "p1")
+            finally:
+                task.cancel()
+        asyncio.run(go())
+
+
 class TestContentTypeSelectsFraming:
     def test_filter_resp_detects_proto_stream(self):
         """filter_resp must pick length-delimited framing from the
