@@ -30,6 +30,7 @@ lock, so the device graph always reflects the committed store revision.
 
 from __future__ import annotations
 
+import asyncio
 import collections
 import heapq
 import os
@@ -544,6 +545,10 @@ class JaxEndpoint(PermissionsEndpoint):
         self._graph_cls = _GRAPH_KINDS[kind]
         self._lock = threading.RLock()
         self._graph = None
+        # store revision the device graph reflects (checked_at source):
+        # rebuilds capture it atomically with their snapshot; applied
+        # delta batches advance it to their own revision
+        self._graph_revision = 0
         # listener callbacks run while the STORE lock is held; they must
         # never take self._lock (ABBA deadlock with queries that hold
         # self._lock and read the store), so delta intake is a lock-free
@@ -640,20 +645,30 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def _rebuild(self) -> None:
         # a rebuild reflects the current store snapshot; any queued deltas
-        # are subsumed by it
+        # are subsumed by it (re-application of a delta already inside the
+        # snapshot is idempotent).  The snapshot reads and the revision
+        # capture hold the STORE lock together so checked_at can never
+        # name a revision other than the one the graph reflects (checks
+        # run off-loop now, so writes race the rebuild).
         self._drain_pending()
         self._graph_invalid = False
-        self._caveated_pairs = self.store.caveated_relation_pairs()
-        self._caveat_affected = (
-            caveat_affected_pairs(self.schema, self._caveated_pairs)
-            if self._caveated_pairs else set())
-        self._caveated_keys = (self.store.caveated_keys()
-                               if self._caveated_pairs else set())
         # phantom-subject columns: every type gets one reserved column so
         # first-contact subjects (zero tuples) still hit the kernel
         extra = {t: {PHANTOM_ID} for t in self.schema.definitions}
-        view = self.store.columnar_view() if self._graph_cls is _EllGraph \
-            or self.mesh is not None else None
+        with self.store.lock:
+            snapshot_revision = self.store.revision
+            self._caveated_pairs = self.store.caveated_relation_pairs()
+            self._caveat_affected = (
+                caveat_affected_pairs(self.schema, self._caveated_pairs)
+                if self._caveated_pairs else set())
+            self._caveated_keys = (self.store.caveated_keys()
+                                   if self._caveated_pairs else set())
+            view = self.store.columnar_view() \
+                if self._graph_cls is _EllGraph or self.mesh is not None \
+                else None
+            tuples = None if view is not None else self.store.read(None)
+        # the (long) compile runs outside the store lock: writes landing
+        # now queue deltas that re-apply idempotently on the new graph
         if view is not None:
             # vectorized compile straight off the store's columnar base —
             # no per-tuple object materialization (the ELL graph is
@@ -664,12 +679,12 @@ class JaxEndpoint(PermissionsEndpoint):
             graph = self._make_graph(prog)
             self._reset_expiry_columnar(snap, rows, overlay)
         else:
-            tuples = self.store.read(None)
             prog = compile_graph(self.schema, tuples, extra_subject_ids=extra)
             graph = self._make_graph(prog)
             graph.index_tuples(tuples)
             self._reset_expiry(tuples)
         self._graph = graph
+        self._graph_revision = snapshot_revision
         self.stats["rebuilds"] += 1
 
     def _reset_expiry_columnar(self, snap, rows, overlay) -> None:
@@ -737,8 +752,10 @@ class JaxEndpoint(PermissionsEndpoint):
             return
 
         needs_rebuild = False
+        applied_revision = self._graph_revision
         cav_deltas = getattr(graph, "supports_cav_deltas", False)
         for batch in batches:
+            applied_revision = max(applied_revision, batch.revision)
             for u in batch.updates:
                 key = u.rel.key()
                 if u.op == UpdateOp.DELETE:
@@ -834,6 +851,7 @@ class JaxEndpoint(PermissionsEndpoint):
         if needs_rebuild:
             self._rebuild()
             return
+        self._graph_revision = applied_revision
         if graph.flush():
             self.stats["delta_batches"] += 1
 
@@ -886,18 +904,29 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def _check_batch_sync(self, reqs: list) -> list:
         with self._lock:
-            # capture the revision BEFORE draining deltas so checked_at is
-            # never newer than the evaluated snapshot (writes committing
-            # during evaluation must not be attributed to the result)
-            rev = self.store.revision
+            # checked_at = the revision the drained graph actually
+            # reflects (tracked through rebuilds and applied deltas) —
+            # reading store.revision here instead would race loop-thread
+            # writes landing between the read and the drain, attributing
+            # results to a revision the kernel never evaluated
             graph = self._current_graph()
+            rev = self._graph_revision
             q_arr, cols, unknown = self._encode_subjects(
                 graph, [r.subject for r in reqs])
             gather_idx: list[int] = []
             gather_col: list[int] = []
             kernel_rows: list[int] = []  # positions in reqs served by kernel
-            results: list[Optional[int]] = [None] * len(reqs)  # tri-state
+            # per-row (tri-state value, checked_at): oracle fallbacks
+            # evaluate the LIVE store, so they carry its revision rather
+            # than claiming the graph snapshot's
+            results: list[Optional[tuple]] = [None] * len(reqs)
             tri = getattr(graph, "tri_state_capable", False)
+
+            def oracle_row(r):
+                return (self._oracle.check3(r.resource, r.permission,
+                                            r.subject),
+                        self.store.revision)
+
             for i, r in enumerate(reqs):
                 if (not tri and (r.resource.type, r.permission)
                         in self._caveat_affected):
@@ -905,15 +934,13 @@ class JaxEndpoint(PermissionsEndpoint):
                     # evaluation (pre-round-4 behavior; only the sharded /
                     # segment kernels and unsupported caveat shapes land
                     # here now)
-                    results[i] = self._oracle.check3(r.resource, r.permission,
-                                                     r.subject)
+                    results[i] = oracle_row(r)
                     self.stats["oracle_residual_checks"] += 1
                     continue
                 if r.subject in unknown:
                     # no slot for (type, relation) at all: oracle reproduces
                     # the schema error/edge semantics
-                    results[i] = self._oracle.check3(r.resource, r.permission,
-                                                     r.subject)
+                    results[i] = oracle_row(r)
                     continue
                 state_idx = graph.prog.state_index(
                     r.resource.type, r.permission, r.resource.id)
@@ -921,10 +948,9 @@ class JaxEndpoint(PermissionsEndpoint):
                     d = self.schema.definitions.get(r.resource.type)
                     if d is None or not d.has_relation_or_permission(r.permission):
                         # surface schema errors like the oracle does
-                        results[i] = self._oracle.check3(
-                            r.resource, r.permission, r.subject)
+                        results[i] = oracle_row(r)
                     else:
-                        results[i] = 0  # unknown object: no tuples
+                        results[i] = (0, rev)  # unknown object: no tuples
                     continue
                 gather_idx.append(state_idx)
                 gather_col.append(cols[r.subject])
@@ -933,17 +959,29 @@ class JaxEndpoint(PermissionsEndpoint):
                 out = graph.run_checks3(q_arr, gather_idx, gather_col)
                 self.stats["kernel_calls"] += 1
                 for j, row in enumerate(kernel_rows):
-                    results[row] = int(out[j])
-        return [CheckResult(permissionship=self._TRISTATE[r], checked_at=rev)
-                for r in results]
+                    results[row] = (int(out[j]), rev)
+        return [CheckResult(permissionship=self._TRISTATE[v],
+                            checked_at=at)
+                for (v, at) in results]
+
+    async def _off_loop(self, fn, *args):
+        """Run a device-touching sync path in the executor: a fused
+        1M-graph batch holds the kernel + transfer + unpack for hundreds
+        of ms, and running it ON the event loop would freeze every
+        concurrent request, watch frame, and health probe for that long.
+        self._lock is a threading.RLock, so executor threads serialize
+        against the delta-drain machinery exactly like loop-thread
+        callers did."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, fn, *args)
 
     async def check_permission(self, req: CheckRequest) -> CheckResult:
-        return self._check_batch_sync([req])[0]
+        return (await self._off_loop(self._check_batch_sync, [req]))[0]
 
     async def check_bulk_permissions(self, reqs: list) -> list:
         if not reqs:
             return []
-        return self._check_batch_sync(reqs)
+        return await self._off_loop(self._check_batch_sync, reqs)
 
     def _lookup_sync(self, resource_type: str, permission: str,
                      subject: SubjectRef) -> list:
@@ -976,7 +1014,8 @@ class JaxEndpoint(PermissionsEndpoint):
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
-        return self._lookup_sync(resource_type, permission, subject)
+        return await self._off_loop(self._lookup_sync, resource_type,
+                                    permission, subject)
 
     async def lookup_resources_stream(self, resource_type: str,
                                       permission: str, subject: SubjectRef):
@@ -985,10 +1024,8 @@ class JaxEndpoint(PermissionsEndpoint):
         so consumers' per-id extraction interleaves with other work — the
         device analog of draining the reference's LR server-stream
         (lookups.go:74-135)."""
-        import asyncio
-        loop = asyncio.get_running_loop()
-        ids = await loop.run_in_executor(None, self._lookup_sync,
-                                         resource_type, permission, subject)
+        ids = await self._off_loop(self._lookup_sync, resource_type,
+                                   permission, subject)
         chunk = 4096
         for i in range(0, len(ids), chunk):
             for rid in ids[i: i + chunk]:
@@ -1038,7 +1075,8 @@ class JaxEndpoint(PermissionsEndpoint):
                                      subjects: list) -> list:
         if not subjects:
             return []
-        return self._lookup_batch_sync(resource_type, permission, subjects)
+        return await self._off_loop(self._lookup_batch_sync, resource_type,
+                                    permission, subjects)
 
     async def read_relationships(self, flt: RelationshipFilter) -> list:
         return self.store.read(flt)

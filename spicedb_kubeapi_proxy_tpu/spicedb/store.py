@@ -177,6 +177,12 @@ class TupleStore:
         with self._lock:
             return self._revision
 
+    @property
+    def lock(self):
+        """The store's reentrant lock — for callers that must combine
+        several reads (e.g. revision + a snapshot) atomically."""
+        return self._lock
+
     # -- reads --------------------------------------------------------------
 
     def read(self, flt: Optional[RelationshipFilter] = None) -> list:

@@ -131,6 +131,69 @@ def test_mixed_concurrent_traffic(endpoint_url):
     asyncio.run(go())
 
 
+@pytest.mark.parametrize("endpoint_url", ["embedded://", "jax://"])
+def test_checked_at_tracks_evaluated_snapshot(endpoint_url):
+    """checked_at must name the revision the evaluated graph reflects —
+    after a write drains, checks carry that write's revision."""
+    ep = create_endpoint(endpoint_url, Bootstrap(schema_text=SCHEMA))
+    ep.store.bulk_load([parse_relationship(r) for r in seed_rels()])
+
+    async def go():
+        req = CheckRequest(ObjectRef("doc", "d0"), "view",
+                           SubjectRef("user", "u0"))
+        res = await ep.check_permission(req)
+        assert res.checked_at == ep.store.revision
+        await ep.write_relationships([RelationshipUpdate(
+            UpdateOp.TOUCH,
+            parse_relationship("doc:d0#viewer@user:fresh"))])
+        r1 = ep.store.revision
+        res = await ep.check_permission(CheckRequest(
+            ObjectRef("doc", "d0"), "view", SubjectRef("user", "fresh")))
+        assert res.allowed
+        assert res.checked_at == r1
+    asyncio.run(go())
+
+
+def test_device_batches_do_not_block_event_loop(monkeypatch):
+    """A fused device batch (kernel + transfer + unpack) can take hundreds
+    of ms on big graphs; it must run OFF the event loop so concurrent
+    requests, watch frames, and health probes keep flowing."""
+    import time as _time
+
+    ep = create_endpoint("jax://", Bootstrap(schema_text=SCHEMA))
+    ep.store.bulk_load([parse_relationship(r) for r in seed_rels()])
+
+    def slow_batch(reqs):
+        _time.sleep(0.5)  # stand-in for a long kernel+transfer window
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            CheckResult,
+            Permissionship,
+        )
+        return [CheckResult(permissionship=Permissionship.NO_PERMISSION,
+                            checked_at=0) for _ in reqs]
+
+    monkeypatch.setattr(ep, "_check_batch_sync", slow_batch)
+
+    async def go():
+        ticks = []
+
+        async def ticker():
+            while True:
+                ticks.append(asyncio.get_running_loop().time())
+                await asyncio.sleep(0.02)
+
+        t = asyncio.ensure_future(ticker())
+        await ep.check_bulk_permissions([CheckRequest(
+            ObjectRef("doc", "d0"), "view", SubjectRef("user", "u0"))])
+        t.cancel()
+        # the loop must have kept ticking through the 0.5s device window
+        assert len(ticks) >= 10, (
+            f"event loop starved: only {len(ticks)} ticks during the batch")
+        gaps = [b - a for a, b in zip(ticks, ticks[1:])]
+        assert max(gaps, default=1) < 0.3, f"loop stalled {max(gaps):.3f}s"
+    asyncio.run(go())
+
+
 @pytest.mark.parametrize("endpoint_url", ["jax://"])
 def test_concurrent_writes_during_rebuild(endpoint_url):
     """Writes racing graph rebuilds (bulk_load invalidation) must never
