@@ -284,7 +284,10 @@ def complete(args: argparse.Namespace,
         authenticators.append(ClientCertAuthenticator())
 
     endpoint_kwargs = {}
-    if args.spicedb_endpoint.startswith(("grpc", "http")):
+    if not args.spicedb_endpoint.startswith(("embedded", "jax")):
+        # every non-local endpoint dials gRPC — including the reference's
+        # scheme-less `host:port` default shape (options.go:107) — and
+        # must carry the connection flags
         endpoint_kwargs = {
             "token": args.spicedb_token,
             "insecure": args.spicedb_insecure,

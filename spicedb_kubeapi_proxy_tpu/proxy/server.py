@@ -119,9 +119,13 @@ class ProxyServer:
             **opts.endpoint_kwargs)
         if opts.enable_metrics:
             from ..spicedb.instrumented import InstrumentedEndpoint
+            # label = URL scheme; a scheme-less host:port endpoint is a
+            # remote gRPC dial — label it "grpc" rather than leaking the
+            # hostname into metric label cardinality
+            ep_str = opts.spicedb_endpoint
+            backend = (ep_str.split(":")[0] if "://" in ep_str else "grpc")
             self.endpoint = InstrumentedEndpoint(
-                self.endpoint,
-                backend_label=opts.spicedb_endpoint.split(":")[0])
+                self.endpoint, backend_label=backend)
         configs = list(opts.rule_configs)
         if opts.rules_yaml:
             configs.extend(proxyrule.parse(opts.rules_yaml))

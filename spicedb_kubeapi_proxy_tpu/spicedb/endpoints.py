@@ -294,6 +294,11 @@ def create_endpoint(url: str,
     (reference options.go:307-369)."""
     from urllib.parse import parse_qs
 
+    if "://" not in url and url:
+        # scheme-less `host:port` is a remote SpiceDB, exactly like the
+        # reference's default `localhost:50051` (options.go:107: anything
+        # that isn't embedded:// dials gRPC; TLS unless --spicedb-insecure)
+        url = "grpcs://" + url
     split = urlsplit(url)
     scheme = split.scheme
     params = parse_qs(split.query)

@@ -167,6 +167,19 @@ def test_complete_loads_and_validates_rules(tmp_path):
     assert completed.embedded_mode
 
 
+def test_scheme_less_endpoint_carries_connection_flags():
+    """The reference's default endpoint shape is scheme-less host:port
+    (options.go:107); token/insecure/CA flags must flow to it exactly as
+    they do for grpc:// URLs."""
+    args = parse(["--spicedb-endpoint", "spicedb.example.com:50051",
+                  "--spicedb-token", "tok", "--spicedb-insecure",
+                  "--use-in-cluster-config", "--embedded-mode"])
+    completed = cli.complete(args, upstream_transport=NullTransport())
+    kw = completed.server_options.endpoint_kwargs
+    assert kw["token"] == "tok"
+    assert kw["insecure"] is True
+
+
 def test_complete_rejects_invalid_rules(tmp_path):
     rules = tmp_path / "rules.yaml"
     rules.write_text("apiVersion: authzed.com/v1alpha1\nkind: Nope\n")

@@ -447,6 +447,11 @@ class TestEmbeddedEndpoint:
         from spicedb_kubeapi_proxy_tpu.spicedb.grpc_remote import RemoteEndpoint
         remote = create_endpoint("grpc://localhost:50051")
         assert isinstance(remote, RemoteEndpoint)
+        # scheme-less host:port = remote over TLS, the reference's default
+        # endpoint shape (options.go:107 `localhost:50051`)
+        bare = create_endpoint("localhost:50051")
+        assert isinstance(bare, RemoteEndpoint)
+        assert bare.target == "localhost:50051" and not bare.insecure
         with pytest.raises(EndpointConfigError, match="unsupported"):
             create_endpoint("carrier-pigeon://x")
 
