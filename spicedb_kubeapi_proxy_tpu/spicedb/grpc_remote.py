@@ -31,6 +31,7 @@ from .types import (
     AlreadyExistsError,
     CheckRequest,
     CheckResult,
+    Permissionship,
     Precondition,
     PreconditionFailedError,
     RelationshipFilter,
@@ -280,10 +281,18 @@ class RemoteEndpoint(PermissionsEndpoint):
     async def lookup_resources_stream(self, resource_type: str,
                                       permission: str, subject: SubjectRef):
         """True incremental drain of the LookupResources server-stream
-        (reference lookups.go:74-135): ids yield as frames arrive."""
+        (reference lookups.go:74-135): ids yield as frames arrive.
+
+        CONDITIONAL results are SKIPPED here, exactly like the reference
+        does for its remote SpiceDB (lookups.go:85-88) — a real SpiceDB
+        streams caveated matches with permissionship=CONDITIONAL, and
+        including them in a prefilter allowed-set would over-grant.
+        (Local endpoints never emit them: their LR is definite-plane.)"""
         payload = wire.enc_lookup_request(resource_type, permission, subject)
         async for chunk in self._unary_stream("LookupResources", payload):
             rid, ship = wire.dec_lookup_response(chunk)
+            if ship != Permissionship.HAS_PERMISSION:
+                continue
             yield rid
 
     async def lookup_resources_batch(self, resource_type: str,

@@ -487,10 +487,13 @@ def enc_lookup_response(revision: int, resource_id: str) -> bytes:
 
 
 def dec_lookup_response(buf: bytes) -> tuple:
-    """(resource_id, permissionship)"""
+    """(resource_id, permissionship).  FAIL CLOSED like the check
+    decoders: an absent permissionship field (proto3 zero = UNSPECIFIED)
+    or an unknown enum value decodes as NO_PERMISSION, so it can never
+    slip past the client's HAS-only filter into an allowed-set."""
     return (_first_str(buf, 2),
-            _PERMISSIONSHIP_R.get(_first(buf, 3, 2),
-                                  Permissionship.HAS_PERMISSION))
+            _PERMISSIONSHIP_R.get(_first(buf, 3, 0),
+                                  Permissionship.NO_PERMISSION))
 
 
 def enc_read_request(flt: Optional[RelationshipFilter]) -> bytes:

@@ -84,6 +84,38 @@ def test_caveats_round_trip_grpc():
     asyncio.run(go())
 
 
+def test_remote_lr_skips_conditional_results():
+    """A real SpiceDB streams caveated LookupResources matches with
+    permissionship=CONDITIONAL; the client must skip them (reference
+    lookups.go:85-88) — including one in a prefilter allowed-set would
+    over-grant."""
+    from spicedb_kubeapi_proxy_tpu.spicedb.wire import (
+        _len_field,
+        _str_field,
+        _varint_field,
+        enc_zedtoken,
+    )
+
+    def frame(rid, ship):
+        return (_len_field(1, enc_zedtoken(1)) + _str_field(2, rid)
+                + _varint_field(3, ship))
+
+    ep = RemoteEndpoint("127.0.0.1:1", insecure=True)
+
+    async def fake_stream(method, payload):
+        assert method == "LookupResources"
+        yield frame("definite-id", 2)      # HAS_PERMISSION
+        yield frame("caveated-id", 3)      # CONDITIONAL_PERMISSION
+        yield frame("unspecified-id", 0)   # absent field: fail closed
+        yield frame("future-enum-id", 9)   # unknown value: fail closed
+        yield frame("another-definite", 2)
+
+    ep._unary_stream = fake_stream
+    ids = asyncio.run(ep.lookup_resources(
+        "doc", "view", SubjectRef("user", "a")))
+    assert ids == ["definite-id", "another-definite"]
+
+
 def test_caveated_watch_through_grpc():
     async def go():
         from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
