@@ -1,0 +1,102 @@
+"""Stage-level timing of _lookup_batch_sync on the multitenant-1m graph:
+where do the ~1200ms per 256-subject fused batch actually go?
+
+Run on the real TPU:  python scripts/probe_lookup_stages.py
+"""
+
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint, PHANTOM_ID
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+ROUNDS = 4
+
+
+def main():
+    workload = wl.multitenant_1m()
+    schema = sch.parse_schema(workload.schema_text)
+    ep = JaxEndpoint(schema)
+    ep.store.bulk_load_text("\n".join(workload.relationships))
+    subjects = [SubjectRef("user", s) for s in workload.subjects[:256]]
+    rt, perm = workload.resource_type, workload.permission
+
+    # warm (build graph + compile)
+    ep._lookup_batch_sync(rt, perm, subjects)
+
+    stages = {k: [] for k in
+              ("drain", "encode", "kernel+transfer", "unpack",
+               "transpose+nonzero", "materialize", "total")}
+
+    for _ in range(ROUNDS):
+        t_all = time.perf_counter()
+        with ep._lock:
+            t0 = time.perf_counter()
+            graph = ep._current_graph()
+            stages["drain"].append(time.perf_counter() - t0)
+            rng = graph.prog.slot_range(rt, perm)
+            t0 = time.perf_counter()
+            q_arr, cols, unknown = ep._encode_subjects(graph, subjects)
+            stages["encode"].append(time.perf_counter() - t0)
+
+            n_words = max(1, len(q_arr) // 32)
+            _, run_lookup = graph.kernel._fns(n_words)
+            t0 = time.perf_counter()
+            import jax.numpy as jnp
+            if graph.kernel.planes:
+                packed = np.ascontiguousarray(run_lookup(
+                    rng[0], rng[1], jnp.asarray(q_arr), graph.dev_main,
+                    graph.dev_aux, graph.dev_cav))
+            else:
+                packed = np.ascontiguousarray(run_lookup(
+                    rng[0], rng[1], jnp.asarray(q_arr), graph.dev_main,
+                    graph.dev_aux))
+            stages["kernel+transfer"].append(time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            bitmap = np.unpackbits(
+                packed.view(np.uint8).reshape(rng[1], -1),
+                axis=1, bitorder="little").astype(bool)
+            stages["unpack"].append(time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            by_col, obj = np.nonzero(np.ascontiguousarray(bitmap.T))
+            splits = np.searchsorted(by_col, np.arange(1, len(cols) + 1))
+            per_col = np.split(obj, splits[:-1])
+            stages["transpose+nonzero"].append(time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            ids = graph.prog.object_ids[rt]
+            ph = graph.prog.object_index[rt].get(PHANTOM_ID)
+            per_col_ids = {}
+            out = []
+            for s in subjects:
+                col = cols[s]
+                lst = per_col_ids.get(col)
+                if lst is None:
+                    lst = per_col_ids[col] = \
+                        [ids[i] for i in per_col[col] if i != ph]
+                out.append(lst)
+            stages["materialize"].append(time.perf_counter() - t0)
+        stages["total"].append(time.perf_counter() - t_all)
+
+    for k, v in stages.items():
+        print(f"{k:18s}: {statistics.median(v)*1000:8.1f} ms")
+    # how much of kernel+transfer is the device fixpoint itself?
+    it = graph.kernel.iterations(q_arr, n_words, graph.dev_main,
+                                 graph.dev_aux, graph.dev_cav
+                                 if graph.kernel.planes else None)
+    print("while_loop trips:", it)
+    print("packed transfer bytes:", packed.nbytes)
+
+
+if __name__ == "__main__":
+    main()

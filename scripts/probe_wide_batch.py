@@ -1,0 +1,86 @@
+"""Probe: production multitenant-1m graph, W=8 vs W=128 packed batches.
+
+Measures lookup_resources_batch wall time for
+  (a) 256 distinct subjects at today's W=8 bucket,
+  (b) the same 256 subjects with SPICEDB_TPU_MIN_BATCH_WORDS=128 (padded
+      columns — does widening cost anything?),
+  (c) 4096 distinct subjects at W=128 (real demand filling the columns).
+
+Run on the real TPU:  python scripts/probe_wide_batch.py
+"""
+
+import asyncio
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+from spicedb_kubeapi_proxy_tpu.ops import jax_endpoint as je
+from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+ROUNDS = 4
+
+
+def timed(ep, workload, subjects, label):
+    async def go():
+        t0 = time.time()
+        out = await ep.lookup_resources_batch(
+            workload.resource_type, workload.permission, subjects)
+        warm = time.time() - t0
+        times = []
+        for _ in range(ROUNDS):
+            t0 = time.time()
+            await ep.lookup_resources_batch(
+                workload.resource_type, workload.permission, subjects)
+            times.append(time.time() - t0)
+        med = statistics.median(times)
+        n_obj = len(ep.store.object_ids_of_type(workload.resource_type))
+        print(f"{label}: warm {warm:.1f}s, median {med*1000:.1f} ms, "
+              f"{len(subjects)*n_obj/med/1e6:.1f}M checks/s "
+              f"(sizes sample {[len(x) for x in out[:3]]})")
+        return med
+
+    return asyncio.run(go())
+
+
+def main():
+    workload = wl.multitenant_1m()
+    schema = sch.parse_schema(workload.schema_text)
+    ep = JaxEndpoint(schema)
+    t0 = time.time()
+    ep.store.bulk_load_text("\n".join(workload.relationships))
+    print(f"loaded {len(workload.relationships)} rels in "
+          f"{time.time()-t0:.1f}s")
+
+    subs256 = [SubjectRef("user", s) for s in workload.subjects[:256]]
+    subs4096 = [SubjectRef("user", s) for s in workload.subjects[:4096]]
+    assert len({s.id for s in subs4096}) == 4096, "need distinct subjects"
+
+    os.environ["SPICEDB_TPU_MIN_BATCH_WORDS"] = "1"
+    t8 = timed(ep, workload, subs256, "W=8   batch=256 ")
+
+    os.environ["SPICEDB_TPU_MIN_BATCH_WORDS"] = "128"
+    t128p = timed(ep, workload, subs256, "W=128 batch=256 (padded)")
+    t128f = timed(ep, workload, subs4096, "W=128 batch=4096")
+
+    os.environ["SPICEDB_TPU_MIN_BATCH_WORDS"] = "32"
+    t32 = timed(ep, workload, subs256, "W=32  batch=256 (padded)")
+    t32f = timed(ep, workload,
+                 [SubjectRef("user", s) for s in workload.subjects[:1024]],
+                 "W=32  batch=1024")
+
+    print("\nwiden-penalty (W=128 padded / W=8):", round(t128p / t8, 2))
+    print("throughput ratio (4096@W128 vs 256@W8):",
+          round((t8 / t128f) * 16, 1), "x")
+    print("throughput ratio (1024@W32 vs 256@W8):",
+          round((t8 / t32f) * 4, 1), "x")
+    print("stats:", ep.stats)
+
+
+if __name__ == "__main__":
+    main()

@@ -454,22 +454,29 @@ class EllKernelCache:
             return np.asarray(out).astype(np.int8)
         return np.asarray(run_checks(*args)) != 0
 
+    def lookup_packed(self, slot_offset: int, slot_length: int,
+                      q_idx: np.ndarray, n_words: int, idx_main, idx_aux,
+                      idx_cav=None) -> np.ndarray:
+        """Packed uint32 [slot_length, n_words] allowed words (bit b of
+        word w is query column w*32+b; DEFINITE plane when planes are
+        active).  The packed form is what the device computes and what the
+        host should consume: per-column extraction is a shift/AND/nonzero
+        over one word column, 32x less memory traffic than a bool bitmap."""
+        _, run_lookup = self._fns(n_words)
+        if self.planes:
+            return np.ascontiguousarray(
+                run_lookup(slot_offset, slot_length,
+                           jnp.asarray(q_idx), idx_main, idx_aux, idx_cav))
+        return np.ascontiguousarray(
+            run_lookup(slot_offset, slot_length,
+                       jnp.asarray(q_idx), idx_main, idx_aux))
+
     def lookup(self, slot_offset: int, slot_length: int, q_idx: np.ndarray,
                n_words: int, idx_main, idx_aux, idx_cav=None) -> np.ndarray:
         """bool [slot_length, n_words*32] allowed bitmap (columns beyond the
-        real batch are padding; DEFINITE plane when planes are active).
-        The device returns packed uint32 words; unpacking happens host-side
-        with np.unpackbits (the packed transfer is 32x smaller, and
-        transfer bandwidth — not compute — dominates)."""
-        _, run_lookup = self._fns(n_words)
-        if self.planes:
-            packed = np.ascontiguousarray(
-                run_lookup(slot_offset, slot_length,
-                           jnp.asarray(q_idx), idx_main, idx_aux, idx_cav))
-        else:
-            packed = np.ascontiguousarray(
-                run_lookup(slot_offset, slot_length,
-                           jnp.asarray(q_idx), idx_main, idx_aux))
+        real batch are padding; DEFINITE plane when planes are active)."""
+        packed = self.lookup_packed(slot_offset, slot_length, q_idx, n_words,
+                                    idx_main, idx_aux, idx_cav)
         # uint32 little-endian: bit b of word w lands at column w*32 + b
         return np.unpackbits(packed.view(np.uint8).reshape(slot_length, -1),
                              axis=1, bitorder="little").astype(bool)

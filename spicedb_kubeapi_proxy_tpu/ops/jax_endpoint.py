@@ -71,6 +71,15 @@ from .spmv import KernelCache, bucket, pad_edges
 _MIN_EDGE_BUCKET = 256
 _MIN_BATCH_BUCKET = 8
 
+
+def _min_batch_words() -> int:
+    """Floor for the packed batch width (env-tunable, read per call so
+    tests can flip it)."""
+    try:
+        return max(1, int(os.environ.get("SPICEDB_TPU_MIN_BATCH_WORDS", "1")))
+    except ValueError:
+        return 1
+
 # One synthetic zero-tuple subject per type is compiled into every graph:
 # a subject that appears in no tuple can differ from any other zero-tuple
 # subject of its type only through wildcard terms, which key on the subject
@@ -79,6 +88,34 @@ _MIN_BATCH_BUCKET = 8
 # "oracle cliff": multi-second LR per first-contact user).  The id contains
 # NUL, which can never appear in a stored relationship id.
 PHANTOM_ID = "\x00__phantom__"
+
+
+def _object_ids_np(graph, resource_type: str) -> np.ndarray:
+    """Object-dtype numpy view of the program's id list, cached per graph
+    (fancy-indexing materializes allowed-id lists at C speed instead of a
+    Python loop per id)."""
+    cache = getattr(graph, "_ids_np_cache", None)
+    if cache is None:
+        cache = graph._ids_np_cache = {}
+    arr = cache.get(resource_type)
+    if arr is None:
+        arr = cache[resource_type] = np.asarray(
+            graph.prog.object_ids[resource_type], dtype=object)
+    return arr
+
+
+def _word_col_indices(wcol: np.ndarray, bit: int) -> np.ndarray:
+    """Allowed slot indices from one packed uint32 word column (bit b of
+    word w = query column w*32+b) — no bool bitmap, no 51MB transpose."""
+    return np.nonzero((wcol >> np.uint32(bit)) & np.uint32(1))[0]
+
+
+def _ids_for(ids: np.ndarray, idx: np.ndarray, ph) -> list:
+    """Materialize an allowed-id list, dropping the phantom column's
+    reserved id (part of every type's universe, never emitted)."""
+    if ph is not None:
+        idx = idx[idx != ph]
+    return ids[idx].tolist()
 
 
 def _rel_from_key(key: tuple) -> Relationship:
@@ -415,7 +452,14 @@ class _EllGraph:
     # -- queries ------------------------------------------------------------
 
     def batch_bucket(self, n: int) -> int:
-        return batch_words(n) * 32
+        # SPICEDB_TPU_MIN_BATCH_WORDS floors the packed word width — an
+        # experiment knob, default off.  Measured on v5e
+        # (scripts/probe_wide_batch.py): on the production multitenant-1m
+        # graph the iteration cost is bandwidth-proportional in W, so
+        # widening is a wash (uniform-random gathers DO scalarize at W=8
+        # per probe_gather_layout.py, but real graphs' index locality
+        # avoids that cliff) — keep W at demand size.
+        return batch_words(n, _min_batch_words()) * 32
 
     def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
         out = self.run_checks3(q_arr, gather_idx, gather_col)
@@ -439,6 +483,12 @@ class _EllGraph:
         n_words = max(1, len(q_arr) // 32)
         return self.kernel.lookup(offset, length, q_arr, n_words,
                                   self.dev_main, self.dev_aux, self.dev_cav)
+
+    def run_lookup_packed(self, offset: int, length: int, q_arr) -> np.ndarray:
+        n_words = max(1, len(q_arr) // 32)
+        return self.kernel.lookup_packed(offset, length, q_arr, n_words,
+                                         self.dev_main, self.dev_aux,
+                                         self.dev_cav)
 
 
 class _ShardedEllGraph(_EllGraph):
@@ -502,7 +552,10 @@ class _ShardedEllGraph(_EllGraph):
         return changed
 
     def batch_bucket(self, n: int) -> int:
-        return self.kernel.padded_batch_words(n) * 32
+        # honor the SPICEDB_TPU_MIN_BATCH_WORDS floor here too (the kernel
+        # then rounds up to whole words per data-axis shard)
+        return self.kernel.padded_batch_words(
+            max(n, _min_batch_words() * 32)) * 32
 
     def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
         out = self.kernel.checks(np.asarray(q_arr, np.int32),
@@ -520,6 +573,10 @@ class _ShardedEllGraph(_EllGraph):
 
     def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
         return self.kernel.lookup(offset, length, np.asarray(q_arr, np.int32))
+
+    def run_lookup_packed(self, offset: int, length: int, q_arr) -> np.ndarray:
+        return self.kernel.lookup_packed(offset, length,
+                                         np.asarray(q_arr, np.int32))
 
 
 _GRAPH_KINDS = {"ell": _EllGraph, "segment": _SegmentGraph}
@@ -1005,12 +1062,17 @@ class JaxEndpoint(PermissionsEndpoint):
                 return self._oracle.lookup_resources(resource_type, permission,
                                                      subject)
             col = cols[subject]
-            bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
+            if hasattr(graph, "run_lookup_packed"):
+                packed = graph.run_lookup_packed(rng[0], rng[1], q_arr)
+                idx = _word_col_indices(
+                    np.ascontiguousarray(packed[:, col // 32]), col % 32)
+            else:
+                bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
+                idx = np.nonzero(bitmap[:, col])[0]
             self.stats["kernel_calls"] += 1
-            ids = graph.prog.object_ids[resource_type]
-            # the phantom is part of every type's universe; never emit it
+            ids = _object_ids_np(graph, resource_type)
             ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
-        return [ids[i] for i in np.nonzero(bitmap[:, col])[0] if i != ph]
+        return _ids_for(ids, idx, ph)
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
@@ -1047,15 +1109,24 @@ class JaxEndpoint(PermissionsEndpoint):
                 return [self._oracle.lookup_resources(resource_type, permission, s)
                         for s in subjects]
             q_arr, cols, unknown = self._encode_subjects(graph, subjects)
-            bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
+            if hasattr(graph, "run_lookup_packed"):
+                # packed fast path: per-column shift/AND/nonzero over one
+                # uint32 word column — never materializes the 32x larger
+                # bool bitmap or its [B, L] transpose
+                packed = graph.run_lookup_packed(rng[0], rng[1], q_arr)
+                packed_T = np.ascontiguousarray(packed.T)  # [W, L], small
+
+                def col_indices(col):
+                    return _word_col_indices(packed_T[col // 32], col % 32)
+            else:
+                bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
+
+                def col_indices(col):
+                    return np.nonzero(bitmap[:, col])[0]
+
             self.stats["kernel_calls"] += 1
-            ids = graph.prog.object_ids[resource_type]
+            ids = _object_ids_np(graph, resource_type)
             ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
-            # one pass over the transposed bitmap groups allowed object
-            # indices by query column (vs a nonzero() per subject)
-            by_col, obj = np.nonzero(np.ascontiguousarray(bitmap.T))
-            splits = np.searchsorted(by_col, np.arange(1, len(cols) + 1))
-            per_col = np.split(obj, splits[:-1]) if len(cols) else []
             per_col_ids: dict = {}  # column -> id list (columns are shared)
             out = []
             for s in subjects:
@@ -1066,8 +1137,8 @@ class JaxEndpoint(PermissionsEndpoint):
                 col = cols[s]
                 lst = per_col_ids.get(col)
                 if lst is None:
-                    lst = per_col_ids[col] = \
-                        [ids[i] for i in per_col[col] if i != ph]
+                    lst = per_col_ids[col] = _ids_for(
+                        ids, col_indices(col), ph)
                 out.append(lst)
         return out
 

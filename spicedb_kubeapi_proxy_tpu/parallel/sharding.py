@@ -476,15 +476,22 @@ class ShardedEllKernel:
             return (self.idx_main, self.idx_aux, self.idx_cav)
         return (self.idx_main, self.idx_aux)
 
+    def lookup_packed(self, slot_offset: int, slot_length: int,
+                      q_idx: np.ndarray) -> np.ndarray:
+        """Packed uint32 [slot_length, padded_words] allowed words (bit b
+        of word w is query column w*32+b; DEFINITE plane under the
+        tri-state path).  Columns past len(q_idx) are padding."""
+        run_lookup, _ = self._fns()
+        q = jax.device_put(self._pad_q(np.asarray(q_idx, np.int32)),
+                           NamedSharding(self.mesh, P("data")))
+        return np.ascontiguousarray(
+            run_lookup(slot_offset, slot_length, q, *self._table_args()))
+
     def lookup(self, slot_offset: int, slot_length: int,
                q_idx: np.ndarray) -> np.ndarray:
         """bool [slot_length, B] allowed bitmap over the real batch
         (DEFINITE plane under the tri-state path)."""
-        run_lookup, _ = self._fns()
-        q = jax.device_put(self._pad_q(np.asarray(q_idx, np.int32)),
-                           NamedSharding(self.mesh, P("data")))
-        packed = np.ascontiguousarray(
-            run_lookup(slot_offset, slot_length, q, *self._table_args()))
+        packed = self.lookup_packed(slot_offset, slot_length, q_idx)
         bits = np.unpackbits(packed.view(np.uint8).reshape(slot_length, -1),
                              axis=1, bitorder="little").astype(bool)
         return bits[:, : len(q_idx)]
