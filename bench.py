@@ -391,7 +391,6 @@ def roofline_probe(ep, workload, batch: int) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
-    from spicedb_kubeapi_proxy_tpu.ops.ell import K_AUX, K_CAV, K_MAIN
     from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
 
     with ep._lock:
@@ -428,12 +427,15 @@ def roofline_probe(ep, workload, batch: int) -> dict:
     out = run_lookup(*args)
     out.block_until_ready()
     t1 = time.perf_counter()
+    # production extraction path: packed transpose + per-column word ops
+    # (ops/jax_endpoint._lookup_batch_sync)
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import (
+        _object_ids_np, _word_col_indices)
     packed = np.ascontiguousarray(out)
-    bitmap = np.unpackbits(packed.view(np.uint8).reshape(rng_slot[1], -1),
-                           axis=1, bitorder="little").astype(bool)
+    packed_T = np.ascontiguousarray(packed.T)
     t2 = time.perf_counter()
-    ids = prog.object_ids[workload.resource_type]
-    _ = [[ids[i] for i in np.nonzero(bitmap[:, c])[0]]
+    ids_np = _object_ids_np(graph, workload.resource_type)
+    _ = [ids_np[_word_col_indices(packed_T[c // 32], c % 32)].tolist()
          for c in range(min(len(cols), 8))]  # sample of id materialization
     t3 = time.perf_counter()
 
@@ -442,13 +444,17 @@ def roofline_probe(ep, workload, batch: int) -> dict:
     n = prog.state_size
     a = graph.dev_aux.shape[0]
     nt = n + a
+    # fanin widths from the ACTUAL tables (K layout is env-tunable)
+    k_main = int(graph.dev_main.shape[1])
+    k_aux = int(graph.dev_aux.shape[1])
+    k_cav = int(graph.dev_cav.shape[1]) if kern.planes else 0
     w_total = 2 * n_words if kern.planes else n_words
     state_bytes = nt * w_total * 4
-    gather_bytes = 4 * w_total * (n * (K_MAIN + 1) + a * (K_AUX + 1))
+    gather_bytes = 4 * w_total * (n * (k_main + 1) + a * (k_aux + 1))
     if kern.planes:
-        gather_bytes += 4 * w_total * nt * (K_CAV + 1)
-    table_bytes = 4 * (n * K_MAIN + a * K_AUX
-                       + (nt * K_CAV if kern.planes else 0))
+        gather_bytes += 4 * w_total * nt * (k_cav + 1)
+    table_bytes = 4 * (n * k_main + a * k_aux
+                       + (nt * k_cav if kern.planes else 0))
     per_iter = gather_bytes + 2 * state_bytes + table_bytes
     device_s = t1 - t0
     total_bytes = per_iter * max(iters, 1)
@@ -483,7 +489,7 @@ def roofline_probe(ep, workload, batch: int) -> dict:
         "dispatch_rtt_ms": round(rtt * 1e3, 3),
         "kernel_compute_ms": round(compute_s * 1e3, 3),
         "timing_basis": timing_basis,
-        "transfer_unpack_ms": round((t2 - t1) * 1e3, 3),
+        "transfer_transpose_ms": round((t2 - t1) * 1e3, 3),
         "id_materialize_sample_ms": round((t3 - t2) * 1e3, 3),
         "modeled_achieved_hbm_gbps": (round(achieved, 2)
                                       if achieved is not None else None),
@@ -717,7 +723,7 @@ def main() -> None:
                                                    args.batch)
             payload["latency_breakdown_ms"].update({
                 k: payload["roofline"][k]
-                for k in ("device_time_ms", "transfer_unpack_ms",
+                for k in ("device_time_ms", "transfer_transpose_ms",
                           "id_materialize_sample_ms")
                 if k in payload["roofline"]})
             log(f"roofline: {payload['roofline']}")

@@ -35,6 +35,7 @@ its position; padding slots in both tables point at it.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Optional
 
@@ -51,9 +52,14 @@ from .graph_compile import (
     PZero,
 )
 
-# Main-table fanin: rows with more in-edges are tree-split.  8 int32 = one
-# 32-byte row; small enough that mostly-degree-1 graphs don't blow memory.
-K_MAIN = 8
+# Main-table fanin: rows with more in-edges are tree-split.  Production
+# graphs are extremely fanin-skewed (multitenant-1m: 62% of rows fanin 0,
+# 38% fanin 1, 0.2% more), so narrow main rows win: each main slot costs
+# a full [NT]-row gather per iteration, while tree-split hubs ride the
+# tiny aux table.  K=2 keeps one spare slot on the common fanin-1 row for
+# incremental inserts (a full row grows an aux node from the spare pool,
+# see _EllGraph.add_rel).  Env-tunable for experiments.
+K_MAIN = int(os.environ.get("SPICEDB_TPU_K_MAIN", "2"))
 # Aux-node fanin: wider is better for hubs (fewer tree levels).
 K_AUX = 32
 # Caveat (MAYBE-plane) table fanin; caveated tuples are typically sparse,
@@ -79,18 +85,24 @@ class EllTables:
     idx_main: np.ndarray                 # int32 [state_size, K_MAIN]
     idx_aux: np.ndarray                  # int32 [n_aux, K_AUX]
     tree_depth: int                      # max OR-tree levels over all hubs
+    # trailing all-dead aux rows reserved for incremental growth: a delta
+    # insert hitting a full main row moves the row's children into one of
+    # these and gains an OR-tree level instead of forcing a rebuild
+    spare_rows: tuple = ()               # aux-table row numbers
 
 
-def build_tables(prog: GraphProgram) -> EllTables:
+def build_tables(prog: GraphProgram,
+                 k_main: Optional[int] = None) -> EllTables:
     """Group the program's (src, dst) edge list destination-major into
     fixed-fanin tables, tree-splitting hubs.
 
     Vectorized: one stable sort by destination, then per-slot scatter for
     the (overwhelmingly common) small rows; only hub destinations fall to
     a Python loop."""
+    km = k_main if k_main is not None else K_MAIN
     n = prog.state_size
     dead = prog.dead_index
-    idx_main = np.full((n, K_MAIN), dead, np.int32)
+    idx_main = np.full((n, km), dead, np.int32)
     aux_rows: list[np.ndarray] = []
     tree_depth = 0
     e = len(prog.edge_src)
@@ -104,7 +116,7 @@ def build_tables(prog: GraphProgram) -> EllTables:
         gdst = sdst[starts]
         # rank of each edge within its destination group
         rank = np.arange(e) - np.repeat(starts, counts)
-        small = counts <= K_MAIN
+        small = counts <= km
         small_edges = np.repeat(small, counts)
         idx_main[sdst[small_edges], rank[small_edges]] = ssrc[small_edges]
 
@@ -118,7 +130,7 @@ def build_tables(prog: GraphProgram) -> EllTables:
             lo = int(starts[g])
             children = ssrc[lo: lo + int(counts[g])]
             depth = 0
-            while len(children) > K_MAIN:
+            while len(children) > km:
                 children = np.asarray(
                     [new_aux(children[i: i + K_AUX])
                      for i in range(0, len(children), K_AUX)], np.int32)
@@ -130,8 +142,19 @@ def build_tables(prog: GraphProgram) -> EllTables:
         idx_aux = np.stack(aux_rows).astype(np.int32)
     else:
         idx_aux = np.full((0, K_AUX), dead, np.int32)
+    # spare pool sized to the graph; hub-free graphs keep an empty aux
+    # table (no per-iteration aux gather at all) and fall back to the
+    # rebuild path on their rare full-row inserts
+    if aux_rows:
+        n_spare = max(64, len(aux_rows) // 4)
+        spare0 = idx_aux.shape[0]
+        idx_aux = np.vstack([idx_aux,
+                             np.full((n_spare, K_AUX), dead, np.int32)])
+        spares = tuple(range(spare0, spare0 + n_spare))
+    else:
+        spares = ()
     return EllTables(idx_main=idx_main, idx_aux=idx_aux,
-                     tree_depth=tree_depth)
+                     tree_depth=tree_depth, spare_rows=spares)
 
 
 @dataclass
@@ -240,13 +263,15 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int,
 
     def step(x, x0, idx_main, idx_aux, idx_cav=None):
         # one-step closure: K gathers + OR per table, concatenated in row
-        # order (main rows first, aux rows after) — no scatter anywhere
+        # order (main rows first, aux rows after) — no scatter anywhere.
+        # Fanin widths come from the table shapes (trace-time constants),
+        # so one step fn serves any K layout.
         y_main = x[idx_main[:, 0]]
-        for k in range(1, K_MAIN):
+        for k in range(1, idx_main.shape[1]):
             y_main = y_main | x[idx_main[:, k]]
         if n_aux_rows:
             y_aux = x[idx_aux[:, 0]]
-            for k in range(1, K_AUX):
+            for k in range(1, idx_aux.shape[1]):
                 y_aux = y_aux | x[idx_aux[:, k]]
             y = jnp.concatenate([y_main, y_aux], axis=0)
         else:
@@ -256,7 +281,7 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int,
             # closure and OR it into the maybe half (definite half is
             # untouched — an undecided caveat can never DEFINITELY grant)
             extra = x[idx_cav[:, 0]]
-            for k in range(1, K_CAV):
+            for k in range(1, idx_cav.shape[1]):
                 extra = extra | x[idx_cav[:, k]]
             y = jnp.concatenate([y[:, :half], y[:, half:] | extra[:, half:]],
                                 axis=1)

@@ -297,6 +297,7 @@ class _EllGraph:
             self.dev_cav = None
         self.host_main = t.idx_main
         self.host_aux = t.idx_aux
+        self._spare_aux = list(t.spare_rows)
         self.dev_main = jnp.asarray(t.idx_main)
         self.dev_aux = jnp.asarray(t.idx_aux)
         self.kernel = EllKernelCache(prog, n_aux_rows=t.idx_aux.shape[0],
@@ -357,6 +358,30 @@ class _EllGraph:
             return True
         return self._remove_pairs(pairs)
 
+    def _grow(self, root_row: int, src: int) -> bool:
+        """Full main row (no dead slot anywhere in its tree): move the
+        row's direct entries into a spare aux node, append `src` there,
+        and point the row at the node — one extra OR-tree level for this
+        destination, no rebuild.  Monotone OR gates make this exactly
+        equivalent; the kernel's iteration cap (50x(1+tree_depth)) has
+        ample headroom for the few growth events between rebuilds."""
+        if not self._spare_aux:
+            return False
+        row = self.host_main[root_row].copy()
+        if len(row) + 1 > self.host_aux.shape[1]:
+            # K_MAIN tuned >= K_AUX: the row's children + the new source
+            # don't fit one aux node — fall back to the rebuild path
+            return False
+        j = self._spare_aux.pop()
+        n = self.prog.state_size
+        self.host_aux[j, : len(row)] = row
+        self.host_aux[j, len(row)] = src
+        self._dirty_aux.add(j)
+        self.host_main[root_row, 0] = n + j
+        self.host_main[root_row, 1:] = self.prog.dead_index
+        self._dirty_main.add(root_row)
+        return True
+
     def add_rel(self, rel: Relationship) -> bool:
         pairs = self._edge_endpoints(self.prog, rel)
         if pairs is None:
@@ -367,7 +392,9 @@ class _EllGraph:
                 continue  # edge already present (re-touch)
             loc = self._walk(d, dead)
             if loc is None:
-                return False  # row and tree full: rebuild grows a level
+                if not self._grow(d, s):
+                    return False  # spare pool dry: rebuild grows a level
+                continue
             self._set(loc, s)
         return True
 
@@ -528,6 +555,7 @@ class _ShardedEllGraph(_EllGraph):
         self.supports_cav_deltas = True
         self.host_cav = self.kernel.host_cav_compile
         self._cav_aux_base = prog.state_size + self.kernel.n_aux_shared
+        self._spare_aux = list(t.spare_rows)
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
         self._dirty_cav: set = set()

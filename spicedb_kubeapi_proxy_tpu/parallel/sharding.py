@@ -213,7 +213,7 @@ class ShardedEllKernel:
 
     def __init__(self, prog: GraphProgram, mesh: Mesh,
                  num_iters: Optional[int] = None, tables=None):
-        from ..ops.ell import K_AUX, K_CAV, K_MAIN, build_cav_tables, build_tables
+        from ..ops.ell import K_AUX, build_cav_tables, build_tables
         from ..ops.ell import MAX_ITERATIONS as ELL_MAX
 
         self.prog = prog
@@ -246,9 +246,9 @@ class ShardedEllKernel:
             tree_depth = max(tree_depth, cav.tree_depth)
         self.n_pad = _ceil_mult(n, n_graph)
         self.a_pad = _ceil_mult(max(a, 1), n_graph)
-        main = np.full((self.n_pad, K_MAIN), dead, np.int32)
+        main = np.full((self.n_pad, t.idx_main.shape[1]), dead, np.int32)
         main[:n] = t.idx_main
-        aux = np.full((self.a_pad, K_AUX), dead, np.int32)
+        aux = np.full((self.a_pad, t.idx_aux.shape[1]), dead, np.int32)
         aux[:a] = t.idx_aux
         if self.n_pad != n:
             # remap aux references past the padded main block
@@ -263,8 +263,9 @@ class ShardedEllKernel:
         if self.planes:
             # reindex the cav table from compile row space ([0,n) main +
             # [n, n+a) aux) to the padded device row space, values incl.
-            cav_dev = np.full((self.n_pad + self.a_pad, K_CAV), dead,
-                              np.int32)
+            cav_dev = np.full(
+                (self.n_pad + self.a_pad, cav.idx_cav.shape[1]), dead,
+                np.int32)
             cav_dev[:n] = cav.idx_cav[:n]
             cav_dev[self.n_pad: self.n_pad + (cav.idx_cav.shape[0] - n)] = \
                 cav.idx_cav[n:]
@@ -309,7 +310,7 @@ class ShardedEllKernel:
     # -- the sharded program -------------------------------------------------
 
     def _evaluate_shard_fn(self):
-        from ..ops.ell import K_AUX, K_CAV, K_MAIN, _apply_perm_expr_packed
+        from ..ops.ell import _apply_perm_expr_packed
 
         prog = self.prog
         n_pad = self.n_pad
@@ -344,10 +345,10 @@ class ShardedEllKernel:
             def step(x_main, x_aux):
                 x = jnp.concatenate([x_main, x_aux], axis=0)
                 y_main_l = x[main_local[:, 0]]
-                for k in range(1, K_MAIN):
+                for k in range(1, main_local.shape[1]):
                     y_main_l = y_main_l | x[main_local[:, k]]
                 y_aux_l = x[aux_local[:, 0]]
-                for k in range(1, K_AUX):
+                for k in range(1, aux_local.shape[1]):
                     y_aux_l = y_aux_l | x[aux_local[:, k]]
                 # reassemble row blocks across the graph axis (tiled ICI
                 # all-gather; payload is rows x local words [x planes])
@@ -360,7 +361,7 @@ class ShardedEllKernel:
                     # plane only — slice the plane BEFORE the all_gather
                     # so only maybe-plane words cross ICI
                     y_cav_l = x[cav_local[:, 0], :, 1]
-                    for k in range(1, K_CAV):
+                    for k in range(1, cav_local.shape[1]):
                         y_cav_l = y_cav_l | x[cav_local[:, k], :, 1]
                     y_cav = jax.lax.all_gather(y_cav_l, "graph", axis=0,
                                                tiled=True)

@@ -240,6 +240,33 @@ class TestIncrementalDeltas:
         assert jx.stats["rebuilds"] == rebuilds_before, \
             "delete+readd of known ids must not rebuild"
 
+    def test_full_row_insert_grows_aux_without_rebuild(self, kernel_kind):
+        """K_MAIN=2 layout: the 3rd..Nth viewer on one namespace overflows
+        the main row; add_rel must grow an OR-tree level from the spare
+        aux pool instead of rebuilding (ell kernel only — the segment
+        kernel has positional slack instead).  A hub seeds the aux table
+        so the spare pool exists (hub-free graphs rebuild instead)."""
+        rels = ["namespace:ns#viewer@user:u0"]
+        # every id must be in the compiled universe, so pre-seed the users
+        # on a throwaway namespace — enough of them that the seed row is a
+        # hub (aux table + spare pool present)
+        rels += [f"namespace:seed#viewer@user:u{i}" for i in range(1, 40)]
+        jx, oracle = make_pair(GROUPS_SCHEMA, rels)
+        assert_agreement(jx, oracle, "namespace", "view",
+                         users(*[f"u{i}" for i in range(12)]))
+        rebuilds_before = jx.stats["rebuilds"]
+        for i in range(1, 12):
+            jx.store.write(touch(f"namespace:ns#viewer@user:u{i}"))
+        assert_agreement(jx, oracle, "namespace", "view",
+                         users(*[f"u{i}" for i in range(12)]))
+        if kernel_kind == "ell":
+            assert jx.stats["rebuilds"] == rebuilds_before, \
+                "full-row inserts must grow aux nodes, not rebuild"
+            # removal after growth still works through the grown tree
+            jx.store.write(delete("namespace:ns#viewer@user:u3"))
+            assert_agreement(jx, oracle, "namespace", "view",
+                             users(*[f"u{i}" for i in range(12)]))
+
     def test_new_object_forces_rebuild(self):
         jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns1#viewer@user:alice"])
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
