@@ -306,6 +306,27 @@ def create_endpoint(url: str,
         return EmbeddedEndpoint.from_bootstrap(bootstrap)
     if scheme == "jax":
         from ..ops.jax_endpoint import JaxEndpoint  # lazy: pulls in jax
+        # multi-host: `jax://?distributed=1` joins the jax.distributed
+        # cluster named by the SPICEDB_TPU_COORDINATOR/NUM_PROCESSES/
+        # PROCESS_ID env triplet (auto-detected on TPU pod slices) BEFORE
+        # any mesh is built, so jax.devices() below is the global set and
+        # the graph axis stripes across hosts over DCN.  `distributed=1`
+        # is strict (an authz proxy must not silently fall back to a
+        # partial device set); `distributed=auto` is best-effort so one
+        # config spans single-host and pod deployments.
+        dist_param = (params.get("distributed") or ["0"])[0].lower()
+        if dist_param in ("1", "true", "yes", "auto"):
+            from ..parallel.distributed import init_from_env
+            try:
+                init_from_env(strict=dist_param != "auto")
+            except Exception as e:
+                raise EndpointConfigError(
+                    f"distributed={dist_param} in {url!r}: jax.distributed "
+                    f"initialization failed: {e}") from e
+        elif dist_param not in ("0", "false", "no", ""):
+            raise EndpointConfigError(
+                f"invalid distributed={dist_param!r} in {url!r} "
+                f"(expected 1/true/yes/auto/0/false/no)")
         # multi-chip: `jax://?mesh=auto` shards the graph over all local
         # devices (2D data x graph mesh); `mesh=DxG` fixes the axis split.
         # Single-device processes fall back to the single-chip kernels.

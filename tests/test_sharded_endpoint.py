@@ -8,6 +8,7 @@ reference's dispatch-distributed graph walk (pkg/spicedb/spicedb.go:31-47).
 """
 
 import asyncio
+import os
 import time
 
 import pytest
@@ -188,3 +189,80 @@ class TestShardedEndpoint:
                 assert sorted(g) == sorted(oracle.lookup_resources(
                     "namespace", "view", s))
         asyncio.run(run())
+
+
+class TestDistributedGlue:
+    """Multi-host jax.distributed glue (parallel/distributed.py)."""
+
+    def test_partial_env_config_rejected(self, monkeypatch):
+        from spicedb_kubeapi_proxy_tpu.parallel import distributed as dist
+        monkeypatch.setattr(dist, "_runtime_initialized", lambda: False)
+        monkeypatch.setenv("SPICEDB_TPU_COORDINATOR", "127.0.0.1:9999")
+        monkeypatch.delenv("SPICEDB_TPU_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("SPICEDB_TPU_PROCESS_ID", raising=False)
+        with pytest.raises(ValueError, match="partial multi-host config"):
+            dist.init_from_env()
+
+    def test_idempotent_when_runtime_already_up(self, monkeypatch):
+        from spicedb_kubeapi_proxy_tpu.parallel import distributed as dist
+        monkeypatch.setattr(dist, "_runtime_initialized", lambda: True)
+        assert dist.init_from_env() is True  # no runtime touch
+
+    def test_endpoint_param_triggers_strict_init(self, monkeypatch):
+        from spicedb_kubeapi_proxy_tpu.parallel import distributed as dist
+        from spicedb_kubeapi_proxy_tpu.spicedb import endpoints as eps
+        calls = []
+        monkeypatch.setattr(dist, "init_from_env",
+                            lambda *a, **k: calls.append(k) or True)
+        eps.create_endpoint("jax://?distributed=1&dispatch=direct", None)
+        assert calls == [{"strict": True}]
+        calls.clear()
+        eps.create_endpoint("jax://?distributed=auto&dispatch=direct", None)
+        assert calls == [{"strict": False}]
+
+    def test_endpoint_param_off_and_invalid(self, monkeypatch):
+        from spicedb_kubeapi_proxy_tpu.parallel import distributed as dist
+        from spicedb_kubeapi_proxy_tpu.spicedb import endpoints as eps
+        monkeypatch.setattr(
+            dist, "init_from_env",
+            lambda *a, **k: (_ for _ in ()).throw(AssertionError("called")))
+        eps.create_endpoint("jax://?distributed=false&dispatch=direct", None)
+        with pytest.raises(eps.EndpointConfigError, match="invalid distributed"):
+            eps.create_endpoint("jax://?distributed=bogus&dispatch=direct",
+                                None)
+
+    def test_strict_init_failure_is_config_error(self, monkeypatch):
+        from spicedb_kubeapi_proxy_tpu.parallel import distributed as dist
+        from spicedb_kubeapi_proxy_tpu.spicedb import endpoints as eps
+
+        def boom(*a, **k):
+            raise RuntimeError("no coordinator")
+
+        monkeypatch.setattr(dist, "init_from_env", boom)
+        with pytest.raises(eps.EndpointConfigError,
+                           match="initialization failed"):
+            eps.create_endpoint("jax://?distributed=1&dispatch=direct", None)
+
+    def test_single_process_cluster_initializes(self):
+        """num_processes=1 with an explicit coordinator really spins up
+        the jax.distributed service — in a fresh subprocess, because
+        initialize() must precede any XLA backend use in the process."""
+        import pathlib
+        import subprocess
+        import sys
+        repo = str(pathlib.Path(__file__).resolve().parent.parent)
+        code = (
+            "import socket\n"
+            "from spicedb_kubeapi_proxy_tpu.parallel import distributed\n"
+            "s = socket.socket(); s.bind((\"127.0.0.1\", 0))\n"
+            "port = s.getsockname()[1]; s.close()\n"
+            "assert distributed.init_from_env(\n"
+            "    coordinator=f\"127.0.0.1:{port}\",\n"
+            "    num_processes=1, process_id=0) is True\n"
+            "assert distributed.is_initialized()\n"
+            "print(\"DIST_OK\")\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=repo, capture_output=True,
+            text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert "DIST_OK" in out.stdout, (out.stdout, out.stderr)
