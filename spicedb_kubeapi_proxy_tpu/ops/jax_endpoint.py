@@ -229,21 +229,32 @@ class _SegmentGraph:
     def batch_bucket(self, n: int) -> int:
         return bucket(max(n, 1), _MIN_BATCH_BUCKET)
 
-    def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+    def snapshot(self) -> tuple:
+        """Immutable query view (kernel choice + edge arrays) captured
+        under the endpoint lock; kernel execution then proceeds OUTSIDE
+        the lock on a consistent graph (flush swaps whole arrays, never
+        mutates them)."""
+        return (self._kernel(), self.edge_src, self.edge_dst)
+
+    def run_checks(self, q_arr, gather_idx, gather_col,
+                   snap=None) -> np.ndarray:
+        kern, src, dst = snap if snap is not None else self.snapshot()
         g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
         gi = np.zeros(g, np.int32)
         gc = np.zeros(g, np.int32)
         gi[: len(gather_idx)] = gather_idx
         gc[: len(gather_col)] = gather_col
-        return self._kernel().checks(q_arr, gi, gc, self.edge_src,
-                                     self.edge_dst)
+        return kern.checks(q_arr, gi, gc, src, dst)
 
-    def run_checks3(self, q_arr, gather_idx, gather_col) -> np.ndarray:
-        return np.where(self.run_checks(q_arr, gather_idx, gather_col), 2, 0)
+    def run_checks3(self, q_arr, gather_idx, gather_col,
+                    snap=None) -> np.ndarray:
+        return np.where(
+            self.run_checks(q_arr, gather_idx, gather_col, snap), 2, 0)
 
-    def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
-        return self._kernel().lookup(offset, length, q_arr, self.edge_src,
-                                     self.edge_dst)
+    def run_lookup(self, offset: int, length: int, q_arr,
+                   snap=None) -> np.ndarray:
+        kern, src, dst = snap if snap is not None else self.snapshot()
+        return kern.lookup(offset, length, q_arr, src, dst)
 
     # no MAYBE plane: removals are vacuous, insertions force a rebuild
     def remove_cav_key(self, key: tuple) -> bool:
@@ -488,34 +499,45 @@ class _EllGraph:
         # avoids that cliff) — keep W at demand size.
         return batch_words(n, _min_batch_words()) * 32
 
-    def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
-        out = self.run_checks3(q_arr, gather_idx, gather_col)
+    def snapshot(self) -> tuple:
+        """Immutable query view of the device tables, captured under the
+        endpoint lock so kernel execution can proceed OUTSIDE it (flush
+        swaps whole arrays via .at[].set, never mutates in place)."""
+        return (self.dev_main, self.dev_aux, self.dev_cav)
+
+    def run_checks(self, q_arr, gather_idx, gather_col,
+                   snap=None) -> np.ndarray:
+        out = self.run_checks3(q_arr, gather_idx, gather_col, snap)
         return out == 2
 
-    def run_checks3(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+    def run_checks3(self, q_arr, gather_idx, gather_col,
+                    snap=None) -> np.ndarray:
         """Tri-state check values {0: NO, 1: CONDITIONAL, 2: HAS}."""
+        main, aux, cav = snap if snap is not None else self.snapshot()
         g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
         gi = np.zeros(g, np.int32)
         gc = np.zeros(g, np.int32)
         gi[: len(gather_idx)] = gather_idx
         gc[: len(gather_col)] = gather_col
         n_words = max(1, len(q_arr) // 32)
-        out = self.kernel.checks(q_arr, n_words, gi, gc, self.dev_main,
-                                 self.dev_aux, self.dev_cav)
+        out = self.kernel.checks(q_arr, n_words, gi, gc, main, aux, cav)
         if not self.has_cav:
             return np.where(out, 2, 0)
         return out
 
-    def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
+    def run_lookup(self, offset: int, length: int, q_arr,
+                   snap=None) -> np.ndarray:
+        main, aux, cav = snap if snap is not None else self.snapshot()
         n_words = max(1, len(q_arr) // 32)
         return self.kernel.lookup(offset, length, q_arr, n_words,
-                                  self.dev_main, self.dev_aux, self.dev_cav)
+                                  main, aux, cav)
 
-    def run_lookup_packed(self, offset: int, length: int, q_arr) -> np.ndarray:
+    def run_lookup_packed(self, offset: int, length: int, q_arr,
+                          snap=None) -> np.ndarray:
+        main, aux, cav = snap if snap is not None else self.snapshot()
         n_words = max(1, len(q_arr) // 32)
         return self.kernel.lookup_packed(offset, length, q_arr, n_words,
-                                         self.dev_main, self.dev_aux,
-                                         self.dev_cav)
+                                         main, aux, cav)
 
 
 class _ShardedEllGraph(_EllGraph):
@@ -585,26 +607,36 @@ class _ShardedEllGraph(_EllGraph):
         return self.kernel.padded_batch_words(
             max(n, _min_batch_words() * 32)) * 32
 
-    def run_checks(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+    def snapshot(self) -> tuple:
+        return self.kernel.snapshot_tables()
+
+    def run_checks(self, q_arr, gather_idx, gather_col,
+                   snap=None) -> np.ndarray:
         out = self.kernel.checks(np.asarray(q_arr, np.int32),
                                  np.asarray(gather_idx, np.int32),
-                                 np.asarray(gather_col, np.int64))
+                                 np.asarray(gather_col, np.int64),
+                                 tables=snap)
         return (out == 2) if self.kernel.planes else out
 
-    def run_checks3(self, q_arr, gather_idx, gather_col) -> np.ndarray:
+    def run_checks3(self, q_arr, gather_idx, gather_col,
+                    snap=None) -> np.ndarray:
         out = self.kernel.checks(np.asarray(q_arr, np.int32),
                                  np.asarray(gather_idx, np.int32),
-                                 np.asarray(gather_col, np.int64))
+                                 np.asarray(gather_col, np.int64),
+                                 tables=snap)
         if self.kernel.planes:
             return out
         return np.where(out, 2, 0)
 
-    def run_lookup(self, offset: int, length: int, q_arr) -> np.ndarray:
-        return self.kernel.lookup(offset, length, np.asarray(q_arr, np.int32))
+    def run_lookup(self, offset: int, length: int, q_arr,
+                   snap=None) -> np.ndarray:
+        return self.kernel.lookup(offset, length,
+                                  np.asarray(q_arr, np.int32), tables=snap)
 
-    def run_lookup_packed(self, offset: int, length: int, q_arr) -> np.ndarray:
-        return self.kernel.lookup_packed(offset, length,
-                                         np.asarray(q_arr, np.int32))
+    def run_lookup_packed(self, offset: int, length: int, q_arr,
+                          snap=None) -> np.ndarray:
+        return self.kernel.lookup_packed(
+            offset, length, np.asarray(q_arr, np.int32), tables=snap)
 
 
 _GRAPH_KINDS = {"ell": _EllGraph, "segment": _SegmentGraph}
@@ -1005,12 +1037,8 @@ class JaxEndpoint(PermissionsEndpoint):
             # evaluate the LIVE store, so they carry its revision rather
             # than claiming the graph snapshot's
             results: list[Optional[tuple]] = [None] * len(reqs)
+            oracle_rows: list[int] = []  # positions needing host evaluation
             tri = getattr(graph, "tri_state_capable", False)
-
-            def oracle_row(r):
-                return (self._oracle.check3(r.resource, r.permission,
-                                            r.subject),
-                        self.store.revision)
 
             for i, r in enumerate(reqs):
                 if (not tri and (r.resource.type, r.permission)
@@ -1019,13 +1047,13 @@ class JaxEndpoint(PermissionsEndpoint):
                     # evaluation (pre-round-4 behavior; only the sharded /
                     # segment kernels and unsupported caveat shapes land
                     # here now)
-                    results[i] = oracle_row(r)
+                    oracle_rows.append(i)
                     self.stats["oracle_residual_checks"] += 1
                     continue
                 if r.subject in unknown:
                     # no slot for (type, relation) at all: oracle reproduces
                     # the schema error/edge semantics
-                    results[i] = oracle_row(r)
+                    oracle_rows.append(i)
                     continue
                 state_idx = graph.prog.state_index(
                     r.resource.type, r.permission, r.resource.id)
@@ -1033,7 +1061,7 @@ class JaxEndpoint(PermissionsEndpoint):
                     d = self.schema.definitions.get(r.resource.type)
                     if d is None or not d.has_relation_or_permission(r.permission):
                         # surface schema errors like the oracle does
-                        results[i] = oracle_row(r)
+                        oracle_rows.append(i)
                     else:
                         results[i] = (0, rev)  # unknown object: no tuples
                     continue
@@ -1041,10 +1069,22 @@ class JaxEndpoint(PermissionsEndpoint):
                 gather_col.append(cols[r.subject])
                 kernel_rows.append(i)
             if kernel_rows:
-                out = graph.run_checks3(q_arr, gather_idx, gather_col)
+                snap = graph.snapshot()
                 self.stats["kernel_calls"] += 1
-                for j, row in enumerate(kernel_rows):
-                    results[row] = (int(out[j]), rev)
+        # device execution + host-oracle fallbacks run OUTSIDE the lock:
+        # the snapshot is immutable, so concurrent drains/queries proceed
+        # instead of queueing behind a hundreds-of-ms kernel hold.  Oracle
+        # fallbacks evaluate the LIVE store and carry its revision rather
+        # than claiming the graph snapshot's.
+        if kernel_rows:
+            out = graph.run_checks3(q_arr, gather_idx, gather_col, snap=snap)
+            for j, row in enumerate(kernel_rows):
+                results[row] = (int(out[j]), rev)
+        for i in oracle_rows:
+            r = reqs[i]
+            results[i] = (self._oracle.check3(r.resource, r.permission,
+                                              r.subject),
+                          self.store.revision)
         return [CheckResult(permissionship=self._TRISTATE[v],
                             checked_at=at)
                 for (v, at) in results]
@@ -1071,6 +1111,7 @@ class JaxEndpoint(PermissionsEndpoint):
     def _lookup_sync(self, resource_type: str, permission: str,
                      subject: SubjectRef) -> list:
         self.schema.definition(resource_type)  # raises like the oracle
+        oracle = False
         with self._lock:
             graph = self._current_graph()
             if ((resource_type, permission) in self._caveat_affected
@@ -1079,27 +1120,32 @@ class JaxEndpoint(PermissionsEndpoint):
                 # skips CONDITIONAL results (reference lookups.go:85-88);
                 # plane-capable kernels return the DEFINITE plane, which
                 # skips them by construction
-                return self._oracle.lookup_resources(resource_type,
-                                                     permission, subject)
-            rng = graph.prog.slot_range(resource_type, permission)
-            if rng is None:
-                return self._oracle.lookup_resources(resource_type, permission,
-                                                     subject)
-            q_arr, cols, unknown = self._encode_subjects(graph, [subject])
-            if subject in unknown:
-                return self._oracle.lookup_resources(resource_type, permission,
-                                                     subject)
-            col = cols[subject]
-            if hasattr(graph, "run_lookup_packed"):
-                packed = graph.run_lookup_packed(rng[0], rng[1], q_arr)
-                idx = _word_col_indices(
-                    np.ascontiguousarray(packed[:, col // 32]), col % 32)
+                oracle = True
+            elif (rng := graph.prog.slot_range(resource_type,
+                                               permission)) is None:
+                oracle = True
             else:
-                bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
-                idx = np.nonzero(bitmap[:, col])[0]
-            self.stats["kernel_calls"] += 1
-            ids = _object_ids_np(graph, resource_type)
-            ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
+                q_arr, cols, unknown = self._encode_subjects(graph, [subject])
+                if subject in unknown:
+                    oracle = True
+                else:
+                    col = cols[subject]
+                    snap = graph.snapshot()
+                    self.stats["kernel_calls"] += 1
+        if oracle:
+            # host evaluation outside the lock (reads the live store)
+            return self._oracle.lookup_resources(resource_type, permission,
+                                                 subject)
+        # kernel + extraction outside the lock (immutable snapshot)
+        if hasattr(graph, "run_lookup_packed"):
+            packed = graph.run_lookup_packed(rng[0], rng[1], q_arr, snap=snap)
+            idx = _word_col_indices(
+                np.ascontiguousarray(packed[:, col // 32]), col % 32)
+        else:
+            bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
+            idx = np.nonzero(bitmap[:, col])[0]
+        ids = _object_ids_np(graph, resource_type)
+        ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
         return _ids_for(ids, idx, ph)
 
     async def lookup_resources(self, resource_type: str, permission: str,
@@ -1125,49 +1171,54 @@ class JaxEndpoint(PermissionsEndpoint):
     def _lookup_batch_sync(self, resource_type: str, permission: str,
                            subjects: list) -> list:
         self.schema.definition(resource_type)
+        all_oracle = False
         with self._lock:
             graph = self._current_graph()
             if ((resource_type, permission) in self._caveat_affected
                     and not getattr(graph, "tri_state_capable", False)):
-                return [self._oracle.lookup_resources(resource_type,
-                                                      permission, s)
-                        for s in subjects]
-            rng = graph.prog.slot_range(resource_type, permission)
-            if rng is None:
-                return [self._oracle.lookup_resources(resource_type, permission, s)
-                        for s in subjects]
-            q_arr, cols, unknown = self._encode_subjects(graph, subjects)
-            if hasattr(graph, "run_lookup_packed"):
-                # packed fast path: per-column shift/AND/nonzero over one
-                # uint32 word column — never materializes the 32x larger
-                # bool bitmap or its [B, L] transpose
-                packed = graph.run_lookup_packed(rng[0], rng[1], q_arr)
-                packed_T = np.ascontiguousarray(packed.T)  # [W, L], small
-
-                def col_indices(col):
-                    return _word_col_indices(packed_T[col // 32], col % 32)
+                all_oracle = True
+            elif (rng := graph.prog.slot_range(resource_type,
+                                               permission)) is None:
+                all_oracle = True
             else:
-                bitmap = graph.run_lookup(rng[0], rng[1], q_arr)
+                q_arr, cols, unknown = self._encode_subjects(graph, subjects)
+                snap = graph.snapshot()
+                self.stats["kernel_calls"] += 1
+        if all_oracle:
+            # host evaluation outside the lock (reads the live store)
+            return [self._oracle.lookup_resources(resource_type, permission, s)
+                    for s in subjects]
+        # kernel + extraction outside the lock (immutable snapshot)
+        if hasattr(graph, "run_lookup_packed"):
+            # packed fast path: per-column shift/AND/nonzero over one
+            # uint32 word column — never materializes the 32x larger
+            # bool bitmap or its [B, L] transpose
+            packed = graph.run_lookup_packed(rng[0], rng[1], q_arr, snap=snap)
+            packed_T = np.ascontiguousarray(packed.T)  # [W, L], small
 
-                def col_indices(col):
-                    return np.nonzero(bitmap[:, col])[0]
+            def col_indices(col):
+                return _word_col_indices(packed_T[col // 32], col % 32)
+        else:
+            bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
 
-            self.stats["kernel_calls"] += 1
-            ids = _object_ids_np(graph, resource_type)
-            ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
-            per_col_ids: dict = {}  # column -> id list (columns are shared)
-            out = []
-            for s in subjects:
-                if s in unknown:
-                    out.append(self._oracle.lookup_resources(
-                        resource_type, permission, s))
-                    continue
-                col = cols[s]
-                lst = per_col_ids.get(col)
-                if lst is None:
-                    lst = per_col_ids[col] = _ids_for(
-                        ids, col_indices(col), ph)
-                out.append(lst)
+            def col_indices(col):
+                return np.nonzero(bitmap[:, col])[0]
+
+        ids = _object_ids_np(graph, resource_type)
+        ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
+        per_col_ids: dict = {}  # column -> id list (columns are shared)
+        out = []
+        for s in subjects:
+            if s in unknown:
+                out.append(self._oracle.lookup_resources(
+                    resource_type, permission, s))
+                continue
+            col = cols[s]
+            lst = per_col_ids.get(col)
+            if lst is None:
+                lst = per_col_ids[col] = _ids_for(
+                    ids, col_indices(col), ph)
+            out.append(lst)
         return out
 
     async def lookup_resources_batch(self, resource_type: str, permission: str,

@@ -472,13 +472,20 @@ class ShardedEllKernel:
         out[: len(q_idx)] = q_idx
         return out
 
-    def _table_args(self) -> tuple:
+    def snapshot_tables(self) -> tuple:
+        """Current device tables as an immutable view: incremental updates
+        swap whole arrays (_scatter_rows), so a captured tuple stays
+        internally consistent while queries run outside the endpoint
+        lock."""
         if self.planes:
             return (self.idx_main, self.idx_aux, self.idx_cav)
         return (self.idx_main, self.idx_aux)
 
+    def _table_args(self, tables=None) -> tuple:
+        return tables if tables is not None else self.snapshot_tables()
+
     def lookup_packed(self, slot_offset: int, slot_length: int,
-                      q_idx: np.ndarray) -> np.ndarray:
+                      q_idx: np.ndarray, tables=None) -> np.ndarray:
         """Packed uint32 [slot_length, padded_words] allowed words (bit b
         of word w is query column w*32+b; DEFINITE plane under the
         tri-state path).  Columns past len(q_idx) are padding."""
@@ -486,19 +493,20 @@ class ShardedEllKernel:
         q = jax.device_put(self._pad_q(np.asarray(q_idx, np.int32)),
                            NamedSharding(self.mesh, P("data")))
         return np.ascontiguousarray(
-            run_lookup(slot_offset, slot_length, q, *self._table_args()))
+            run_lookup(slot_offset, slot_length, q,
+                       *self._table_args(tables)))
 
     def lookup(self, slot_offset: int, slot_length: int,
-               q_idx: np.ndarray) -> np.ndarray:
+               q_idx: np.ndarray, tables=None) -> np.ndarray:
         """bool [slot_length, B] allowed bitmap over the real batch
         (DEFINITE plane under the tri-state path)."""
-        packed = self.lookup_packed(slot_offset, slot_length, q_idx)
+        packed = self.lookup_packed(slot_offset, slot_length, q_idx, tables)
         bits = np.unpackbits(packed.view(np.uint8).reshape(slot_length, -1),
                              axis=1, bitorder="little").astype(bool)
         return bits[:, : len(q_idx)]
 
     def checks(self, q_idx: np.ndarray, gather_idx: np.ndarray,
-               gather_col: np.ndarray) -> np.ndarray:
+               gather_col: np.ndarray, tables=None) -> np.ndarray:
         """bool allowed per gather slot — or int {0,1,2} tri-state when
         the plane path is active."""
         run_lookup, run_checks = self._fns()
@@ -512,7 +520,7 @@ class ShardedEllKernel:
         out = np.asarray(run_checks(
             q, jnp.asarray(gi), jnp.asarray(gcol // 32),
             jnp.asarray((gcol % 32).astype(np.uint32)),
-            *self._table_args()))
+            *self._table_args(tables)))
         if self.planes:
             return out[: len(gather_idx)].astype(np.int8)
         return (out[: len(gather_idx)] != 0)
