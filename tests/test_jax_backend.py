@@ -325,6 +325,49 @@ class TestRandomizedDifferential:
             jx.store.write([RelationshipUpdate(UpdateOp.DELETE, rel)])
         assert_agreement(jx, oracle, "namespace", "view", subjects)
 
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_delta_churn(self, seed):
+        """Sustained add/delete/re-add churn over a FIXED id universe: every
+        mutation stays incremental (no new ids), so this hammers the slot
+        edits, spare-aux growth, and tree-walk removal paths — agreement
+        with the oracle is re-asserted after every burst."""
+        rng = random.Random(7000 + seed)
+        n_users, n_groups, n_ns = 8, 4, 6
+        # seed graph mentions every id once so the compiled universe is
+        # closed under later churn
+        rels = [f"group:g{g}#member@user:u{u}"
+                for g in range(n_groups) for u in range(n_users)]
+        rels += [f"namespace:ns{i}#viewer@user:u0" for i in range(n_ns)]
+        rels += [f"namespace:ns{i}#viewer@group:g0#member"
+                 for i in range(n_ns)]
+        jx, oracle = make_pair(GROUPS_SCHEMA, rels)
+        subjects = users(*[f"u{i}" for i in range(n_users)])
+        assert_agreement(jx, oracle, "namespace", "view", subjects)
+
+        def any_rel():
+            kind = rng.random()
+            if kind < 0.4:
+                return (f"group:g{rng.randrange(n_groups)}#member"
+                        f"@user:u{rng.randrange(n_users)}")
+            if kind < 0.6:
+                a, b = rng.sample(range(n_groups), 2)
+                return f"group:g{a}#member@group:g{b}#member"
+            if kind < 0.85:
+                return (f"namespace:ns{rng.randrange(n_ns)}#viewer"
+                        f"@user:u{rng.randrange(n_users)}")
+            return (f"namespace:ns{rng.randrange(n_ns)}#viewer"
+                    f"@group:g{rng.randrange(n_groups)}#member")
+
+        for _ in range(5):  # bursts
+            ops = []
+            for _ in range(rng.randint(3, 10)):
+                rel = any_rel()
+                op = (UpdateOp.DELETE if rng.random() < 0.4
+                      else UpdateOp.TOUCH)
+                ops.append(RelationshipUpdate(op, parse_relationship(rel)))
+            jx.store.write(ops)
+            assert_agreement(jx, oracle, "namespace", "view", subjects)
+
     @pytest.mark.parametrize("seed", range(3))
     def test_random_rbac_deny(self, seed):
         rng = random.Random(1000 + seed)
