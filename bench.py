@@ -450,10 +450,13 @@ def roofline_probe(ep, workload, batch: int) -> dict:
     k_cav = int(graph.dev_cav.shape[1]) if kern.planes else 0
     w_total = 2 * n_words if kern.planes else n_words
     state_bytes = nt * w_total * 4
-    gather_bytes = 4 * w_total * (n * (k_main + 1) + a * (k_aux + 1))
+    # the bottom-up aux refresh sweeps the aux table aux_passes times per
+    # outer iteration (Gauss-Seidel tree collapse)
+    ap = getattr(kern, "aux_passes", 1)
+    gather_bytes = 4 * w_total * (n * (k_main + 1) + ap * a * (k_aux + 1))
     if kern.planes:
         gather_bytes += 4 * w_total * nt * (k_cav + 1)
-    table_bytes = 4 * (n * k_main + a * k_aux
+    table_bytes = 4 * (n * k_main + ap * a * k_aux
                        + (nt * k_cav if kern.planes else 0))
     per_iter = gather_bytes + 2 * state_bytes + table_bytes
     device_s = t1 - t0
@@ -521,7 +524,8 @@ def sharded_comm_model(ep, workload, batch: int,
         return {"skipped": "needs the ELL graph"}
     out = comm_model(graph.prog.state_size, graph.dev_aux.shape[0],
                      n_data, n_graph, batch,
-                     planes=bool(getattr(graph, "has_cav", False)))
+                     planes=bool(getattr(graph, "has_cav", False)),
+                     aux_passes=getattr(graph.kernel, "aux_passes", 1))
     out["note"] = ("per-iteration tiled all_gather over ICI reassembles "
                    "row blocks; measured wall time for this layout is "
                    "recorded by dryrun_multichip (MULTICHIP artifact)")

@@ -246,11 +246,17 @@ def _apply_perm_expr_packed(expr, x: jnp.ndarray,
 
 
 def make_ell_step(prog: GraphProgram, n_aux_rows: int,
-                  half: Optional[int] = None):
+                  half: Optional[int] = None, aux_passes: int = 1):
     """Per-iteration transition over packed state x: [NT, W] uint32 —
     or [NT, 2*half] when the tri-state (definite/maybe bitplane) path is
     active (`half` = words per plane; an idx_cav table feeds the MAYBE
-    half with the undecidable caveated edges)."""
+    half with the undecidable caveated edges).
+
+    `aux_passes` (= the OR-tree height) refreshes the aux nodes
+    bottom-up BEFORE the main gather reads them (Gauss-Seidel within the
+    iteration), so a hub edge propagates leaf -> tree -> destination in
+    one outer iteration instead of one per tree level.  Monotone OR
+    fixpoint semantics are unchanged — only the trip count drops."""
     n = prog.state_size
     dead = prog.dead_index
     perm_ops = tuple(prog.perm_ops)
@@ -266,15 +272,24 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int,
         # order (main rows first, aux rows after) — no scatter anywhere.
         # Fanin widths come from the table shapes (trace-time constants),
         # so one step fn serves any K layout.
-        y_main = x[idx_main[:, 0]]
-        for k in range(1, idx_main.shape[1]):
-            y_main = y_main | x[idx_main[:, k]]
         if n_aux_rows:
-            y_aux = x[idx_aux[:, 0]]
-            for k in range(1, idx_aux.shape[1]):
-                y_aux = y_aux | x[idx_aux[:, k]]
+            # refresh aux OR-tree nodes bottom-up first; each pass fixes
+            # one more tree level (pass 1 = nodes whose children are all
+            # state rows), then the main gather reads current roots
+            xm = x
+            for _ in range(max(1, aux_passes)):
+                y_aux = xm[idx_aux[:, 0]]
+                for k in range(1, idx_aux.shape[1]):
+                    y_aux = y_aux | xm[idx_aux[:, k]]
+                xm = jnp.concatenate([x[:n], y_aux], axis=0)
+            y_main = xm[idx_main[:, 0]]
+            for k in range(1, idx_main.shape[1]):
+                y_main = y_main | xm[idx_main[:, k]]
             y = jnp.concatenate([y_main, y_aux], axis=0)
         else:
+            y_main = x[idx_main[:, 0]]
+            for k in range(1, idx_main.shape[1]):
+                y_main = y_main | x[idx_main[:, k]]
             y = y_main
         if idx_cav is not None:
             # caveat edges reach the MAYBE plane only: gather their
@@ -329,11 +344,12 @@ def init_packed_state(prog: GraphProgram, n_aux_rows: int, q_idx,
 
 def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
                       num_iters: int, use_while: bool = True,
-                      planes: bool = False):
+                      planes: bool = False, aux_passes: int = 1):
     """fn(q_idx, idx_main, idx_aux[, idx_cav]) -> packed x_final
     [NT, W] uint32 ([NT, 2W] on the tri-state plane path)."""
     step = make_ell_step(prog, n_aux_rows,
-                         half=n_words if planes else None)
+                         half=n_words if planes else None,
+                         aux_passes=aux_passes)
 
     if use_while:
         def evaluate(q_idx, idx_main, idx_aux, idx_cav=None):
@@ -375,11 +391,20 @@ class EllKernelCache:
     lookups.go:85-88)."""
 
     def __init__(self, prog: GraphProgram, n_aux_rows: int, tree_depth: int,
-                 num_iters: Optional[int] = None, planes: bool = False):
+                 num_iters: Optional[int] = None, planes: bool = False,
+                 shared_tree_depth: Optional[int] = None):
         self.prog = prog
         self.n_aux_rows = n_aux_rows
         self.planes = planes
-        # hub OR-trees add tree_depth effective levels per original hop;
+        # in-step bottom-up aux refresh (Gauss-Seidel) collapses OR-tree
+        # levels into their outer iteration.  Passes follow the SHARED
+        # table's tree height only — cav trees propagate through idx_cav
+        # one level per outer iteration regardless, so their depth must
+        # not inflate the sweep count (callers fold it into tree_depth
+        # for the cap).  +1 spare pass: incremental growth can add a
+        # level beyond the built height.
+        std = shared_tree_depth if shared_tree_depth is not None else tree_depth
+        self.aux_passes = std + 1
         # generous cap — while_loop exits at the true fixpoint anyway
         base = num_iters or MAX_ITERATIONS
         self.num_iters = base * (1 + tree_depth)
@@ -390,7 +415,8 @@ class EllKernelCache:
         if fns is not None:
             return fns
         evaluate = make_ell_evaluate(self.prog, self.n_aux_rows, n_words,
-                                     self.num_iters, planes=self.planes)
+                                     self.num_iters, planes=self.planes,
+                                     aux_passes=self.aux_passes)
         if self.planes:
             def run_checks(q_idx, gather_idx, gather_word, gather_bit,
                            idx_main, idx_aux, idx_cav):
@@ -435,7 +461,8 @@ class EllKernelCache:
         fn = self._jits.get(key)
         if fn is None:
             step = make_ell_step(self.prog, self.n_aux_rows,
-                                 half=n_words if self.planes else None)
+                                 half=n_words if self.planes else None,
+                                 aux_passes=self.aux_passes)
             num_iters = self.num_iters
             prog, n_aux, planes = self.prog, self.n_aux_rows, self.planes
 

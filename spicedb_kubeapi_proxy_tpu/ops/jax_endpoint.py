@@ -314,7 +314,8 @@ class _EllGraph:
         self.kernel = EllKernelCache(prog, n_aux_rows=t.idx_aux.shape[0],
                                      tree_depth=tree_depth,
                                      num_iters=num_iters,
-                                     planes=self.has_cav)
+                                     planes=self.has_cav,
+                                     shared_tree_depth=t.tree_depth)
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
         self._dirty_cav: set = set()

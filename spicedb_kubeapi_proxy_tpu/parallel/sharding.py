@@ -158,7 +158,8 @@ def padded_batch_words_for(n_data: int, batch: int) -> int:
 
 
 def comm_model(state_size: int, n_aux_rows: int, n_data: int, n_graph: int,
-               batch: int, planes: bool = False) -> dict:
+               batch: int, planes: bool = False,
+               aux_passes: int = 1) -> dict:
     """Per-iteration ICI traffic of the sharded ELL layout — the SINGLE
     source of the communication model consumed by bench.py and
     __graft_entry__.dryrun_multichip, mirroring ShardedEllKernel's padding
@@ -173,11 +174,14 @@ def comm_model(state_size: int, n_aux_rows: int, n_data: int, n_graph: int,
     n_pad = _ceil_mult(state_size, n_graph)
     a_pad = _ceil_mult(max(n_aux_rows, 1), n_graph)
     w_local = max(1, padded_batch_words_for(n_data, batch) // n_data)
-    rows = n_pad + a_pad
+    # the bottom-up aux refresh all_gathers the aux block aux_passes
+    # times per outer iteration (main block still once)
+    rows = n_pad + a_pad * max(1, aux_passes)
     factor = 3 if planes else 1
     return {
         "mesh": f"{n_data}x{n_graph} (data x graph)",
-        "padded_rows": rows,
+        "padded_rows": n_pad + a_pad,
+        "aux_passes": max(1, aux_passes),
         "words_per_device": w_local,
         "bitplanes": 2 if planes else 1,
         "all_gather_recv_bytes_per_device_per_iter":
@@ -244,6 +248,11 @@ class ShardedEllKernel:
                     np.full((cav.n_aux_cav, K_AUX), dead, np.int32)])
                 a += cav.n_aux_cav
             tree_depth = max(tree_depth, cav.tree_depth)
+        # in-step bottom-up aux refresh passes (Gauss-Seidel tree
+        # collapse, matching the single-chip kernel): SHARED tree height
+        # only — cav trees propagate via idx_cav per outer iteration —
+        # +1 spare pass for incrementally grown levels
+        self.aux_passes = t.tree_depth + 1
         self.n_pad = _ceil_mult(n, n_graph)
         self.a_pad = _ceil_mult(max(a, 1), n_graph)
         main = np.full((self.n_pad, t.idx_main.shape[1]), dead, np.int32)
@@ -324,6 +333,7 @@ class ShardedEllKernel:
             m[np.asarray(term.mask_indices, np.int64)] = np.uint32(0xFFFFFFFF)
             wc_masks.append((term, jnp.asarray(m)))
         num_iters = self.num_iters
+        aux_passes = self.aux_passes
 
         def shard_fn(q_local, main_local, aux_local, cav_local=None):
             wl = q_local.shape[0] // 32
@@ -344,18 +354,29 @@ class ShardedEllKernel:
 
             def step(x_main, x_aux):
                 x = jnp.concatenate([x_main, x_aux], axis=0)
-                y_main_l = x[main_local[:, 0]]
+                # bottom-up aux refresh first (Gauss-Seidel tree collapse,
+                # same as the single-chip step): each pass gathers the
+                # local aux rows and reassembles the full aux block over
+                # ICI — the aux table is tiny next to the main block, so
+                # the extra all_gathers cost far less than the outer
+                # iterations they remove
+                aux_cur = x_aux
+                for _ in range(max(1, aux_passes)):
+                    base = jnp.concatenate([x_main, aux_cur], axis=0)
+                    y_aux_l = base[aux_local[:, 0]]
+                    for k in range(1, aux_local.shape[1]):
+                        y_aux_l = y_aux_l | base[aux_local[:, k]]
+                    aux_cur = jax.lax.all_gather(y_aux_l, "graph", axis=0,
+                                                 tiled=True)
+                xm = jnp.concatenate([x_main, aux_cur], axis=0)
+                y_main_l = xm[main_local[:, 0]]
                 for k in range(1, main_local.shape[1]):
-                    y_main_l = y_main_l | x[main_local[:, k]]
-                y_aux_l = x[aux_local[:, 0]]
-                for k in range(1, aux_local.shape[1]):
-                    y_aux_l = y_aux_l | x[aux_local[:, k]]
+                    y_main_l = y_main_l | xm[main_local[:, k]]
                 # reassemble row blocks across the graph axis (tiled ICI
                 # all-gather; payload is rows x local words [x planes])
                 y_main = jax.lax.all_gather(y_main_l, "graph", axis=0,
                                             tiled=True)
-                y_aux = jax.lax.all_gather(y_aux_l, "graph", axis=0,
-                                           tiled=True)
+                y_aux = aux_cur
                 if cav_local is not None:
                     # undecidable caveated edges: closure feeds the MAYBE
                     # plane only — slice the plane BEFORE the all_gather
