@@ -89,6 +89,17 @@ def _min_batch_words() -> int:
 # NUL, which can never appear in a stored relationship id.
 PHANTOM_ID = "\x00__phantom__"
 
+# Spare object rows (VERDICT-r4 follow-through on the rebuild cliff):
+# every type's compiled universe reserves a pool of placeholder ids; a
+# dual-write that creates a BRAND-NEW object id claims one by renaming it
+# in the program's id maps instead of forcing a multi-second full rebuild
+# of the 1M-row graph.  The prefix contains NUL, which can never appear
+# in a stored relationship id.
+_SPARE_PREFIX = "\x00__spare__"
+# pool sizing: max(floor, universe // divisor) placeholder rows per type
+_SPARE_FLOOR = 64
+_SPARE_DIVISOR = 64
+
 
 def _object_ids_np(graph, resource_type: str) -> np.ndarray:
     """Object-dtype numpy view of the program's id list, cached per graph
@@ -684,7 +695,8 @@ class JaxEndpoint(PermissionsEndpoint):
         self._caveat_affected: set = set()
         self._caveated_keys: set = set()
         self.stats = {"rebuilds": 0, "delta_batches": 0, "kernel_calls": 0,
-                      "oracle_residual_checks": 0}
+                      "oracle_residual_checks": 0, "spare_assignments": 0}
+        self._spare_pool: dict = {}
         self.store.add_delta_listener(self._on_delta)
         self.store.add_reset_listener(self._on_reset)
 
@@ -770,9 +782,24 @@ class JaxEndpoint(PermissionsEndpoint):
         # run off-loop now, so writes race the rebuild).
         self._drain_pending()
         self._graph_invalid = False
-        # phantom-subject columns: every type gets one reserved column so
-        # first-contact subjects (zero tuples) still hit the kernel
-        extra = {t: {PHANTOM_ID} for t in self.schema.definitions}
+        # phantom-subject columns (one reserved column per type so
+        # first-contact subjects still hit the kernel) + the spare object
+        # pool for rebuild-free object creation.  Pool size amortizes the
+        # rebuild: sized from the larger of the previous program's
+        # universe (covers subject-only types) and the store's current
+        # per-type resource counts (covers the first rebuild after a
+        # bulk_load, where no previous program exists).
+        prev_counts = (self._graph.prog.num_objects
+                       if self._graph is not None else {})
+        extra = {}
+        self._spare_pool = {}
+        for t in self.schema.definitions:
+            n_t = max(prev_counts.get(t, 0),
+                      len(self.store.object_ids_of_type(t)))
+            n_spare = max(_SPARE_FLOOR, n_t // _SPARE_DIVISOR)
+            spares = [f"{_SPARE_PREFIX}{k}" for k in range(n_spare)]
+            extra[t] = {PHANTOM_ID, *spares}
+            self._spare_pool[t] = spares
         with self.store.lock:
             snapshot_revision = self.store.revision
             self._caveated_pairs = self.store.caveated_relation_pairs()
@@ -846,6 +873,47 @@ class JaxEndpoint(PermissionsEndpoint):
         except Exception:
             return "unsupported"
 
+    def _assign_spare(self, graph, type_name: str, new_id: str) -> bool:
+        """Claim a spare row for a brand-new object id by renaming it in
+        the program's id maps (slot layout, row count, and device tables
+        are untouched — the row exists, dead, in every slot of the type).
+        Runs under self._lock; the graph's cached numpy id view is
+        invalidated."""
+        pool = self._spare_pool.get(type_name)
+        if not pool:
+            return False
+        prog = graph.prog
+        spare = pool.pop()
+        local = prog.object_index[type_name].pop(spare)
+        prog.object_index[type_name][new_id] = local
+        prog.object_ids[type_name][local] = new_id
+        cache = getattr(graph, "_ids_np_cache", None)
+        if cache is not None:
+            cache.pop(type_name, None)
+        self.stats["spare_assignments"] += 1
+        return True
+
+    def _ensure_ids_for(self, graph, rel: Relationship) -> bool:
+        """Make every id a TOUCHed tuple names indexable, assigning spare
+        rows to new ones; False (pool dry / unknown type combination)
+        forces a rebuild."""
+        prog = graph.prog
+        rt, rid = rel.resource.type, rel.resource.id
+        d = self.schema.definitions.get(rt)
+        if d is None or rel.relation not in d.relations:
+            # edgeless tuple (unmodeled relation/type): _edge_endpoints
+            # will report no edges — never spend spare rows on it
+            return True
+        if rt in prog.object_index and rid not in prog.object_index[rt]:
+            if not self._assign_spare(graph, rt, rid):
+                return False
+        st, sid = rel.subject.type, rel.subject.id
+        if (st in prog.object_index and sid != WILDCARD
+                and sid not in prog.object_index[st]):
+            if not self._assign_spare(graph, st, sid):
+                return False
+        return True
+
     def _drain_pending(self) -> list:
         """Atomically take all queued delta batches."""
         out = []
@@ -898,6 +966,9 @@ class JaxEndpoint(PermissionsEndpoint):
                         break
                 elif u.rel.caveat is not None:  # TOUCH, caveated
                     self._set_expiry(key, u.rel.expires_at)
+                    if not self._ensure_ids_for(graph, u.rel):
+                        needs_rebuild = True
+                        break
                     value = self._caveat_decidability(u.rel)
                     if value == "unsupported" or not cav_deltas:
                         needs_rebuild = True
@@ -926,6 +997,9 @@ class JaxEndpoint(PermissionsEndpoint):
                     # value False: no edges at all
                 else:  # TOUCH, definite
                     self._set_expiry(key, u.rel.expires_at)
+                    if not self._ensure_ids_for(graph, u.rel):
+                        needs_rebuild = True
+                        break
                     if key in self._caveated_keys:
                         # previously-caveated tuple replaced by a definite
                         # one: undo its old plane placement first
@@ -1132,6 +1206,14 @@ class JaxEndpoint(PermissionsEndpoint):
                 else:
                     col = cols[subject]
                     snap = graph.snapshot()
+                    # id view + phantom index captured under the lock:
+                    # spare-row assignment renames ids in place, so the
+                    # cache read must serialize with it (the captured
+                    # array is consistent with `snap` — rows renamed
+                    # later are dead in this snapshot)
+                    ids = _object_ids_np(graph, resource_type)
+                    ph = graph.prog.object_index[resource_type].get(
+                        PHANTOM_ID)
                     self.stats["kernel_calls"] += 1
         if oracle:
             # host evaluation outside the lock (reads the live store)
@@ -1145,8 +1227,6 @@ class JaxEndpoint(PermissionsEndpoint):
         else:
             bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
             idx = np.nonzero(bitmap[:, col])[0]
-        ids = _object_ids_np(graph, resource_type)
-        ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
         return _ids_for(ids, idx, ph)
 
     async def lookup_resources(self, resource_type: str, permission: str,
@@ -1184,6 +1264,9 @@ class JaxEndpoint(PermissionsEndpoint):
             else:
                 q_arr, cols, unknown = self._encode_subjects(graph, subjects)
                 snap = graph.snapshot()
+                # captured under the lock — see _lookup_sync
+                ids = _object_ids_np(graph, resource_type)
+                ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
                 self.stats["kernel_calls"] += 1
         if all_oracle:
             # host evaluation outside the lock (reads the live store)
@@ -1205,8 +1288,6 @@ class JaxEndpoint(PermissionsEndpoint):
             def col_indices(col):
                 return np.nonzero(bitmap[:, col])[0]
 
-        ids = _object_ids_np(graph, resource_type)
-        ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
         per_col_ids: dict = {}  # column -> id list (columns are shared)
         out = []
         for s in subjects:

@@ -267,11 +267,58 @@ class TestIncrementalDeltas:
             assert_agreement(jx, oracle, "namespace", "view",
                              users(*[f"u{i}" for i in range(12)]))
 
-    def test_new_object_forces_rebuild(self):
+    def test_new_object_id_assigns_spare_without_rebuild(self):
+        """A tuple naming a brand-new object/subject id claims spare rows
+        (renamed in the program's id maps) instead of forcing a full
+        rebuild — the dual-write create path at 1M scale must not stall
+        seconds per new pod."""
         jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns1#viewer@user:alice"])
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+        rebuilds = jx.stats["rebuilds"]
         jx.store.write(touch("namespace:brand-new#viewer@user:newbie"))
         assert_agreement(jx, oracle, "namespace", "view", users("alice", "newbie"))
+        assert jx.stats["rebuilds"] == rebuilds, \
+            "new ids must claim spare rows, not rebuild"
+        assert jx.stats["spare_assignments"] >= 2  # object + subject
+        # placeholder ids never leak from lookups
+        ids = asyncio.run(jx.lookup_resources(
+            "namespace", "view", SubjectRef("user", "newbie")))
+        assert ids == ["brand-new"]
+
+    def test_spare_pool_exhaustion_rebuilds_and_resizes(self, monkeypatch):
+        """Draining the spare pool falls back to a rebuild whose new pool
+        is sized from the (now larger) universe; correctness holds across
+        the boundary.  The sizing divisor is patched to 1 so the resize
+        is observable at unit-test scale."""
+        from spicedb_kubeapi_proxy_tpu.ops import jax_endpoint as je
+        monkeypatch.setattr(je, "_SPARE_DIVISOR", 1)
+        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns1#viewer@user:alice"])
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+        floor_pool = len(jx._spare_pool["namespace"])
+        for k in range(70):  # exceeds the 64-row floor pool
+            jx.store.write(touch(f"namespace:n{k}#viewer@user:alice"))
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+        assert jx.stats["rebuilds"] >= 2
+        # the exhaustion rebuild sized the new pool from the grown
+        # universe (divisor 1: one spare per existing object > the floor)
+        assert len(jx._spare_pool["namespace"]) > floor_pool
+        got = sorted(asyncio.run(jx.lookup_resources(
+            "namespace", "view", SubjectRef("user", "alice"))))
+        assert got == sorted(["ns1"] + [f"n{k}" for k in range(70)])
+
+    def test_unmodeled_relation_burns_no_spares(self):
+        """Edgeless tuples (relations absent from the schema) must not
+        consume spare rows — a stream of them used to be a no-op and must
+        stay one."""
+        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns1#viewer@user:alice"])
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+        before = jx.stats["spare_assignments"]
+        rebuilds = jx.stats["rebuilds"]
+        for k in range(10):
+            jx.store.write(touch(f"namespace:brand-{k}#unmodeled@user:nobody"))
+        assert_agreement(jx, oracle, "namespace", "view", users("alice"))
+        assert jx.stats["spare_assignments"] == before
+        assert jx.stats["rebuilds"] == rebuilds
 
     def test_group_membership_revocation(self):
         jx, oracle = make_pair(GROUPS_SCHEMA, [

@@ -130,11 +130,13 @@ class TestShardedEndpoint:
         assert_agreement(ep, oracle, users("alice", "bob"))
         assert ep.stats["rebuilds"] == rebuilds
         assert ep.stats["delta_batches"] > 0
-        # new object id forces a rebuild, sharded again
+        # a brand-new object id claims a spare row on the sharded graph
+        # too (no rebuild — the device tables already hold its rows)
         ep.store.write(touch("namespace:brand-new#viewer@user:alice"))
         assert_agreement(ep, oracle, users("alice", "bob"))
         assert isinstance(ep._graph, _ShardedEllGraph)
-        assert ep.stats["rebuilds"] == rebuilds + 1
+        assert ep.stats["rebuilds"] == rebuilds
+        assert ep.stats["spare_assignments"] >= 1
 
     def test_hub_tree_deltas_sharded(self):
         rels = [f"group:eng#member@user:u{i}" for i in range(120)]
