@@ -66,7 +66,7 @@ from ..spicedb.types import (
 from .ell import EllKernelCache, batch_words, build_tables
 from .graph_compile import (GraphProgram, SELF_SLOT, caveat_affected_pairs,
                             compile_graph, compile_graph_columnar)
-from .spmv import KernelCache, bucket, pad_edges
+from .spmv import KernelCache, bucket, pad_edges, pad_scatter
 
 _MIN_EDGE_BUCKET = 256
 _MIN_BATCH_BUCKET = 8
@@ -226,11 +226,12 @@ class _SegmentGraph:
         wins, matching XLA scatter's undefined duplicate order)."""
         if not self._updates:
             return False
-        pos = jnp.asarray(list(self._updates.keys()), jnp.int32)
-        srcs = jnp.asarray([v[0] for v in self._updates.values()], jnp.int32)
-        dsts = jnp.asarray([v[1] for v in self._updates.values()], jnp.int32)
-        self.edge_src = self.edge_src.at[pos].set(srcs)
-        self.edge_dst = self.edge_dst.at[pos].set(dsts)
+        pos_np = np.asarray(list(self._updates.keys()), np.int32)
+        sd = np.asarray(list(self._updates.values()), np.int32)  # [M, 2]
+        pos_np, sd = pad_scatter(pos_np, sd)
+        pos = jnp.asarray(pos_np)
+        self.edge_src = self.edge_src.at[pos].set(jnp.asarray(sd[:, 0]))
+        self.edge_dst = self.edge_dst.at[pos].set(jnp.asarray(sd[:, 1]))
         self.sorted_edges = False
         self._updates = {}
         return True
@@ -481,20 +482,23 @@ class _EllGraph:
         changed = False
         if self._dirty_main:
             rows = np.asarray(sorted(self._dirty_main), np.int32)
+            rows, vals = pad_scatter(rows, self.host_main[rows])
             self.dev_main = self.dev_main.at[jnp.asarray(rows)].set(
-                jnp.asarray(self.host_main[rows]))
+                jnp.asarray(vals))
             self._dirty_main = set()
             changed = True
         if self._dirty_aux:
             rows = np.asarray(sorted(self._dirty_aux), np.int32)
+            rows, vals = pad_scatter(rows, self.host_aux[rows])
             self.dev_aux = self.dev_aux.at[jnp.asarray(rows)].set(
-                jnp.asarray(self.host_aux[rows]))
+                jnp.asarray(vals))
             self._dirty_aux = set()
             changed = True
         if self._dirty_cav:
             rows = np.asarray(sorted(self._dirty_cav), np.int32)
+            rows, vals = pad_scatter(rows, self.host_cav[rows])
             self.dev_cav = self.dev_cav.at[jnp.asarray(rows)].set(
-                jnp.asarray(self.host_cav[rows]))
+                jnp.asarray(vals))
             self._dirty_cav = set()
             changed = True
         return changed

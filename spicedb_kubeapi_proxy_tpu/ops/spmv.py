@@ -50,6 +50,20 @@ def bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
+def pad_scatter(rows: np.ndarray, vals: np.ndarray) -> tuple:
+    """Bucket a row-scatter to power-of-two row counts (pad by repeating
+    the last row with its own values — duplicate identical updates are
+    benign under XLA's scatter semantics) so jitted .at[].set updates
+    compile O(log n) shapes instead of one per distinct dirty-row
+    count."""
+    b = bucket(len(rows), 16)
+    if b != len(rows):
+        pad = b - len(rows)
+        rows = np.concatenate([rows, np.repeat(rows[-1:], pad)])
+        vals = np.concatenate([vals, np.repeat(vals[-1:], pad, axis=0)])
+    return rows, vals
+
+
 def pad_edges(prog: GraphProgram, capacity: Optional[int] = None) -> tuple:
     """Pad edge arrays into a power-of-two bucket; padding edges read the
     dead index (always 0) and write the dead index (never read)."""

@@ -30,7 +30,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.graph_compile import GraphProgram
-from ..ops.spmv import MAX_ITERATIONS, bucket, make_evaluate, pad_edges
+from ..ops.spmv import (MAX_ITERATIONS, bucket, make_evaluate,
+                        pad_edges, pad_scatter)
 
 
 def make_mesh(devices=None, data: Optional[int] = None,
@@ -303,6 +304,7 @@ class ShardedEllKernel:
         return vals
 
     def _scatter_rows(self, arr, rows: np.ndarray, vals: np.ndarray):
+        rows, vals = pad_scatter(np.asarray(rows), np.asarray(vals))
         out = arr.at[jnp.asarray(rows)].set(jnp.asarray(vals))
         # keep the row sharding stable regardless of what the scatter's
         # output sharding propagation decided
