@@ -224,3 +224,61 @@ def test_concurrent_writes_during_rebuild(endpoint_url):
             60)
 
     asyncio.run(go())
+
+
+@pytest.mark.parametrize("endpoint_url", ["jax://", "jax://?mesh=2x4"])
+def test_lookups_race_spare_assigning_writes(endpoint_url):
+    """Round-4 regression net: lookups (kernel + id materialization run
+    OUTSIDE the endpoint lock on a snapshot) race writes that create
+    brand-new object ids (in-place renames of the program's id maps via
+    the spare pool).  Invariants: no placeholder id (NUL-prefixed) ever
+    leaks into results; every id returned was a doc id the store has
+    seen; once a create's write returns, subsequent lookups must include
+    it (read-your-writes through the drain)."""
+    if "mesh" in endpoint_url:
+        import jax
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+    ep = create_endpoint(endpoint_url + ("&" if "?" in endpoint_url
+                                         else "?") + "dispatch=direct",
+                         Bootstrap(schema_text=SCHEMA))
+    ep.store.bulk_load([parse_relationship(r) for r in seed_rels()])
+
+    async def go():
+        errors = []
+        created = []  # ids whose write has returned
+        stop = asyncio.Event()
+
+        async def writer():
+            for k in range(60):
+                rel = f"doc:new-{k}#viewer@user:u0"
+                await ep.write_relationships([RelationshipUpdate(
+                    UpdateOp.TOUCH, parse_relationship(rel))])
+                created.append(f"new-{k}")
+                await asyncio.sleep(0)
+            stop.set()
+
+        async def reader():
+            while not stop.is_set():
+                mark = len(created)
+                ids = await ep.lookup_resources(
+                    "doc", "view", SubjectRef("user", "u0"))
+                got = set(ids)
+                if any("\x00" in i for i in got):
+                    errors.append(f"placeholder leak: {got}")
+                    return
+                # read-your-writes: ids created before the call started
+                missing = [c for c in created[:mark] if c not in got]
+                if missing:
+                    errors.append(f"missing created ids: {missing}")
+                    return
+                await asyncio.sleep(0)
+
+        await asyncio.wait_for(
+            asyncio.gather(writer(), *[reader() for _ in range(4)]), 120)
+        assert not errors, errors[:3]
+        final = set(await ep.lookup_resources(
+            "doc", "view", SubjectRef("user", "u0")))
+        assert all(f"new-{k}" in final for k in range(60))
+
+    asyncio.run(go())
