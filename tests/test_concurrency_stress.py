@@ -258,6 +258,13 @@ def test_lookups_race_spare_assigning_writes(endpoint_url):
                 await asyncio.sleep(0)
             stop.set()
 
+        def diag():
+            inner_ep = getattr(ep, "inner", ep)
+            st = dict(getattr(inner_ep, "stats", {}))
+            pool = {t: len(v) for t, v in
+                    getattr(inner_ep, "_spare_pool", {}).items()}
+            return f"stats={st} pool={pool} created={len(created)}"
+
         async def reader():
             while not stop.is_set():
                 mark = len(created)
@@ -265,12 +272,15 @@ def test_lookups_race_spare_assigning_writes(endpoint_url):
                     "doc", "view", SubjectRef("user", "u0"))
                 got = set(ids)
                 if any("\x00" in i for i in got):
-                    errors.append(f"placeholder leak: {got}")
+                    errors.append(
+                        f"placeholder leak: "
+                        f"{[i for i in got if chr(0) in i]} [{diag()}]")
                     return
                 # read-your-writes: ids created before the call started
                 missing = [c for c in created[:mark] if c not in got]
                 if missing:
-                    errors.append(f"missing created ids: {missing}")
+                    errors.append(f"missing created ids: {missing} "
+                                  f"(got {len(got)}) [{diag()}]")
                     return
                 await asyncio.sleep(0)
 
