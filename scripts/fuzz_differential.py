@@ -155,11 +155,12 @@ def rand_rel(rng, kind, n, new_id_rate=0.15):
     if c < 0.4:
         cur, mx = rng.randrange(5), rng.randrange(5)
         return (f"doc:{d}#viewer@{u}"
-                f"[within_limit:{{\"current\":{cur},\"max\":{mx}}}]")
+                f"[caveat:within_limit:{{\"current\":{cur},\"max\":{mx}}}]")
     if c < 0.5:
         # undecidable: max missing -> context-dependent at check time
         cur = rng.randrange(5)
-        return f"doc:{d}#viewer@{u}[within_limit:{{\"current\":{cur}}}]"
+        return (f"doc:{d}#viewer@{u}"
+                f"[caveat:within_limit:{{\"current\":{cur}}}]")
     if c < 0.8:
         return f"doc:{d}#viewer@{u}"
     return f"doc:{d}#editor@{u}"
@@ -214,9 +215,9 @@ def run_seed(seed, mesh=None):
         for _ in range(rng.randint(2, 12)):
             r = rand_rel(rng, kind, n)
             op = UpdateOp.DELETE if rng.random() < 0.35 else UpdateOp.TOUCH
-            rel = parse_relationship(r)
-            if op == UpdateOp.DELETE:
-                rel = parse_relationship(r.split("[")[0])
+            # deletes key on identity only: strip any caveat/expiry suffix
+            rel = parse_relationship(r.split("[")[0]
+                                     if op == UpdateOp.DELETE else r)
             ops.append(RelationshipUpdate(op, rel))
         jx.store.write(ops)
         agree(jx, oracle, rt, perm, subjects, seed, step)
