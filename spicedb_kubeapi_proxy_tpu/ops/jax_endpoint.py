@@ -699,8 +699,15 @@ class JaxEndpoint(PermissionsEndpoint):
         self._caveat_affected: set = set()
         self._caveated_keys: set = set()
         self.stats = {"rebuilds": 0, "delta_batches": 0, "kernel_calls": 0,
-                      "oracle_residual_checks": 0, "spare_assignments": 0}
+                      "oracle_residual_checks": 0, "spare_assignments": 0,
+                      "spare_reclaims": 0}
         self._spare_pool: dict = {}
+        # (type, id) -> live tuple keys, for spare-ASSIGNED ids only: when
+        # the set empties the row is renamed back to a placeholder and
+        # returned to the pool, so unique-name create/delete churn (the
+        # normal kubernetes pod lifecycle) never exhausts the pool
+        self._assigned_refs: dict = {}
+        self._spare_seq = 0
         self.store.add_delta_listener(self._on_delta)
         self.store.add_reset_listener(self._on_reset)
 
@@ -804,6 +811,8 @@ class JaxEndpoint(PermissionsEndpoint):
             spares = [f"{_SPARE_PREFIX}{k}" for k in range(n_spare)]
             extra[t] = {PHANTOM_ID, *spares}
             self._spare_pool[t] = spares
+        self._assigned_refs = {}
+        self._spare_seq = 0
         with self.store.lock:
             snapshot_revision = self.store.revision
             self._caveated_pairs = self.store.caveated_relation_pairs()
@@ -886,16 +895,54 @@ class JaxEndpoint(PermissionsEndpoint):
         pool = self._spare_pool.get(type_name)
         if not pool:
             return False
+        self._rename_row(graph, type_name, pool.pop(), new_id)
+        self._assigned_refs[(type_name, new_id)] = set()
+        self.stats["spare_assignments"] += 1
+        return True
+
+    @staticmethod
+    def _rename_row(graph, type_name: str, old_id: str, new_id: str) -> bool:
+        """Rename one object row in the program's id maps (the single
+        place the rename discipline lives — assignment and reclaim both
+        use it); invalidates the graph's cached numpy id view."""
         prog = graph.prog
-        spare = pool.pop()
-        local = prog.object_index[type_name].pop(spare)
+        local = prog.object_index[type_name].pop(old_id, None)
+        if local is None:
+            return False
         prog.object_index[type_name][new_id] = local
         prog.object_ids[type_name][local] = new_id
         cache = getattr(graph, "_ids_np_cache", None)
         if cache is not None:
             cache.pop(type_name, None)
-        self.stats["spare_assignments"] += 1
         return True
+
+    def _note_key_applied(self, key: tuple) -> None:
+        """Record a live tuple against any spare-assigned ids it names."""
+        for side in ((key[0], key[1]), (key[3], key[4])):
+            refs = self._assigned_refs.get(side)
+            if refs is not None:
+                refs.add(key)
+
+    def _note_key_removed(self, graph, key: tuple) -> None:
+        """Drop a tuple from its ids' ref sets; an emptied set reclaims
+        the spare row (rename back to a fresh placeholder + repool)."""
+        for side in ((key[0], key[1]), (key[3], key[4])):
+            refs = self._assigned_refs.get(side)
+            if refs is None:
+                continue
+            refs.discard(key)
+            if not refs:
+                self._reclaim_spare(graph, side)
+
+    def _reclaim_spare(self, graph, side: tuple) -> None:
+        t, old_id = side
+        self._assigned_refs.pop(side, None)
+        self._spare_seq += 1
+        placeholder = f"{_SPARE_PREFIX}r{self._spare_seq}"
+        if not self._rename_row(graph, t, old_id, placeholder):
+            return
+        self._spare_pool.setdefault(t, []).append(placeholder)
+        self.stats["spare_reclaims"] += 1
 
     def _ensure_ids_for(self, graph, rel: Relationship) -> bool:
         """Make every id a TOUCHed tuple names indexable, assigning spare
@@ -964,10 +1011,12 @@ class JaxEndpoint(PermissionsEndpoint):
                             needs_rebuild = True
                             break
                         self._caveated_keys.discard(key)
+                        self._note_key_removed(graph, key)
                         continue
                     if not graph.remove_key(key):
                         needs_rebuild = True
                         break
+                    self._note_key_removed(graph, key)
                 elif u.rel.caveat is not None:  # TOUCH, caveated
                     self._set_expiry(key, u.rel.expires_at)
                     if not self._ensure_ids_for(graph, u.rel):
@@ -999,6 +1048,7 @@ class JaxEndpoint(PermissionsEndpoint):
                             needs_rebuild = True
                             break
                     # value False: no edges at all
+                    self._note_key_applied(key)
                 else:  # TOUCH, definite
                     self._set_expiry(key, u.rel.expires_at)
                     if not self._ensure_ids_for(graph, u.rel):
@@ -1014,6 +1064,7 @@ class JaxEndpoint(PermissionsEndpoint):
                     if not graph.add_rel(u.rel):
                         needs_rebuild = True
                         break
+                    self._note_key_applied(key)
             if needs_rebuild:
                 break
         # expire lazily AFTER batch processing so expirations registered by
@@ -1039,10 +1090,12 @@ class JaxEndpoint(PermissionsEndpoint):
                     needs_rebuild = True
                     break
                 self._caveated_keys.discard(key)
+                self._note_key_removed(graph, key)
                 continue
             if not graph.remove_key(key):
                 needs_rebuild = True
                 break
+            self._note_key_removed(graph, key)
 
         if needs_rebuild:
             self._rebuild()
