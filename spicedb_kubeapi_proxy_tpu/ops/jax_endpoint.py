@@ -985,7 +985,8 @@ class JaxEndpoint(PermissionsEndpoint):
             return
         batches = self._drain_pending()
         if not batches and not (self._expiry_heap
-                                and self._expiry_heap[0][0] <= time.time()):
+                                and self._expiry_heap[0][0]
+                                <= self.store.now()):
             return
 
         needs_rebuild = False
@@ -1070,8 +1071,11 @@ class JaxEndpoint(PermissionsEndpoint):
         # expire lazily AFTER batch processing so expirations registered by
         # the batches just drained take effect this query; heap entries whose
         # expiry no longer matches the current metadata are stale (tuple
-        # deleted/re-touched) and skipped
-        now = time.time()
+        # deleted/re-touched) and skipped.  The STORE clock is the single
+        # time source: reads filter expired tuples with it, so the device
+        # graph must agree or kernel/oracle results diverge at the expiry
+        # instant.
+        now = self.store.now()
         while (not needs_rebuild and self._expiry_heap
                and self._expiry_heap[0][0] <= now):
             exp, key = heapq.heappop(self._expiry_heap)

@@ -153,6 +153,12 @@ class _Entry:
 class TupleStore:
     """Thread-safe in-memory tuple store with monotonic revisions."""
 
+    def now(self) -> float:
+        """The store's time source — consumers enforcing expiration (the
+        device-graph expiry heap) must read THIS clock so tests can drive
+        expiry deterministically."""
+        return self._clock()
+
     def __init__(self, clock: Callable[[], float] = time.time):
         self._lock = threading.RLock()
         self._clock = clock

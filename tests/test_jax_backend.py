@@ -44,14 +44,26 @@ def delete(*rels):
     return [RelationshipUpdate(UpdateOp.DELETE, parse_relationship(r)) for r in rels]
 
 
-def make_pair(schema_text, rels):
-    """(jax endpoint, oracle) over the same tuples."""
+def make_pair(schema_text, rels, clock=None):
+    """(jax endpoint, oracle) over the same tuples.  Pass `clock` for
+    deterministic expiry tests (the endpoint's expiry heap and the
+    store's read-time filtering share it)."""
     schema = sch.parse_schema(schema_text)
-    jx = JaxEndpoint(schema)
+    jx = JaxEndpoint(schema, store=TupleStore(clock=clock)
+                     if clock is not None else None)
     if rels:
         jx.store.write(touch(*rels))
     oracle = Evaluator(schema, jx.store)
     return jx, oracle
+
+
+def make_clocked_pair(schema_text, rels):
+    """(jx, oracle, clk): a pair on a manual clock — set clk[0] to move
+    time for deterministic expiry tests."""
+    import time
+    clk = [time.time()]
+    jx, oracle = make_pair(schema_text, rels, clock=lambda: clk[0])
+    return jx, oracle, clk
 
 
 def assert_agreement(jx, oracle, resource_type, permission, subjects,
@@ -356,12 +368,12 @@ class TestIncrementalDeltas:
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
 
     def test_expiration_respected(self):
-        import time
-        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns#viewer@user:alice"])
+        jx, oracle, clk = make_clocked_pair(
+            GROUPS_SCHEMA, ["namespace:ns#viewer@user:alice"])
         jx.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
-            f"namespace:ns#viewer@user:bob[expiration:{time.time() + 0.3}]"))])
+            f"namespace:ns#viewer@user:bob[expiration:{clk[0] + 100}]"))])
         assert_agreement(jx, oracle, "namespace", "view", users("alice", "bob"))
-        time.sleep(0.35)
+        clk[0] += 200
         assert_agreement(jx, oracle, "namespace", "view", users("alice", "bob"))
 
 
@@ -508,27 +520,26 @@ class TestReviewRegressions:
         assert_agreement(jx, oracle, "doc", "view", users("zed", "eve"))
 
     def test_touch_adds_expiry_to_existing_tuple(self):
-        import time
-        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns#viewer@user:alice"])
+        jx, oracle, clk = make_clocked_pair(
+            GROUPS_SCHEMA, ["namespace:ns#viewer@user:alice"])
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
-        # re-touch the same tuple, now with a short expiration
+        # re-touch the same tuple, now with an expiration
         jx.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
-            f"namespace:ns#viewer@user:alice[expiration:{time.time() + 0.2}]"))])
-        time.sleep(0.25)
+            f"namespace:ns#viewer@user:alice[expiration:{clk[0] + 100}]"))])
+        clk[0] += 200
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
 
     def test_delete_then_readd_clears_stale_expiry(self):
-        import time
-        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns0#viewer@user:z"])
-        # generous pre-expiry window: the first assert_agreement must fully
-        # evaluate kernel AND oracle before the tuple expires, and a loaded
-        # host (suite-order compiles) can eat a short budget -> flake
+        """Deterministic via the store's injectable clock (the endpoint's
+        expiry heap reads store.now()): no wall-clock races, no sleeps."""
+        jx, oracle, clk = make_clocked_pair(
+            GROUPS_SCHEMA, ["namespace:ns0#viewer@user:z"])
         jx.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
-            f"namespace:ns#viewer@user:alice[expiration:{time.time() + 3.0}]"))])
+            f"namespace:ns#viewer@user:alice[expiration:{clk[0] + 100}]"))])
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
         jx.store.write(delete("namespace:ns#viewer@user:alice"))
         jx.store.write(touch("namespace:ns#viewer@user:alice"))  # no expiry
-        time.sleep(3.1)  # stale heap entry fires; must be ignored
+        clk[0] += 200  # stale heap entry fires; must be ignored
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
 
     def test_deep_membership_chain(self):
