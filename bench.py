@@ -434,7 +434,7 @@ def roofline_probe(ep, workload, batch: int) -> dict:
     packed = np.ascontiguousarray(out)
     packed_T = np.ascontiguousarray(packed.T)
     t2 = time.perf_counter()
-    ids_np = _object_ids_np(graph, workload.resource_type)
+    ids_np, _mask = _object_ids_np(graph, workload.resource_type)
     _ = [ids_np[_word_col_indices(packed_T[c // 32], c % 32)].tolist()
          for c in range(min(len(cols), 8))]  # sample of id materialization
     t3 = time.perf_counter()

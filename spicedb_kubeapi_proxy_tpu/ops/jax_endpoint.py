@@ -33,6 +33,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import heapq
+import logging
 import os
 import threading
 import time
@@ -101,18 +102,23 @@ _SPARE_FLOOR = 64
 _SPARE_DIVISOR = 64
 
 
-def _object_ids_np(graph, resource_type: str) -> np.ndarray:
-    """Object-dtype numpy view of the program's id list, cached per graph
-    (fancy-indexing materializes allowed-id lists at C speed instead of a
-    Python loop per id)."""
+def _object_ids_np(graph, resource_type: str) -> tuple:
+    """(ids array, placeholder mask) view of the program's id list,
+    cached per graph: object-dtype numpy ids for C-speed fancy-indexed
+    materialization, plus a bool mask of internal NUL-prefixed ids
+    (phantom + spare placeholders) so result filtering never needs a
+    per-id Python scan."""
     cache = getattr(graph, "_ids_np_cache", None)
     if cache is None:
         cache = graph._ids_np_cache = {}
-    arr = cache.get(resource_type)
-    if arr is None:
-        arr = cache[resource_type] = np.asarray(
-            graph.prog.object_ids[resource_type], dtype=object)
-    return arr
+    entry = cache.get(resource_type)
+    if entry is None:
+        lst = graph.prog.object_ids[resource_type]
+        arr = np.asarray(lst, dtype=object)
+        mask = np.fromiter(("\x00" in i for i in lst), dtype=bool,
+                           count=len(lst))
+        entry = cache[resource_type] = (arr, mask)
+    return entry
 
 
 def _word_col_indices(wcol: np.ndarray, bit: int) -> np.ndarray:
@@ -121,12 +127,33 @@ def _word_col_indices(wcol: np.ndarray, bit: int) -> np.ndarray:
     return np.nonzero((wcol >> np.uint32(bit)) & np.uint32(1))[0]
 
 
-def _ids_for(ids: np.ndarray, idx: np.ndarray, ph) -> list:
+_log = logging.getLogger(__name__)
+
+
+def _ids_for(ids: np.ndarray, idx: np.ndarray, ph, mask) -> tuple:
     """Materialize an allowed-id list, dropping the phantom column's
-    reserved id (part of every type's universe, never emitted)."""
+    reserved id (part of every type's universe, never emitted).
+
+    Defense in depth: ALL internal placeholder ids carry a NUL prefix
+    (phantom + spare rows) and must never reach a client of an
+    authorization proxy.  A non-phantom placeholder surviving to this
+    point indicates an id-view/bitmap inconsistency — suppress it (fail
+    closed, via the C-speed mask) and report it: returns
+    (id list, suppressed count, sample names) for the caller to count
+    under its lock and log with its capture fingerprint; tests assert
+    the counter stays zero."""
     if ph is not None:
         idx = idx[idx != ph]
-    return ids[idx].tolist()
+    bad_n, bad_sample = 0, []
+    sel = mask[idx]
+    if sel.any():
+        bad_n = int(sel.sum())
+        bad_sample = ids[idx[sel][:4]].tolist()
+        idx = idx[~sel]
+    return ids[idx].tolist(), bad_n, bad_sample
+
+
+
 
 
 def _rel_from_key(key: tuple) -> Relationship:
@@ -1225,6 +1252,16 @@ class JaxEndpoint(PermissionsEndpoint):
                             checked_at=at)
                 for (v, at) in results]
 
+    def _report_suppressed(self, n: int, sample: list, context) -> None:
+        """Count (under the lock — callers run lock-free) and log a
+        placeholder suppression with the caller's capture fingerprint."""
+        with self._lock:
+            self.stats["placeholder_suppressed"] = (
+                self.stats.get("placeholder_suppressed", 0) + n)
+        _log.warning("suppressed %d internal placeholder ids from lookup "
+                     "result (id-view/bitmap inconsistency): %r capture=%r",
+                     n, sample, context)
+
     async def _off_loop(self, fn, *args):
         """Run a device-touching sync path in the executor: a fused
         1M-graph batch holds the kernel + transfer + unpack for hundreds
@@ -1272,9 +1309,12 @@ class JaxEndpoint(PermissionsEndpoint):
                     # cache read must serialize with it (the captured
                     # array is consistent with `snap` — rows renamed
                     # later are dead in this snapshot)
-                    ids = _object_ids_np(graph, resource_type)
+                    ids, mask = _object_ids_np(graph, resource_type)
                     ph = graph.prog.object_index[resource_type].get(
                         PHANTOM_ID)
+                    _forensic = (id(graph), self._graph_revision,
+                                 self.stats.get("spare_assignments"),
+                                 id(ids), threading.get_ident())
                     self.stats["kernel_calls"] += 1
         if oracle:
             # host evaluation outside the lock (reads the live store)
@@ -1288,7 +1328,10 @@ class JaxEndpoint(PermissionsEndpoint):
         else:
             bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
             idx = np.nonzero(bitmap[:, col])[0]
-        return _ids_for(ids, idx, ph)
+        out, bad_n, bad_sample = _ids_for(ids, idx, ph, mask)
+        if bad_n:
+            self._report_suppressed(bad_n, bad_sample, _forensic)
+        return out
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
@@ -1326,8 +1369,11 @@ class JaxEndpoint(PermissionsEndpoint):
                 q_arr, cols, unknown = self._encode_subjects(graph, subjects)
                 snap = graph.snapshot()
                 # captured under the lock — see _lookup_sync
-                ids = _object_ids_np(graph, resource_type)
+                ids, mask = _object_ids_np(graph, resource_type)
                 ph = graph.prog.object_index[resource_type].get(PHANTOM_ID)
+                _forensic = (id(graph), self._graph_revision,
+                             self.stats.get("spare_assignments"),
+                             id(ids), threading.get_ident())
                 self.stats["kernel_calls"] += 1
         if all_oracle:
             # host evaluation outside the lock (reads the live store)
@@ -1359,8 +1405,11 @@ class JaxEndpoint(PermissionsEndpoint):
             col = cols[s]
             lst = per_col_ids.get(col)
             if lst is None:
-                lst = per_col_ids[col] = _ids_for(
-                    ids, col_indices(col), ph)
+                lst, bad_n, bad_sample = _ids_for(
+                    ids, col_indices(col), ph, mask)
+                if bad_n:
+                    self._report_suppressed(bad_n, bad_sample, _forensic)
+                per_col_ids[col] = lst
             out.append(lst)
         return out
 

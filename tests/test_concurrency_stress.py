@@ -275,9 +275,17 @@ def test_lookups_race_spare_assigning_writes(endpoint_url):
                     "doc", "view", SubjectRef("user", "u0"))
                 got = set(ids)
                 if any("\x00" in i for i in got):
+                    bad = [i for i in got if chr(0) in i]
+                    inner_ep = getattr(ep, "inner", ep)
+                    with inner_ep._lock:
+                        # leak family: placeholder still unassigned in the
+                        # CURRENT index => the kernel lit a dead row;
+                        # renamed away => a stale id view was used
+                        fam = {n: inner_ep._graph.prog.object_index["doc"]
+                               .get(n, "renamed-away") for n in bad[:6]}
                     errors.append(
-                        f"placeholder leak: "
-                        f"{[i for i in got if chr(0) in i]} [{diag()}]")
+                        f"placeholder leak: {bad[:6]} families={fam} "
+                        f"[{diag()}]")
                     return
                 # read-your-writes: ids created before the call started
                 missing = [c for c in created[:mark] if c not in got]
@@ -293,5 +301,10 @@ def test_lookups_race_spare_assigning_writes(endpoint_url):
         final = set(await ep.lookup_resources(
             "doc", "view", SubjectRef("user", "u0")))
         assert all(f"new-{k}" in final for k in range(60))
+        # the product fails closed on internal-placeholder leakage and
+        # counts it; the tripwire is the counter staying zero
+        inner_ep = getattr(ep, "inner", ep)
+        assert inner_ep.stats.get("placeholder_suppressed", 0) == 0, \
+            f"placeholder suppression fired [{diag()}]"
 
     asyncio.run(go())
