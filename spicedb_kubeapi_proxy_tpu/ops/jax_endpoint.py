@@ -1283,6 +1283,18 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def _lookup_sync(self, resource_type: str, permission: str,
                      subject: SubjectRef) -> list:
+        """One retry on placeholder suppression: a suppressed result was
+        built from an id view detected inconsistent with the bitmap, so
+        re-capturing against the current graph returns the correct,
+        complete answer instead of a truncated one (the counter and log
+        still record the event)."""
+        out, bad_n = self._lookup_once(resource_type, permission, subject)
+        if bad_n:
+            out, _ = self._lookup_once(resource_type, permission, subject)
+        return out
+
+    def _lookup_once(self, resource_type: str, permission: str,
+                     subject: SubjectRef) -> tuple:
         self.schema.definition(resource_type)  # raises like the oracle
         oracle = False
         with self._lock:
@@ -1319,7 +1331,7 @@ class JaxEndpoint(PermissionsEndpoint):
         if oracle:
             # host evaluation outside the lock (reads the live store)
             return self._oracle.lookup_resources(resource_type, permission,
-                                                 subject)
+                                                 subject), 0
         # kernel + extraction outside the lock (immutable snapshot)
         if hasattr(graph, "run_lookup_packed"):
             packed = graph.run_lookup_packed(rng[0], rng[1], q_arr, snap=snap)
@@ -1331,7 +1343,7 @@ class JaxEndpoint(PermissionsEndpoint):
         out, bad_n, bad_sample = _ids_for(ids, idx, ph, mask)
         if bad_n:
             self._report_suppressed(bad_n, bad_sample, _forensic)
-        return out
+        return out, bad_n
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
@@ -1355,6 +1367,16 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def _lookup_batch_sync(self, resource_type: str, permission: str,
                            subjects: list) -> list:
+        """One retry on placeholder suppression — see _lookup_sync."""
+        out, bad_n = self._lookup_batch_once(resource_type, permission,
+                                             subjects)
+        if bad_n:
+            out, _ = self._lookup_batch_once(resource_type, permission,
+                                             subjects)
+        return out
+
+    def _lookup_batch_once(self, resource_type: str, permission: str,
+                           subjects: list) -> tuple:
         self.schema.definition(resource_type)
         all_oracle = False
         with self._lock:
@@ -1378,7 +1400,7 @@ class JaxEndpoint(PermissionsEndpoint):
         if all_oracle:
             # host evaluation outside the lock (reads the live store)
             return [self._oracle.lookup_resources(resource_type, permission, s)
-                    for s in subjects]
+                    for s in subjects], 0
         # kernel + extraction outside the lock (immutable snapshot)
         if hasattr(graph, "run_lookup_packed"):
             # packed fast path: per-column shift/AND/nonzero over one
@@ -1397,6 +1419,7 @@ class JaxEndpoint(PermissionsEndpoint):
 
         per_col_ids: dict = {}  # column -> id list (columns are shared)
         out = []
+        total_bad = 0
         for s in subjects:
             if s in unknown:
                 out.append(self._oracle.lookup_resources(
@@ -1408,10 +1431,11 @@ class JaxEndpoint(PermissionsEndpoint):
                 lst, bad_n, bad_sample = _ids_for(
                     ids, col_indices(col), ph, mask)
                 if bad_n:
+                    total_bad += bad_n
                     self._report_suppressed(bad_n, bad_sample, _forensic)
                 per_col_ids[col] = lst
             out.append(lst)
-        return out
+        return out, total_bad
 
     async def lookup_resources_batch(self, resource_type: str, permission: str,
                                      subjects: list) -> list:
