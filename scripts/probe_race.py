@@ -1,0 +1,233 @@
+"""Deterministic hunt for the stale-id-view/bitmap race (VERDICT r4 item 1).
+
+Reproduces tests/test_concurrency_stress.py::test_lookups_race_spare_assigning_writes
+in a tight loop with deep instrumentation: every rename / cache build /
+capture is logged to a ring buffer with thread ids and sequence numbers;
+the moment a suppression fires we freeze the endpoint lock and dump
+  - the captured ids array vs the CURRENT program id at each bad index,
+  - host vs device table contents for the affected rows,
+  - the last N instrumentation events.
+
+Run: JAX_PLATFORMS=cpu PYTHONPATH=/root/repo python scripts/probe_race.py [rounds]
+"""
+
+import asyncio
+import itertools
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import spicedb_kubeapi_proxy_tpu.ops.jax_endpoint as je
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap, create_endpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = """
+definition user {}
+definition group { relation member: user | group#member }
+definition doc {
+  relation viewer: user | group#member
+  relation banned: user
+  permission view = viewer - banned
+}
+"""
+
+N_DOCS = 24
+N_USERS = 12
+
+EVENTS: deque = deque(maxlen=400)
+SEQ = itertools.count()
+FROZEN = threading.Event()   # set on first suppression: stop the world
+REPORT: list = []
+
+
+def log_event(kind, **kw):
+    EVENTS.append((next(SEQ), time.monotonic(), threading.get_ident(),
+                   kind, kw))
+
+
+def seed_rels():
+    out = []
+    for d in range(N_DOCS):
+        out.append(f"doc:d{d}#viewer@user:u{d % N_USERS}")
+        out.append(f"doc:d{d}#viewer@group:g{d % 3}#member")
+    for u in range(N_USERS):
+        out.append(f"group:g{u % 3}#member@user:u{u}")
+    return out
+
+
+def install_instrumentation():
+    orig_rename = je.JaxEndpoint._rename_row
+
+    def rename_logged(self, graph, type_name, old_id, new_id):
+        local = graph.prog.object_index[type_name].get(old_id)
+        ok = orig_rename(self, graph, type_name, old_id, new_id)
+        cache = getattr(graph, "_ids_np_cache", None)
+        log_event("rename", graph=id(graph), t=type_name, old=old_id,
+                  new=new_id, local=local, ok=ok,
+                  cache_entry=id(cache.get(type_name)) if cache else None)
+        return ok
+
+    je.JaxEndpoint._rename_row = rename_logged
+
+    orig_ids_np = je._object_ids_np
+
+    def ids_np_logged(graph, resource_type):
+        cache = getattr(graph, "_ids_np_cache", None)
+        had = cache is not None and resource_type in cache
+        out = orig_ids_np(graph, resource_type)
+        log_event("ids_np", graph=id(graph), t=resource_type,
+                  cached=had, arr=id(out[0]),
+                  n_ph=int(out[1].sum()))
+        return out
+
+    je._object_ids_np = ids_np_logged
+
+    orig_ids_for = je._ids_for
+    capture: dict = {}
+
+    def ids_for_logged(ids, idx, ph, mask):
+        out, bad_n, bad_sample = orig_ids_for(ids, idx, ph, mask)
+        if bad_n:
+            bad_idx = idx[mask[idx]]
+            capture[threading.get_ident()] = (ids, np.array(idx), ph,
+                                              np.array(bad_idx))
+        return out, bad_n, bad_sample
+
+    je._ids_for = ids_for_logged
+
+    orig_report = je.JaxEndpoint._report_suppressed
+
+    def report_logged(self, n, sample, context):
+        orig_report(self, n, sample, context)
+        with self._lock:
+            ids, idx, ph, bad_idx = capture.get(threading.get_ident(),
+                                                (None, None, None, None))
+            graph = self._graph
+            lines = [f"=== SUPPRESSION n={n} sample={sample!r} "
+                     f"context={context!r}"]
+            lines.append(f"current graph={id(graph)} "
+                         f"rev={self._graph_revision} "
+                         f"spare_assignments={self.stats.get('spare_assignments')} "
+                         f"reclaims={self.stats.get('spare_reclaims')} "
+                         f"rebuilds={self.stats.get('rebuilds')}")
+            if ids is not None and graph is not None:
+                cur = graph.prog.object_ids.get("doc")
+                cache = getattr(graph, "_ids_np_cache", {})
+                ce = cache.get("doc")
+                lines.append(
+                    f"captured arr id={id(ids)} len={len(ids)}; current "
+                    f"cache entry arr id={id(ce[0]) if ce else None}; "
+                    f"current prog list len={len(cur) if cur else 0}")
+                for b in np.asarray(bad_idx).tolist()[:8]:
+                    cur_id = cur[b] if cur and b < len(cur) else "<oob>"
+                    lines.append(
+                        f"  local={b}: captured={ids[b]!r} current={cur_id!r}")
+                    rng = graph.prog.slot_range("doc", "view")
+                    if rng:
+                        row = rng[0] + b
+                        hm = getattr(graph, "host_main", None)
+                        if hm is not None:
+                            dm = np.asarray(graph.dev_main[row])
+                            lines.append(f"    state_row={row} "
+                                         f"host_main={hm[row].tolist()} "
+                                         f"dev_main={dm.tolist()} "
+                                         f"dirty={row in graph._dirty_main}")
+                        rngv = graph.prog.slot_range("doc", "viewer")
+                        if rngv:
+                            rowv = rngv[0] + b
+                            if hm is not None:
+                                dmv = np.asarray(graph.dev_main[rowv])
+                                lines.append(
+                                    f"    viewer_row={rowv} "
+                                    f"host_main={hm[rowv].tolist()} "
+                                    f"dev_main={dmv.tolist()} "
+                                    f"dirty={rowv in graph._dirty_main}")
+            lines.append("--- last events (most recent last):")
+            for ev in list(EVENTS):
+                lines.append(f"  {ev}")
+            REPORT.append("\n".join(lines))
+            FROZEN.set()
+
+    je.JaxEndpoint._report_suppressed = report_logged
+
+
+async def run_round(round_no):
+    ep = create_endpoint("jax://?dispatch=direct",
+                         Bootstrap(schema_text=SCHEMA))
+    ep.store.bulk_load([parse_relationship(r) for r in seed_rels()])
+    inner = getattr(ep, "inner", ep)
+    stop = asyncio.Event()
+    created: list = []
+    errors: list = []
+
+    async def writer(wid):
+        # churn: create AND delete so spare assign + reclaim both cycle
+        for k in range(80):
+            if FROZEN.is_set():
+                break
+            rel = f"doc:new-{wid}-{k}#viewer@user:u0"
+            await ep.write_relationships([RelationshipUpdate(
+                UpdateOp.TOUCH, parse_relationship(rel))])
+            created.append(f"new-{wid}-{k}")
+            if k % 3 == 2:  # delete an older one -> reclaim
+                victim = f"doc:new-{wid}-{k-2}#viewer@user:u0"
+                await ep.write_relationships([RelationshipUpdate(
+                    UpdateOp.DELETE, parse_relationship(victim))])
+                created.remove(f"new-{wid}-{k-2}")
+            await asyncio.sleep(0)
+
+    async def reader(rid):
+        while not stop.is_set() and not FROZEN.is_set():
+            ids = await ep.lookup_resources(
+                "doc", "view", SubjectRef("user", "u0"))
+            bad = [i for i in ids if "\x00" in i]
+            if bad:
+                errors.append(f"LEAK (post-retry): {bad[:6]}")
+                FROZEN.set()
+                return
+            await asyncio.sleep(0)
+
+    async def writers():
+        # readers stop only after ALL writers finish: the tail of one
+        # writer's churn must still race concurrent lookups
+        await asyncio.gather(writer(0), writer(1))
+        stop.set()
+
+    await asyncio.wait_for(
+        asyncio.gather(writers(), *[reader(i) for i in range(6)]), 180)
+    return inner.stats.get("placeholder_suppressed", 0), errors
+
+
+def main():
+    install_instrumentation()
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 200
+    t0 = time.time()
+    for r in range(rounds):
+        supp, errors = asyncio.run(run_round(r))
+        if errors:
+            print("ERRORS:", errors)
+        if supp or FROZEN.is_set():
+            print(f"\n*** race fired in round {r} "
+                  f"(suppressed={supp}, {time.time()-t0:.1f}s in)\n")
+            for rep in REPORT:
+                print(rep)
+            return 1
+        if r % 10 == 0:
+            print(f"round {r} clean ({time.time()-t0:.1f}s)", flush=True)
+    print(f"no race in {rounds} rounds ({time.time()-t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,13 +73,36 @@ _MIN_EDGE_BUCKET = 256
 _MIN_BATCH_BUCKET = 8
 
 
+_warned_min_batch_words: set = set()
+
+
 def _min_batch_words() -> int:
     """Floor for the packed batch width (env-tunable, read per call so
-    tests can flip it)."""
+    tests can flip it).  Malformed values are loudly rejected (once per
+    value) instead of silently ignored, and the floor is rounded up to a
+    power of two so batch_words' doubling from it keeps producing
+    power-of-two word buckets — non-pow2 floors would fragment the jit
+    cache that bucketing exists to bound."""
+    raw = os.environ.get("SPICEDB_TPU_MIN_BATCH_WORDS", "1")
     try:
-        return max(1, int(os.environ.get("SPICEDB_TPU_MIN_BATCH_WORDS", "1")))
+        v = int(raw)
+        if v < 1:
+            raise ValueError(raw)
     except ValueError:
+        if raw not in _warned_min_batch_words:
+            _warned_min_batch_words.add(raw)
+            _log.error("ignoring malformed SPICEDB_TPU_MIN_BATCH_WORDS=%r "
+                       "(expected a positive integer); using 1", raw)
         return 1
+    p = 1
+    while p < v:
+        p <<= 1
+    if p != v and raw not in _warned_min_batch_words:
+        _warned_min_batch_words.add(raw)
+        _log.warning("SPICEDB_TPU_MIN_BATCH_WORDS=%d is not a power of two; "
+                     "rounding up to %d (non-pow2 floors fragment the jit "
+                     "bucket cache)", v, p)
+    return p
 
 # One synthetic zero-tuple subject per type is compiled into every graph:
 # a subject that appears in no tuple can differ from any other zero-tuple
@@ -111,6 +134,7 @@ def _object_ids_np(graph, resource_type: str) -> tuple:
     cache = getattr(graph, "_ids_np_cache", None)
     if cache is None:
         cache = graph._ids_np_cache = {}
+        graph._ids_np_published = set()
     entry = cache.get(resource_type)
     if entry is None:
         lst = graph.prog.object_ids[resource_type]
@@ -118,6 +142,9 @@ def _object_ids_np(graph, resource_type: str) -> tuple:
         mask = np.fromiter(("\x00" in i for i in lst), dtype=bool,
                            count=len(lst))
         entry = cache[resource_type] = (arr, mask)
+    # the pair escapes the lock with the caller: renames must now
+    # copy-on-write instead of patching it in place (see _rename_row)
+    graph._ids_np_published.add(resource_type)
     return entry
 
 
@@ -358,6 +385,7 @@ class _EllGraph:
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
         self._dirty_cav: set = set()
+        self._grow_extra: dict = {}  # root row -> levels grown past build
         # first cav-aux row index: values >= this in the cav table are
         # OR-tree nodes whose children live in the cav table itself
         self._cav_aux_base = prog.state_size + a_shared
@@ -409,15 +437,27 @@ class _EllGraph:
             return True
         return self._remove_pairs(pairs)
 
+    # Repeated growth on one destination nests OR-tree levels beyond the
+    # single extra level the kernel's Gauss-Seidel sweep budget
+    # (aux_passes = shared_tree_depth + 1) covers; correctness survives
+    # via the outer while_loop fixpoint, but each level past the budget
+    # costs one extra outer iteration for queries touching that hub.
+    # Cap the degradation: past this many extra levels on one root, fall
+    # back to a rebuild (which recompiles with the true tree height).
+    _GROW_EXTRA_MAX = 3
+
     def _grow(self, root_row: int, src: int) -> bool:
         """Full main row (no dead slot anywhere in its tree): move the
         row's direct entries into a spare aux node, append `src` there,
         and point the row at the node — one extra OR-tree level for this
         destination, no rebuild.  Monotone OR gates make this exactly
-        equivalent; the kernel's iteration cap (50x(1+tree_depth)) has
-        ample headroom for the few growth events between rebuilds."""
+        equivalent; the first extra level rides the aux_passes budget and
+        levels past _GROW_EXTRA_MAX force a rebuild (see above)."""
         if not self._spare_aux:
             return False
+        grown = self._grow_extra.get(root_row, 0)
+        if grown >= self._GROW_EXTRA_MAX:
+            return False  # budget exhausted for this hub: rebuild
         row = self.host_main[root_row].copy()
         if len(row) + 1 > self.host_aux.shape[1]:
             # K_MAIN tuned >= K_AUX: the row's children + the new source
@@ -431,6 +471,7 @@ class _EllGraph:
         self.host_main[root_row, 0] = n + j
         self.host_main[root_row, 1:] = self.prog.dead_index
         self._dirty_main.add(root_row)
+        self._grow_extra[root_row] = grown + 1
         return True
 
     def add_rel(self, rel: Relationship) -> bool:
@@ -624,6 +665,7 @@ class _ShardedEllGraph(_EllGraph):
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
         self._dirty_cav: set = set()
+        self._grow_extra: dict = {}  # root row -> levels grown past build
 
     def flush(self) -> bool:
         changed = False
@@ -829,10 +871,18 @@ class JaxEndpoint(PermissionsEndpoint):
         # bulk_load, where no previous program exists).
         prev_counts = (self._graph.prog.num_objects
                        if self._graph is not None else {})
+        # num_objects includes the previous generation's synthetic rows
+        # (1 phantom + the unassigned spare placeholders); subtract them
+        # so pool sizing tracks the REAL universe instead of compounding
+        # by ~1/64 at every rebuild (assigned spares are real objects now
+        # and correctly stay counted)
+        prev_synthetic = ({t: 1 + len(pool)
+                           for t, pool in self._spare_pool.items()}
+                          if self._graph is not None else {})
         extra = {}
         self._spare_pool = {}
         for t in self.schema.definitions:
-            n_t = max(prev_counts.get(t, 0),
+            n_t = max(prev_counts.get(t, 0) - prev_synthetic.get(t, 0),
                       len(self.store.object_ids_of_type(t)))
             n_spare = max(_SPARE_FLOOR, n_t // _SPARE_DIVISOR)
             spares = [f"{_SPARE_PREFIX}{k}" for k in range(n_spare)]
@@ -918,7 +968,8 @@ class JaxEndpoint(PermissionsEndpoint):
         the program's id maps (slot layout, row count, and device tables
         are untouched — the row exists, dead, in every slot of the type).
         Runs under self._lock; the graph's cached numpy id view is
-        invalidated."""
+        patched copy-on-write (see _rename_row — never invalidated, and
+        never mutated in place across a drain-epoch boundary)."""
         pool = self._spare_pool.get(type_name)
         if not pool:
             return False
@@ -927,11 +978,25 @@ class JaxEndpoint(PermissionsEndpoint):
         self.stats["spare_assignments"] += 1
         return True
 
-    @staticmethod
-    def _rename_row(graph, type_name: str, old_id: str, new_id: str) -> bool:
+    def _rename_row(self, graph, type_name: str, old_id: str,
+                    new_id: str) -> bool:
         """Rename one object row in the program's id maps (the single
         place the rename discipline lives — assignment and reclaim both
-        use it); invalidates the graph's cached numpy id view."""
+        use it) and patch the graph's cached numpy id view copy-on-write.
+
+        COW, not in-place: lookups capture the cached (arr, mask) pair
+        under the lock and fancy-index it OUTSIDE the lock against their
+        own snapshot — mutating a pair a released lock hold may have
+        captured would corrupt those in-flight results (a reclaim rename
+        would suppress ids that were legitimately live at the captured
+        revision).  _object_ids_np marks an entry PUBLISHED when it
+        hands it to a caller; only published entries are copied before
+        patching (the fresh copy is private until the next capture, so
+        write-heavy/lookup-idle churn patches in place and never pays
+        the O(universe) copy).  This replaces dropping the entry
+        wholesale, which made every post-churn lookup rebuild an
+        O(universe) object array + NUL-mask scan under the lock
+        (~tens of ms on the 1M graph)."""
         prog = graph.prog
         local = prog.object_index[type_name].pop(old_id, None)
         if local is None:
@@ -940,7 +1005,17 @@ class JaxEndpoint(PermissionsEndpoint):
         prog.object_ids[type_name][local] = new_id
         cache = getattr(graph, "_ids_np_cache", None)
         if cache is not None:
-            cache.pop(type_name, None)
+            entry = cache.get(type_name)
+            if entry is not None:
+                arr, mask = entry
+                published = graph._ids_np_published
+                if type_name in published:
+                    arr = arr.copy()
+                    mask = mask.copy()
+                    cache[type_name] = (arr, mask)
+                    published.discard(type_name)
+                arr[local] = new_id
+                mask[local] = "\x00" in new_id
         return True
 
     def _note_key_applied(self, key: tuple) -> None:
@@ -1287,11 +1362,34 @@ class JaxEndpoint(PermissionsEndpoint):
         built from an id view detected inconsistent with the bitmap, so
         re-capturing against the current graph returns the correct,
         complete answer instead of a truncated one (the counter and log
-        still record the event)."""
+        still record the event).  If the re-capture is ALSO inconsistent,
+        fall back to the host oracle: complete, fail-safe results beat a
+        silently truncated list with no failure signal to the caller."""
         out, bad_n = self._lookup_once(resource_type, permission, subject)
         if bad_n:
-            out, _ = self._lookup_once(resource_type, permission, subject)
+            self._purge_ids_view(resource_type)
+            out, bad_n = self._lookup_once(resource_type, permission, subject)
+            if bad_n:
+                with self._lock:
+                    self.stats["suppression_oracle_fallbacks"] = (
+                        self.stats.get("suppression_oracle_fallbacks", 0) + 1)
+                out = self._oracle.lookup_resources(resource_type, permission,
+                                                    subject)
         return out
+
+    def _purge_ids_view(self, resource_type: str) -> None:
+        """Drop the current graph's cached id view for a type so the
+        retry rebuilds it fresh from prog.object_ids: with copy-on-write
+        patching a diverged (arr, mask) entry would otherwise persist
+        for the graph generation's lifetime and defeat the retry."""
+        with self._lock:
+            graph = self._graph
+            if graph is None:
+                return
+            cache = getattr(graph, "_ids_np_cache", None)
+            if cache is not None:
+                cache.pop(resource_type, None)
+                graph._ids_np_published.discard(resource_type)
 
     def _lookup_once(self, resource_type: str, permission: str,
                      subject: SubjectRef) -> tuple:
@@ -1367,12 +1465,21 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def _lookup_batch_sync(self, resource_type: str, permission: str,
                            subjects: list) -> list:
-        """One retry on placeholder suppression — see _lookup_sync."""
+        """One retry on placeholder suppression, then host-oracle
+        fallback on a second inconsistency — see _lookup_sync."""
         out, bad_n = self._lookup_batch_once(resource_type, permission,
                                              subjects)
         if bad_n:
-            out, _ = self._lookup_batch_once(resource_type, permission,
-                                             subjects)
+            self._purge_ids_view(resource_type)
+            out, bad_n = self._lookup_batch_once(resource_type, permission,
+                                                 subjects)
+            if bad_n:
+                with self._lock:
+                    self.stats["suppression_oracle_fallbacks"] = (
+                        self.stats.get("suppression_oracle_fallbacks", 0) + 1)
+                out = [self._oracle.lookup_resources(resource_type,
+                                                     permission, s)
+                       for s in subjects]
         return out
 
     def _lookup_batch_once(self, resource_type: str, permission: str,
