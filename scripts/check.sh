@@ -13,8 +13,16 @@ cd "$(dirname "$0")/.."
 echo "== syntax gate (compileall)"
 python -m compileall -q spicedb_kubeapi_proxy_tpu tests bench.py __graft_entry__.py
 
-echo "== unit + e2e suites (pytest)"
-python -m pytest tests/ -q
+echo "== lint gate (scripts/lint.py; CI additionally runs ruff)"
+python scripts/lint.py
+
+if [[ "${1:-}" != "--fast" ]]; then
+  echo "== unit + e2e suites with line coverage (pytest via scripts/cov.py)"
+  python scripts/cov.py tests/ -q
+else
+  echo "== unit + e2e suites (pytest)"
+  python -m pytest tests/ -q
+fi
 
 echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"
 JAX_PLATFORMS=cpu python __graft_entry__.py 8

@@ -1,0 +1,107 @@
+"""Zero-dependency line-coverage runner (reference CI uploads codecov,
+/root/reference/.github/workflows/build-test.yaml:70-73; this sandbox has
+no coverage/pytest-cov baked in, so the local gate uses CPython 3.12's
+sys.monitoring (PEP 669) — near-zero overhead because every (code, line)
+location disables itself after its first hit.  CI additionally runs real
+pytest-cov, see .github/workflows/build-test.yaml).
+
+Usage:
+    python scripts/cov.py [pytest args...]      # default: tests/ -q
+
+Writes COVERAGE.json ({"total_pct": ..., "files": {...}}) and prints a
+per-package summary.  Lines executed only in subprocesses (the CLI e2e
+tests spawn `python -m spicedb_kubeapi_proxy_tpu`) are not counted —
+the number is a floor.
+"""
+
+import ast
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = str(REPO / "spicedb_kubeapi_proxy_tpu")
+
+executed: dict = {}   # filename -> set of line numbers
+
+
+def _on_line(code, line):
+    fn = code.co_filename
+    if fn.startswith(PKG):
+        executed.setdefault(fn, set()).add(line)
+    return sys.monitoring.DISABLE  # one hit per location is enough
+
+
+def install():
+    mon = sys.monitoring
+    mon.use_tool_id(mon.COVERAGE_ID, "spicedb-tpu-cov")
+    mon.register_callback(mon.COVERAGE_ID, mon.events.LINE, _on_line)
+    mon.set_events(mon.COVERAGE_ID, mon.events.LINE)
+
+
+def executable_lines(path: Path) -> set:
+    """Approximate executable lines: every statement node's first line
+    (matches what the LINE event reports for straight-line code; doc-
+    strings and blank/comment lines are excluded by construction)."""
+    tree = ast.parse(path.read_text())
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt):
+            lineno = node.lineno
+            # a def/class statement's body counts separately; the header
+            # line itself executes (binding), so keep it
+            if (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                continue  # docstring
+            out.add(lineno)
+    return out
+
+
+def report() -> dict:
+    files = {}
+    tot_exec = tot_hit = 0
+    for py in sorted(Path(PKG).rglob("*.py")):
+        ex = executable_lines(py)
+        if not ex:
+            continue
+        hit = executed.get(str(py), set()) & ex
+        rel = str(py.relative_to(REPO))
+        files[rel] = {"executable": len(ex), "covered": len(hit),
+                      "pct": round(100.0 * len(hit) / len(ex), 1)}
+        tot_exec += len(ex)
+        tot_hit += len(hit)
+    total = round(100.0 * tot_hit / max(1, tot_exec), 1)
+    out = {"total_pct": total, "executable_lines": tot_exec,
+           "covered_lines": tot_hit, "files": files,
+           "note": "sys.monitoring line coverage; subprocess execution "
+                   "(CLI e2e) not counted — treat as a floor"}
+    (REPO / "COVERAGE.json").write_text(json.dumps(out, indent=1))
+    return out
+
+
+def main():
+    os.chdir(REPO)
+    # pytest.main() from this script does not put the repo root on
+    # sys.path the way `python -m pytest` does
+    sys.path.insert(0, str(REPO))
+    install()
+    import pytest
+    args = sys.argv[1:] or ["tests/", "-q"]
+    rc = pytest.main(args)
+    sys.monitoring.set_events(sys.monitoring.COVERAGE_ID, 0)
+    out = report()
+    worst = sorted(out["files"].items(), key=lambda kv: kv[1]["pct"])[:10]
+    print("\n== coverage (sys.monitoring floor; subprocesses uncounted)")
+    for rel, st in worst:
+        print(f"  {st['pct']:5.1f}%  {rel} "
+              f"({st['covered']}/{st['executable']})")
+    print(f"TOTAL {out['total_pct']}% "
+          f"({out['covered_lines']}/{out['executable_lines']} lines) "
+          f"-> COVERAGE.json")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

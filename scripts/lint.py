@@ -1,0 +1,163 @@
+"""Zero-dependency lint gate (reference runs golangci-lint in CI,
+/root/reference/.github/workflows/build-test.yaml:56-92 and
+magefiles/lint.go; this sandbox has no ruff/flake8 baked in, so the
+local gate is an AST pass over the same high-signal rule families —
+CI additionally runs real ruff, see .github/workflows/build-test.yaml).
+
+Checks:
+  F401  unused import (module scope; `__future__` exempt)
+  E722  bare `except:`
+  B006  mutable default argument
+  E711  comparison to None with ==/!=
+  F811  redefinition of a top-level def/class in the same scope
+  W291  trailing whitespace
+  E501  line longer than 100 characters
+  TAB   hard tab in indentation
+
+(E712 `== True` is deliberately NOT enforced: the codebase compares
+numpy bools where `is True` would silently change semantics.)
+
+Exit 1 on any finding.  Usage: python scripts/lint.py [paths...]
+"""
+
+import ast
+import sys
+from pathlib import Path
+
+DEFAULT_PATHS = ["spicedb_kubeapi_proxy_tpu", "tests", "scripts",
+                 "bench.py", "__graft_entry__.py"]
+MAX_LINE = 100
+
+
+def iter_py(paths):
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+class Visitor(ast.NodeVisitor):
+    def __init__(self, findings, path):
+        self.findings = findings
+        self.path = path
+        self.imports: dict = {}   # name -> (lineno, import stmt text)
+        self.used: set = set()
+        self.toplevel_defs: dict = {}
+
+    def visit_Import(self, node):
+        for a in node.names:
+            name = (a.asname or a.name).split(".")[0]
+            self.imports[name] = node.lineno
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.imports[a.asname or a.name] = node.lineno
+        self.generic_visit(node)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        if node.type is None:
+            self.findings.append(
+                (self.path, node.lineno, "E722", "bare `except:`"))
+        self.generic_visit(node)
+
+    def _check_defaults(self, node):
+        for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                self.findings.append(
+                    (self.path, d.lineno, "B006",
+                     "mutable default argument"))
+
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        for op, cmp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq)):
+                if isinstance(cmp, ast.Constant) and cmp.value is None:
+                    self.findings.append(
+                        (self.path, node.lineno, "E711",
+                         "comparison to None with ==/!= (use is/is not)"))
+        self.generic_visit(node)
+
+
+def lint_file(path, findings):
+    text = path.read_text()
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        findings.append((path, e.lineno or 0, "E999", f"syntax error: {e}"))
+        return
+    v = Visitor(findings, path)
+    v.visit(tree)
+
+    # unused imports: names imported at module scope and never loaded
+    # anywhere in the file (conservative: attribute/string uses of the
+    # name are caught by the Load-name scan; __all__ and re-exports in
+    # __init__.py are exempt)
+    src_names = v.used
+    exempt = path.name == "__init__.py" or "__all__" in text
+    if not exempt:
+        for name, lineno in v.imports.items():
+            if name not in src_names and f"{name}." not in text:
+                findings.append((path, lineno, "F401",
+                                 f"unused import `{name}`"))
+
+    # top-level redefinitions
+    seen: dict = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.name in seen:
+                findings.append((path, node.lineno, "F811",
+                                 f"redefinition of `{node.name}` "
+                                 f"(first at line {seen[node.name]})"))
+            seen[node.name] = node.lineno
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if line != line.rstrip():
+            findings.append((path, i, "W291", "trailing whitespace"))
+        if len(line) > MAX_LINE:
+            findings.append((path, i, "E501",
+                             f"line too long ({len(line)} > {MAX_LINE})"))
+        stripped = line.lstrip(" ")
+        if stripped.startswith("\t"):
+            findings.append((path, i, "TAB", "hard tab in indentation"))
+
+
+def main():
+    paths = sys.argv[1:] or DEFAULT_PATHS
+    findings: list = []
+    n = 0
+    for f in iter_py(paths):
+        n += 1
+        lint_file(f, findings)
+    for path, lineno, code, msg in sorted(findings,
+                                          key=lambda x: (str(x[0]), x[1])):
+        print(f"{path}:{lineno}: {code} {msg}")
+    print(f"lint: {n} files, {len(findings)} findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

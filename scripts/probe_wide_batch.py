@@ -18,7 +18,6 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from spicedb_kubeapi_proxy_tpu.models import workloads as wl
-from spicedb_kubeapi_proxy_tpu.ops import jax_endpoint as je
 from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
 from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
 from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef

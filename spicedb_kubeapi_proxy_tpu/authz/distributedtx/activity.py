@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import hashlib
 import time
-from typing import Optional
 
 from ...proxy.httpcore import Headers, Request, Transport
 from ...spicedb.endpoints import PermissionsEndpoint

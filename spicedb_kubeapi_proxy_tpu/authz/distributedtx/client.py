@@ -4,7 +4,6 @@ workflows and the activities."""
 
 from __future__ import annotations
 
-from typing import Optional
 
 from ...proxy.httpcore import Transport
 from ...spicedb.endpoints import PermissionsEndpoint
@@ -12,10 +11,8 @@ from .activity import ActivityHandler
 from .engine import WorkflowEngine
 from .journal import MemoryJournal, SQLiteJournal
 from .workflow import (
-    STRATEGY_OPTIMISTIC,
     STRATEGY_PESSIMISTIC,
-    WORKFLOWS,
-)
+    WORKFLOWS)
 
 
 def setup_workflow_engine(endpoint: PermissionsEndpoint,

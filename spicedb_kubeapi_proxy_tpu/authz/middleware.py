@@ -7,18 +7,14 @@ filter / prefilter+response-filter / post-check / post-filter path.
 
 from __future__ import annotations
 
-import json
-from typing import Optional
 
 from ..proxy.httpcore import Handler, Request, Response, json_response
 from ..proxy.kube import RequestInfo
 from ..proxy.restmapper import CachingRESTMapper
 from ..rules.engine import (
-    MapMatcher,
     ResolveError,
     filter_rules_with_cel_conditions,
-    resolve_input_from_request,
-)
+    resolve_input_from_request)
 from ..spicedb.endpoints import PermissionsEndpoint
 from .check import (
     UnauthorizedError,

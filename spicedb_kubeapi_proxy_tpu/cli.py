@@ -30,13 +30,11 @@ from .config import proxyrule
 from .proxy import kubeconfig as kubecfg
 from .proxy.authn import (
     Authenticator,
-    AuthenticatorChain,
     ClientCertAuthenticator,
     HeaderAuthenticator,
     OIDCAuthenticator,
     RequestHeaderAuthenticator,
-    TokenFileAuthenticator,
-)
+    TokenFileAuthenticator)
 from .proxy.httpcore import Transport
 from .proxy.server import Options as ServerOptions, ProxyServer
 from .spicedb.endpoints import Bootstrap

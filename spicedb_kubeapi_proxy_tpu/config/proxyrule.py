@@ -228,7 +228,9 @@ def parse_doc(doc: dict) -> Config:
         raise RuleValidationError("postfilter must be a list")
     for i, p in enumerate(raw_post):
         if not isinstance(p, dict):
-            raise RuleValidationError(f"postfilter[{i}]: expected a mapping, got {type(p).__name__}")
+            raise RuleValidationError(
+                f"postfilter[{i}]: expected a mapping, "
+                f"got {type(p).__name__}")
         pf = PostFilter()
         if p.get("checkPermissionTemplate") is not None:
             pf.check_permission_template = _string_or_template(

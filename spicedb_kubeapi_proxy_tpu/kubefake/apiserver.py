@@ -15,11 +15,11 @@ import gzip as gzip_mod
 import json
 import time
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
-from ..proxy.httpcore import Handler, Headers, Request, Response, json_response
+from ..proxy.httpcore import Request, Response, json_response
 from ..proxy.kube import parse_request_info
 
 

@@ -14,7 +14,7 @@ resource_type, permission) for one of the five north-star configs
 from __future__ import annotations
 
 import random
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 @dataclass

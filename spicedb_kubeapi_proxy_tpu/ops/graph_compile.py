@@ -43,7 +43,7 @@ from typing import Optional
 import numpy as np
 
 from ..spicedb import schema as sch
-from ..spicedb.types import Relationship, SchemaError, WILDCARD
+from ..spicedb.types import SchemaError, WILDCARD
 
 SELF_SLOT = "__self__"
 

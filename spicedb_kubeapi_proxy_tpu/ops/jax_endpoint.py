@@ -36,7 +36,6 @@ import heapq
 import logging
 import os
 import threading
-import time
 from typing import Iterable, Optional
 
 import jax.numpy as jnp
@@ -65,7 +64,7 @@ from ..spicedb.types import (
     WILDCARD,
 )
 from .ell import EllKernelCache, batch_words, build_tables
-from .graph_compile import (GraphProgram, SELF_SLOT, caveat_affected_pairs,
+from .graph_compile import (GraphProgram, caveat_affected_pairs,
                             compile_graph, compile_graph_columnar)
 from .spmv import KernelCache, bucket, pad_edges, pad_scatter
 

@@ -16,7 +16,6 @@ DELETE -> delete/deletecollection.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
 

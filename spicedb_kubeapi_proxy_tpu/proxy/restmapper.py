@@ -12,7 +12,6 @@ import asyncio
 import json
 import time
 from dataclasses import dataclass
-from typing import Optional
 
 from .httpcore import Headers, Request, Transport
 

@@ -48,7 +48,7 @@ from .httpcore import (
     Transport,
     json_response,
 )
-from .kube import UserInfo, parse_request_info
+from .kube import parse_request_info
 from .restmapper import CachingRESTMapper
 
 logger = logging.getLogger("spicedb_kubeapi_proxy_tpu.proxy")

@@ -9,7 +9,7 @@ reference grammar exactly (reference: pkg/rules/rules.go:1053-1076, the
 from __future__ import annotations
 
 import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 class RelParseError(ValueError):

@@ -14,7 +14,6 @@ differential-testing oracle for the `jax://` device kernels
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional
 
 from . import schema as sch
 from .store import TupleStore

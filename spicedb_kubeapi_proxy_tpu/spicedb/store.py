@@ -17,7 +17,7 @@ import threading
 import time
 
 import numpy as np
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 from typing import Callable, Iterable, Optional
 
 from .columnar import BaseLayer, ColumnarSnapshot

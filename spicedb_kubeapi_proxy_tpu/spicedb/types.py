@@ -10,9 +10,9 @@ from __future__ import annotations
 
 import re
 import time
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass
 from enum import Enum
-from typing import Any, Iterable, Optional
+from typing import Optional
 
 # Subject relation value meaning "the subject object itself" (authzed API's
 # ellipsis relation).

@@ -20,10 +20,8 @@ from cryptography.x509.oid import NameOID
 
 from spicedb_kubeapi_proxy_tpu.proxy.authn import (
     AuthenticatorChain,
-    HeaderAuthenticator,
     OIDCAuthenticator,
-    RequestHeaderAuthenticator,
-)
+    RequestHeaderAuthenticator)
 from spicedb_kubeapi_proxy_tpu.proxy.httpcore import Headers, Request
 
 

@@ -10,7 +10,6 @@ all three decidability states (context-decided True / False, undecided).
 """
 
 import asyncio
-import itertools
 import random
 
 import pytest

@@ -15,10 +15,8 @@ from spicedb_kubeapi_proxy_tpu import cli
 from spicedb_kubeapi_proxy_tpu.config import proxyrule
 from spicedb_kubeapi_proxy_tpu.proxy import kubeconfig as kubecfg
 from spicedb_kubeapi_proxy_tpu.proxy.authn import (
-    ClientCertAuthenticator,
     HeaderAuthenticator,
-    TokenFileAuthenticator,
-)
+    TokenFileAuthenticator)
 from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
     Headers,
     Request,

@@ -185,13 +185,16 @@ def random_rels(rng, n):
         elif k == 3:
             rels.add(f"namespace:n{rng.randrange(6)}#tenant@tenant:t{rng.randrange(3)}")
         elif k == 4:
-            rels.add(f"pod:n{rng.randrange(6)}/p{rng.randrange(40)}#namespace@namespace:n{rng.randrange(6)}")
+            rels.add(f"pod:n{rng.randrange(6)}/p{rng.randrange(40)}"
+                     f"#namespace@namespace:n{rng.randrange(6)}")
         elif k == 5:
             rels.add(f"namespace:n{rng.randrange(6)}#viewer@user:*")
         elif k == 6:
-            rels.add(f"pod:n{rng.randrange(6)}/p{rng.randrange(40)}#banned@user:u{rng.randrange(30)}")
+            rels.add(f"pod:n{rng.randrange(6)}/p{rng.randrange(40)}"
+                     f"#banned@user:u{rng.randrange(30)}")
         else:
-            rels.add(f"pod:n{rng.randrange(6)}/p{rng.randrange(40)}#creator@user:u{rng.randrange(30)}")
+            rels.add(f"pod:n{rng.randrange(6)}/p{rng.randrange(40)}"
+                     f"#creator@user:u{rng.randrange(30)}")
     rels.add("alien:x#zap@user:u1")            # type not in schema
     rels.add("pod:n0/p0#unknownrel@user:u1")   # relation not in schema
     return sorted(rels)

@@ -14,7 +14,6 @@ import json
 
 import pytest
 
-from spicedb_kubeapi_proxy_tpu.config import proxyrule
 from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import (
     BUILTIN_TYPES,
     FakeKubeApiServer,

@@ -6,10 +6,7 @@ round-3 codec silently DROPPED caveats on relationships)."""
 
 import asyncio
 
-import pytest
 
-from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
-from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
 from spicedb_kubeapi_proxy_tpu.spicedb.grpc_remote import (
     PermissionsGrpcServer,
     RemoteEndpoint,

@@ -3,7 +3,6 @@ endpoint-boundary instrumentation wrapper (SURVEY.md §5), and the proxy's
 /metrics route."""
 
 import asyncio
-import json
 
 import pytest
 
@@ -167,7 +166,7 @@ def test_jax_stats_gauges():
 
 def test_proxy_metrics_route():
     from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
-        Headers, Request, Response, Transport)
+        Response, Transport)
     from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
     from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
 

@@ -3,20 +3,15 @@ rules.go:242-279): the proxy log line carries user/rule/GVR context and
 the authz outcome; per-verb latency lands in a histogram."""
 
 import asyncio
-import json
 import logging
 
-import pytest
 
 from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import FakeKubeApiServer
 from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
 from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
 from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
 from spicedb_kubeapi_proxy_tpu.spicedb.types import (
-    RelationshipUpdate,
-    UpdateOp,
-    parse_relationship,
-)
+    parse_relationship)
 
 SCHEMA = """
 definition user {}

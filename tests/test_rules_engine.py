@@ -67,7 +67,8 @@ class TestResolveInput:
         assert inp.namespace == "ns2"
 
     def test_body_extraction(self):
-        body = b'{"apiVersion":"v1","kind":"Pod","metadata":{"name":"p3","namespace":"ns3"},"spec":{"x":1}}'
+        body = (b'{"apiVersion":"v1","kind":"Pod","metadata":'
+                b'{"name":"p3","namespace":"ns3"},"spec":{"x":1}}')
         req = parse_request_info("POST", "/api/v1/namespaces/ns3/pods")
         inp = engine.resolve_input_from_request(req, UserInfo(name="u"), body, {})
         assert inp.name == "p3"
@@ -122,7 +123,8 @@ check:
 - tpl: "pod:{{name}}#view@group:devs#member"
 """)[0]
         rule = engine.compile_rule(cfg)
-        rels = rule.checks[0].generate_relationships(make_input(verb="get", resource="pods", name="p"))
+        rels = rule.checks[0].generate_relationships(
+            make_input(verb="get", resource="pods", name="p"))
         assert rels[0].subject_relation == "member"
 
     def test_none_field_errors(self):

@@ -13,9 +13,7 @@ import time
 
 import pytest
 
-from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import (JaxEndpoint,
-                                                        _ShardedEllGraph)
-from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import (_ShardedEllGraph)
 from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (Bootstrap,
                                                          EndpointConfigError,
                                                          create_endpoint)

@@ -407,7 +407,8 @@ definition pod {
             "namespace:ns2#viewer@user:alice",
             "namespace:ns3#viewer@user:bob",
         ])
-        assert ev.lookup_resources("namespace", "view", SubjectRef("user", "alice")) == ["ns1", "ns2"]
+        assert ev.lookup_resources(
+            "namespace", "view", SubjectRef("user", "alice")) == ["ns1", "ns2"]
 
     def test_lookup_subjects(self):
         ev, _ = make_eval(BOOTSTRAP_SCHEMA, [

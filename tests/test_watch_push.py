@@ -9,7 +9,6 @@ import json
 import threading
 import time
 
-import pytest
 
 from spicedb_kubeapi_proxy_tpu.authz import responsefilterer as rf_mod
 from spicedb_kubeapi_proxy_tpu.authz.responsefilterer import (
