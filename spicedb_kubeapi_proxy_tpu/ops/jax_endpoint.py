@@ -380,7 +380,8 @@ class _EllGraph:
                                      tree_depth=tree_depth,
                                      num_iters=num_iters,
                                      planes=self.has_cav,
-                                     shared_tree_depth=t.tree_depth)
+                                     shared_tree_depth=t.tree_depth,
+                                     host_main=t.idx_main)
         self._dirty_main: set = set()
         self._dirty_aux: set = set()
         self._dirty_cav: set = set()
@@ -1465,24 +1466,27 @@ class JaxEndpoint(PermissionsEndpoint):
     def _lookup_batch_sync(self, resource_type: str, permission: str,
                            subjects: list) -> list:
         """One retry on placeholder suppression, then host-oracle
-        fallback on a second inconsistency — see _lookup_sync."""
-        out, bad_n = self._lookup_batch_once(resource_type, permission,
-                                             subjects)
-        if bad_n:
-            self._purge_ids_view(resource_type)
-            out, bad_n = self._lookup_batch_once(resource_type, permission,
-                                                 subjects)
-            if bad_n:
-                with self._lock:
-                    self.stats["suppression_oracle_fallbacks"] = (
-                        self.stats.get("suppression_oracle_fallbacks", 0) + 1)
-                out = [self._oracle.lookup_resources(resource_type,
-                                                     permission, s)
-                       for s in subjects]
-        return out
+        fallback on a second inconsistency — see _lookup_sync.  (The
+        tail lives in _lookup_batch_finish_sync so the sync and the
+        two-phase dispatcher paths can never drift.)"""
+        return self._lookup_batch_finish_sync(
+            self._lookup_batch_capture(resource_type, permission, subjects))
 
     def _lookup_batch_once(self, resource_type: str, permission: str,
                            subjects: list) -> tuple:
+        ctx = self._lookup_batch_capture(resource_type, permission, subjects)
+        return self._lookup_batch_extract(ctx)
+
+    def _lookup_batch_capture(self, resource_type: str, permission: str,
+                              subjects: list) -> dict:
+        """Phase 1 of a fused batch lookup: capture a consistent
+        (snapshot, id view) pair under the lock, DISPATCH the kernel, and
+        start the device->host copy asynchronously.  Returns a context
+        for _lookup_batch_extract; does not block on device work (jax
+        dispatch is async), so a pipelining caller can capture batch N+1
+        while batch N's transfer is still streaming — the device runs
+        N+1's kernel during N's D2H instead of idling (the dispatcher's
+        double-buffer drain, spicedb/dispatch.py)."""
         self.schema.definition(resource_type)
         all_oracle = False
         with self._lock:
@@ -1503,33 +1507,54 @@ class JaxEndpoint(PermissionsEndpoint):
                              self.stats.get("spare_assignments"),
                              id(ids), threading.get_ident())
                 self.stats["kernel_calls"] += 1
+        ctx = {"rt": resource_type, "perm": permission, "subjects": subjects}
         if all_oracle:
-            # host evaluation outside the lock (reads the live store)
-            return [self._oracle.lookup_resources(resource_type, permission, s)
-                    for s in subjects], 0
-        # kernel + extraction outside the lock (immutable snapshot)
+            ctx["all_oracle"] = True
+            return ctx
+        # kernel dispatch outside the lock (immutable snapshot)
         if hasattr(graph, "run_lookup_packed"):
             # packed fast path: per-column shift/AND/nonzero over one
             # uint32 word column — never materializes the 32x larger
-            # bool bitmap or its [B, L] transpose
-            packed = graph.run_lookup_packed(rng[0], rng[1], q_arr, snap=snap)
-            packed_T = np.ascontiguousarray(packed.T)  # [W, L], small
+            # bool bitmap or its [B, L] transpose.  Transposed on device
+            # so the transfer lands contiguous per word column.
+            packed_T = graph.run_lookup_packed(rng[0], rng[1], q_arr,
+                                               snap=snap).T
+            if hasattr(packed_T, "copy_to_host_async"):
+                packed_T.copy_to_host_async()
+            ctx["packed_T"] = packed_T
+        else:
+            ctx["bitmap"] = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
+        ctx.update(cols=cols, unknown=unknown, ids=ids, mask=mask, ph=ph,
+                   forensic=_forensic)
+        return ctx
+
+    def _lookup_batch_extract(self, ctx: dict) -> tuple:
+        """Phase 2: block on the transfer and materialize per-subject id
+        lists; returns (results, suppressed_count)."""
+        if ctx.get("all_oracle"):
+            # host evaluation outside the lock (reads the live store)
+            return [self._oracle.lookup_resources(ctx["rt"], ctx["perm"], s)
+                    for s in ctx["subjects"]], 0
+        if "packed_T" in ctx:
+            packed_T = np.ascontiguousarray(ctx["packed_T"])  # [W, L]
 
             def col_indices(col):
                 return _word_col_indices(packed_T[col // 32], col % 32)
         else:
-            bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
+            bitmap = ctx["bitmap"]
 
             def col_indices(col):
                 return np.nonzero(bitmap[:, col])[0]
 
+        ids, mask, ph = ctx["ids"], ctx["mask"], ctx["ph"]
+        cols, unknown = ctx["cols"], ctx["unknown"]
         per_col_ids: dict = {}  # column -> id list (columns are shared)
         out = []
         total_bad = 0
-        for s in subjects:
+        for s in ctx["subjects"]:
             if s in unknown:
                 out.append(self._oracle.lookup_resources(
-                    resource_type, permission, s))
+                    ctx["rt"], ctx["perm"], s))
                 continue
             col = cols[s]
             lst = per_col_ids.get(col)
@@ -1538,10 +1563,41 @@ class JaxEndpoint(PermissionsEndpoint):
                     ids, col_indices(col), ph, mask)
                 if bad_n:
                     total_bad += bad_n
-                    self._report_suppressed(bad_n, bad_sample, _forensic)
+                    self._report_suppressed(bad_n, bad_sample,
+                                            ctx["forensic"])
                 per_col_ids[col] = lst
             out.append(lst)
         return out, total_bad
+
+    def _lookup_batch_finish_sync(self, ctx: dict) -> list:
+        """Extraction + the suppression tail (purge -> recapture ->
+        oracle fallback) for a context from _lookup_batch_capture."""
+        out, bad_n = self._lookup_batch_extract(ctx)
+        if bad_n:
+            self._purge_ids_view(ctx["rt"])
+            out, bad_n = self._lookup_batch_once(ctx["rt"], ctx["perm"],
+                                                 ctx["subjects"])
+            if bad_n:
+                with self._lock:
+                    self.stats["suppression_oracle_fallbacks"] = (
+                        self.stats.get("suppression_oracle_fallbacks", 0) + 1)
+                out = [self._oracle.lookup_resources(ctx["rt"], ctx["perm"], s)
+                       for s in ctx["subjects"]]
+        return out
+
+    async def lookup_resources_batch_start(self, resource_type: str,
+                                           permission: str,
+                                           subjects: list) -> dict:
+        """Two-phase fused lookup, phase 1 (kernel dispatch + async D2H).
+        Pair with lookup_resources_batch_finish; the dispatcher uses the
+        pair to double-buffer fused batches."""
+        return await self._off_loop(self._lookup_batch_capture,
+                                    resource_type, permission, subjects)
+
+    async def lookup_resources_batch_finish(self, ctx: dict) -> list:
+        """Two-phase fused lookup, phase 2 (blocking transfer +
+        extraction + self-heal tail)."""
+        return await self._off_loop(self._lookup_batch_finish_sync, ctx)
 
     async def lookup_resources_batch(self, resource_type: str, permission: str,
                                      subjects: list) -> list:
