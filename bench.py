@@ -413,19 +413,40 @@ def roofline_probe(ep, workload, batch: int) -> dict:
     import jax
 
     out = run_lookup(*args)
-    out.block_until_ready()  # warm/compile
+    _ = int(np.asarray(out[0, 0]))  # warm/compile (forced)
     # dispatch/sync round-trip floor: a trivial jitted op timed the same
     # way — under the axon TPU tunnel this is ~70ms and dominates small
-    # kernels; subtracting it separates "kernel compute" from "transport"
+    # kernels; subtracting it separates "kernel compute" from "transport".
+    # A SCALAR FETCH forces execution: block_until_ready can be a no-op
+    # under the tunnel (lazy dispatch), which round 4's probe fell for.
     tiny = jax.jit(lambda v: v + 1)
     z = jnp.zeros(8, jnp.uint32)
-    tiny(z).block_until_ready()
+    _ = int(np.asarray(tiny(z)[0]))
     r0 = time.perf_counter()
-    tiny(z).block_until_ready()
+    _ = int(np.asarray(tiny(z)[0]))
     rtt = time.perf_counter() - r0
-    t0 = time.perf_counter()
+
+    # Detect-and-retime (VERDICT r4 item 7): repeat the forced-execution
+    # timing until two consecutive measurements agree within tolerance;
+    # record the residual disagreement as timing_confidence instead of
+    # publishing a labeled guess.
+    tol = 0.15
+    samples = []
+    for _i in range(6):
+        t0 = time.perf_counter()
+        o = run_lookup(*args)
+        _ = int(np.asarray(o[0, 0]))  # scalar fetch: forces execution
+        samples.append(time.perf_counter() - t0)
+        if (len(samples) >= 2
+                and abs(samples[-1] - samples[-2]) / max(samples[-1],
+                                                         samples[-2]) < tol):
+            break
+    device_s = (samples[-1] + samples[-2]) / 2 if len(samples) >= 2 \
+        else samples[-1]
+    timing_confidence = (1.0 - abs(samples[-1] - samples[-2])
+                         / max(samples[-1], samples[-2])
+                         if len(samples) >= 2 else 0.0)
     out = run_lookup(*args)
-    out.block_until_ready()
     t1 = time.perf_counter()
     # production extraction path: packed transpose + per-column word ops
     # (ops/jax_endpoint._lookup_batch_sync)
@@ -459,27 +480,43 @@ def roofline_probe(ep, workload, batch: int) -> dict:
     table_bytes = 4 * (n * k_main + ap * a * k_aux
                        + (nt * k_cav if kern.planes else 0))
     per_iter = gather_bytes + 2 * state_bytes + table_bytes
-    device_s = t1 - t0
     total_bytes = per_iter * max(iters, 1)
     peak = {"tpu": 819.0}.get(_STATE.get("platform", ""), None)
-    # Under the axon tunnel, execution can be LAZY: block_until_ready may
-    # return in <1ms and the real device work happens inside the host
-    # transfer (observed: 0.1ms "device" + 12s "transfer" on the 1M
-    # config).  When the separate device timing is implausible (< the
-    # measured rtt), fall back to the whole pipeline (run + to-host)
-    # minus rtt as the compute+traffic window — coarser but honest.
-    timing_basis = "device (block_until_ready)"
+    # device_s came from converged scalar-fetch forced timing above (no
+    # lazy-execution guessing path any more — VERDICT r4 item 7).  When
+    # the kernel is too small to separate from the dispatch round trip
+    # (device_s - rtt within jitter), the net-of-rtt rates are
+    # meaningless: null them instead of publishing absurd GB/s.
     compute_s = device_s - rtt
-    if compute_s < rtt:
-        compute_s = max((t2 - t0) - rtt, 1e-6)
-        timing_basis = ("device+transfer pipeline minus rtt (lazy tunnel "
-                        "execution: block_until_ready returned early)")
-    lazy = timing_basis != "device (block_until_ready)"
-    # raw device-time-based numbers are garbage under lazy execution:
-    # null them rather than publish a >100% "achieved" figure
-    achieved = (None if lazy
-                else total_bytes / max(device_s, 1e-6) / 1e9)
-    achieved_net = total_bytes / compute_s / 1e9
+    rtt_dominated = compute_s < max(0.1 * device_s, 1e-4)
+    compute_s = max(compute_s, 1e-6)
+    achieved = total_bytes / max(device_s, 1e-6) / 1e9
+    achieved_net = (None if rtt_dominated
+                    else total_bytes / compute_s / 1e9)
+
+    # Measured attainable floor for THIS access pattern: XLA's TPU
+    # row-gather lowering costs a per-row constant independent of index
+    # locality (scripts/probe_step_breakdown.py), so chip-peak HBM GB/s
+    # is not reachable by any index layout.  Time one amortized gather
+    # of the state shape and scale to the kernel's per-sweep gather
+    # rows; kernel_vs_gather_floor ≈ 1 means the kernel is at the
+    # lowering floor and further wins must cut sweeps or rows.
+    idx_probe = jnp.arange(nt, dtype=jnp.int32)
+
+    @jax.jit
+    def _gather_loop(x):
+        return jax.lax.fori_loop(
+            0, 20, lambda i, v: v[idx_probe] + jnp.uint32(1), x)
+
+    xs = jnp.zeros((nt, w_total), jnp.uint32)
+    _ = int(np.asarray(_gather_loop(xs)[0, 0]))
+    g0 = time.perf_counter()
+    _ = int(np.asarray(_gather_loop(xs)[0, 0]))
+    gather_pass_s = max((time.perf_counter() - g0 - rtt) / 20, 1e-9)
+    ns_per_row = gather_pass_s / nt * 1e9
+    # per-sweep gather rows: K_MAIN over state + aux refreshes
+    sweep_rows = n * k_main + ap * a * k_aux
+    floor_s = sweep_rows * (ns_per_row / 1e9) * max(iters, 1)
     return {
         "state_rows": nt,
         "state_bytes": state_bytes,
@@ -491,23 +528,36 @@ def roofline_probe(ep, workload, batch: int) -> dict:
         "device_time_ms": round(device_s * 1e3, 3),
         "dispatch_rtt_ms": round(rtt * 1e3, 3),
         "kernel_compute_ms": round(compute_s * 1e3, 3),
-        "timing_basis": timing_basis,
-        "transfer_transpose_ms": round((t2 - t1) * 1e3, 3),
+        "rtt_dominated": rtt_dominated,
+        "timing_basis": "scalar-fetch forced execution, converged",
+        "timing_confidence": round(timing_confidence, 3),
+        "timing_samples_ms": [round(s * 1e3, 1) for s in samples],
+        "kernel_transfer_pipeline_ms": round((t2 - t1) * 1e3, 3),
+        # the pipeline window contains a full kernel execution; the
+        # transfer estimate subtracts the separately-forced kernel time
+        "transfer_est_ms": round(max((t2 - t1) - device_s, 0.0) * 1e3, 3),
         "id_materialize_sample_ms": round((t3 - t2) * 1e3, 3),
-        "modeled_achieved_hbm_gbps": (round(achieved, 2)
-                                      if achieved is not None else None),
-        "modeled_achieved_hbm_gbps_net_of_rtt": round(achieved_net, 2),
+        "modeled_achieved_hbm_gbps": round(achieved, 2),
+        "modeled_achieved_hbm_gbps_net_of_rtt": (
+            round(achieved_net, 2) if achieved_net is not None else None),
         "hbm_peak_gbps_v5e": 819.0,
         "modeled_peak_fraction": (round(achieved / peak, 4)
-                                  if peak and achieved is not None else None),
-        "modeled_peak_fraction_net_of_rtt": (round(achieved_net / peak, 4)
-                                             if peak else None),
+                                  if peak else None),
+        "modeled_peak_fraction_net_of_rtt": (
+            round(achieved_net / peak, 4)
+            if peak and achieved_net is not None else None),
+        "gather_ns_per_row_measured": round(ns_per_row, 2),
+        "gather_floor_ms": round(floor_s * 1e3, 3),
+        "kernel_vs_gather_floor": round(compute_s / max(floor_s, 1e-9), 2),
         "model_note": ("bytes model counts gather outputs + state "
-                       "read/write + table reads; random-access "
-                       "amplification not modeled (lower bound); "
-                       "dispatch_rtt is a trivial-op round trip (the axon "
-                       "tunnel adds ~70ms/sync) subtracted for the "
-                       "net-of-rtt numbers"),
+                       "read/write + table reads (lower bound). "
+                       "modeled_peak_fraction vs chip HBM peak is NOT the "
+                       "efficiency story: XLA's row-gather lowering costs "
+                       "gather_ns_per_row regardless of locality (measured "
+                       "in-situ), so gather_floor/kernel_vs_gather_floor is "
+                       "the attainable-efficiency measure; dispatch_rtt (a "
+                       "trivial-op round trip, ~70ms under the axon tunnel) "
+                       "is subtracted for net-of-rtt numbers"),
     }
 
 
@@ -530,6 +580,33 @@ def sharded_comm_model(ep, workload, batch: int,
                    "row blocks; measured wall time for this layout is "
                    "recorded by dryrun_multichip (MULTICHIP artifact)")
     return out
+
+
+def v5e8_projection(ep, workload, batch: int, roofline: dict) -> dict:
+    """Predicted v5e-8 throughput from the measured single-chip roofline
+    (VERDICT r4 item 4) — formula + inputs recorded in the artifact."""
+    from spicedb_kubeapi_proxy_tpu.parallel.sharding import (
+        predict_v5e8_checks_per_s)
+
+    with ep._lock:
+        graph = ep._current_graph()
+    if not hasattr(graph, "dev_main") or "kernel_compute_ms" not in roofline:
+        return {"skipped": "needs the ELL graph + a measured roofline"}
+    iters = max(roofline.get("iterations_executed", 1), 1)
+    iter_s = roofline["kernel_compute_ms"] / 1e3 / iters
+    # fixed overhead: extraction + dispatch (not the tunnel transfer —
+    # a deployed v5e-8 host is directly attached; model D2H at 8 GB/s
+    # PCIe for the packed result instead)
+    n_words = roofline.get("packed_words_per_plane", 8)
+    d2h_s = workload.expected_objects * n_words * 4 / 8e9
+    fixed = d2h_s + roofline.get("id_materialize_sample_ms", 0) / 1e3
+    return predict_v5e8_checks_per_s(
+        graph.prog.state_size, graph.dev_aux.shape[0], 2, 4, batch,
+        objects=workload.expected_objects,
+        single_chip_iter_s=iter_s, iters=iters,
+        planes=bool(getattr(graph, "has_cav", False)),
+        aux_passes=getattr(graph.kernel, "aux_passes", 1),
+        fixed_overhead_s=fixed)
 
 
 CONFIGS = {
@@ -727,7 +804,7 @@ def main() -> None:
                                                    args.batch)
             payload["latency_breakdown_ms"].update({
                 k: payload["roofline"][k]
-                for k in ("device_time_ms", "transfer_transpose_ms",
+                for k in ("device_time_ms", "transfer_est_ms",
                           "id_materialize_sample_ms")
                 if k in payload["roofline"]})
             log(f"roofline: {payload['roofline']}")
@@ -739,6 +816,12 @@ def main() -> None:
                 ep_head, workload, args.batch)
         except Exception as e:
             payload["sharded_comm_model"] = {"error": repr(e)}
+        try:
+            payload["v5e8_projection"] = v5e8_projection(
+                ep_head, workload, args.batch,
+                payload.get("roofline", {}))
+        except Exception as e:
+            payload["v5e8_projection"] = {"error": repr(e)}
         if _STATE["partial"].get("roofline_probe_abandoned"):
             payload["roofline_probe_abandoned"] = True
         ep_head = None  # release: the pops below are no-ops while this lives

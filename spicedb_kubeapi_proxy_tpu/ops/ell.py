@@ -401,9 +401,13 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int,
     sweep, each range's gather reading the ranges already updated this
     sweep, so a full user->group->...->pod chain propagates in ONE sweep
     instead of one per type hop (measured on multitenant-1m: trips 6->2,
-    scripts/probe_staged.py).  Gather traffic per sweep is
-    unchanged — the per-row gather cost is lowering-bound, independent
-    of index locality (same probe), so fewer sweeps is the whole win."""
+    scripts/probe_staged.py).  MAIN-table gather traffic per sweep is
+    unchanged; the aux OR-tree refresh runs once per aux-reading stage
+    pass instead of once per sweep (the aux table is orders of magnitude
+    smaller than the main table, but aux-hub-heavy schemas pay S-fold
+    refresh cost — annotate_stage_refresh bounds S to the stages that
+    actually read aux roots).  Per-row gather cost is lowering-bound and
+    locality-independent (same probe), so fewer sweeps is the win."""
     n = prog.state_size
     dead = prog.dead_index
     perm_ops = tuple(prog.perm_ops)
