@@ -1,0 +1,189 @@
+"""Write-path soak (VERDICT r4 item 8): sustained mixed workload on the
+multitenant-1m graph — unique-name pod create/delete cycles (the normal
+kubernetes lifecycle), fused lookups, bulk checks, and a live watch —
+tracking spare-pool occupancy, rebuilds, suppressions, RSS, and p99
+drift per window.  Writes SOAK_r05.json.
+
+Run (real TPU):  PYTHONPATH=/root/repo python scripts/soak.py [seconds]
+Quick CPU smoke: JAX_PLATFORMS=cpu python scripts/soak.py 60
+"""
+
+import asyncio
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap, create_endpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+WINDOW_S = 300.0
+
+
+def rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main():
+    duration = float(sys.argv[1]) if len(sys.argv) > 1 else 1800.0
+    out_path = os.environ.get("SOAK_OUT", "SOAK_r05.json")
+    w = wl.multitenant_1m()
+    t0 = time.time()
+    ep = create_endpoint("jax://", Bootstrap(schema_text=w.schema_text))
+    ep.store.bulk_load([parse_relationship(r) for r in w.relationships])
+    inner = getattr(ep, "inner", ep)
+    print(f"loaded {len(w.relationships)} tuples in {time.time()-t0:.1f}s",
+          flush=True)
+
+    stop = asyncio.Event()
+    lookup_lat: list = []      # (t, seconds) within current window
+    windows: list = []
+    counters = {"creates": 0, "deletes": 0, "lookups": 0, "checks": 0,
+                "watch_events": 0, "errors": 0}
+    min_pool: dict = {}
+
+    def pool_snapshot():
+        with inner._lock:
+            for t, pool in inner._spare_pool.items():
+                free = len(pool)
+                if t not in min_pool or free < min_pool[t]:
+                    min_pool[t] = free
+
+    async def writer(wid: int):
+        k = 0
+        while not stop.is_set():
+            name = f"soak-{wid}-{k}"
+            try:
+                await ep.write_relationships([RelationshipUpdate(
+                    UpdateOp.TOUCH, parse_relationship(
+                        f"pod:ns{k % 2000}/{name}#creator@user:u{wid}"))])
+                counters["creates"] += 1
+                await asyncio.sleep(0.02)
+                await ep.write_relationships([RelationshipUpdate(
+                    UpdateOp.DELETE, parse_relationship(
+                        f"pod:ns{k % 2000}/{name}#creator@user:u{wid}"))])
+                counters["deletes"] += 1
+            except Exception as e:
+                counters["errors"] += 1
+                print(f"writer error: {e!r}", flush=True)
+            pool_snapshot()
+            k += 1
+            await asyncio.sleep(0.05)
+
+    async def looker(i: int):
+        while not stop.is_set():
+            sub = SubjectRef("user", w.subjects[(i * 37) % len(w.subjects)])
+            t = time.perf_counter()
+            try:
+                ids = await ep.lookup_resources("pod", "view", sub)
+                lookup_lat.append(time.perf_counter() - t)
+                counters["lookups"] += 1
+                assert not any("\x00" in x for x in ids)
+            except Exception as e:
+                counters["errors"] += 1
+                print(f"looker error: {e!r}", flush=True)
+            await asyncio.sleep(0.2)
+
+    async def checker():
+        while not stop.is_set():
+            try:
+                reqs = [CheckRequest(
+                    ObjectRef("pod", f"ns{j % 2000}/p{j}"), "view",
+                    SubjectRef("user", w.subjects[j % len(w.subjects)]))
+                    for j in range(16)]
+                await ep.check_bulk_permissions(reqs)
+                counters["checks"] += 16
+            except Exception as e:
+                counters["errors"] += 1
+                print(f"checker error: {e!r}", flush=True)
+            await asyncio.sleep(0.5)
+
+    async def watcher():
+        wtc = ep.watch(["pod"])
+        try:
+            while not stop.is_set():
+                upd = await wtc.next(timeout=1.0)
+                if upd is not None:
+                    counters["watch_events"] += len(upd.updates)
+        finally:
+            wtc.close()
+
+    async def reporter():
+        start = time.time()
+        last = start
+        while not stop.is_set():
+            await asyncio.sleep(5)
+            now = time.time()
+            if now - last >= WINDOW_S or (stop.is_set() and lookup_lat):
+                lat = sorted(lookup_lat)
+                lookup_lat.clear()
+                last = now
+                st = dict(inner.stats)
+                windows.append({
+                    "t_s": round(now - start, 1),
+                    "lookups": len(lat),
+                    "p50_ms": round(lat[len(lat) // 2] * 1e3, 1) if lat else None,
+                    "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 1) if lat else None,
+                    "rss_mb": round(rss_mb(), 1),
+                    "rebuilds": st.get("rebuilds"),
+                    "spare_assignments": st.get("spare_assignments"),
+                    "spare_reclaims": st.get("spare_reclaims"),
+                    "placeholder_suppressed": st.get("placeholder_suppressed", 0),
+                    "suppression_oracle_fallbacks": st.get(
+                        "suppression_oracle_fallbacks", 0),
+                    "counters": dict(counters),
+                })
+                print(f"window {len(windows)}: {windows[-1]}", flush=True)
+
+    async def run():
+        tasks = [asyncio.ensure_future(x) for x in (
+            writer(0), writer(1), looker(0), looker(1), looker(2),
+            checker(), watcher(), reporter())]
+        await asyncio.sleep(duration)
+        stop.set()
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    t_run = time.time()
+    asyncio.run(run())
+    st = dict(inner.stats)
+    warmup_rebuilds = windows[0]["rebuilds"] if windows else st.get("rebuilds")
+    final = {
+        "duration_s": round(time.time() - t_run, 1),
+        "platform": os.environ.get("JAX_PLATFORMS", "tpu(axon)"),
+        "windows": windows,
+        "final_stats": {k: v for k, v in st.items()
+                        if isinstance(v, (int, float))},
+        "min_spare_pool_free": min_pool,
+        "counters": counters,
+        "rss_mb_final": round(rss_mb(), 1),
+        "verdict": {
+            "rebuilds_after_warmup": (st.get("rebuilds", 0)
+                                      - (warmup_rebuilds or 0)),
+            "placeholder_suppressed": st.get("placeholder_suppressed", 0),
+            "suppression_oracle_fallbacks": st.get(
+                "suppression_oracle_fallbacks", 0),
+            "errors": counters["errors"],
+            "rss_flat": (len(windows) < 2
+                         or windows[-1]["rss_mb"] - windows[1]["rss_mb"]
+                         < 256),
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(final, f, indent=1)
+    print(json.dumps(final["verdict"]), flush=True)
+    print(f"wrote {out_path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
