@@ -6,7 +6,12 @@ location disables itself after its first hit.  CI additionally runs real
 pytest-cov, see .github/workflows/build-test.yaml).
 
 Usage:
-    python scripts/cov.py [pytest args...]      # default: tests/ -q
+    python scripts/cov.py [--min-pct N] [pytest args...]  # default: tests/ -q
+
+`--min-pct N` (or env COV_MIN=N) makes the run FAIL when total coverage
+drops below N percent — the enforced floor scripts/check.sh gates on
+(VERDICT round 5: a coverage reporter nobody gates on regresses
+silently).
 
 Writes COVERAGE.json ({"total_pct": ..., "files": {...}}) and prints a
 per-package summary.  Lines executed only in subprocesses (the CLI e2e
@@ -86,10 +91,34 @@ def main():
     # pytest.main() from this script does not put the repo root on
     # sys.path the way `python -m pytest` does
     sys.path.insert(0, str(REPO))
-    install()
+    args = sys.argv[1:]
+    try:
+        min_pct = float(os.environ.get("COV_MIN", "0") or 0)
+    except ValueError:
+        print(f"error: COV_MIN={os.environ['COV_MIN']!r} is not numeric",
+              file=sys.stderr)
+        return 2
+    if "--min-pct" in args:
+        i = args.index("--min-pct")
+        try:
+            min_pct = float(args[i + 1])
+        except (IndexError, ValueError):
+            print("error: --min-pct requires a numeric value",
+                  file=sys.stderr)
+            return 2
+        del args[i: i + 2]
     import pytest
-    args = sys.argv[1:] or ["tests/", "-q"]
-    rc = pytest.main(args)
+    if not hasattr(sys, "monitoring"):
+        # pre-3.12 interpreter (no PEP 669): run the suite without
+        # coverage instead of crashing; the floor can't be enforced here
+        # (CI runs 3.12+ and does enforce it)
+        print("cov.py: sys.monitoring unavailable on "
+              f"Python {sys.version_info.major}.{sys.version_info.minor}; "
+              "running tests without coverage (gate skipped)",
+              file=sys.stderr)
+        return pytest.main(args or ["tests/", "-q"])
+    install()
+    rc = pytest.main(args or ["tests/", "-q"])
     sys.monitoring.set_events(sys.monitoring.COVERAGE_ID, 0)
     out = report()
     worst = sorted(out["files"].items(), key=lambda kv: kv[1]["pct"])[:10]
@@ -100,6 +129,10 @@ def main():
     print(f"TOTAL {out['total_pct']}% "
           f"({out['covered_lines']}/{out['executable_lines']} lines) "
           f"-> COVERAGE.json")
+    if min_pct and out["total_pct"] < min_pct:
+        print(f"coverage gate: TOTAL {out['total_pct']}% is below the "
+              f"enforced minimum {min_pct}%", file=sys.stderr)
+        return rc or 1
     return rc
 
 

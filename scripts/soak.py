@@ -4,6 +4,11 @@ kubernetes lifecycle), fused lookups, bulk checks, and a live watch —
 tracking spare-pool occupancy, rebuilds, suppressions, RSS, and p99
 drift per window.  Writes SOAK_r05.json.
 
+Every lookup/check runs inside a request trace (utils/tracing.py) and
+each window dumps its slowest traces with per-phase span breakdowns
+(queue_wait vs. kernel vs. extraction), so a p99 spike in a window is
+attributable from the soak output alone.
+
 Run (real TPU):  PYTHONPATH=/root/repo python scripts/soak.py [seconds]
 Quick CPU smoke: JAX_PLATFORMS=cpu python scripts/soak.py 60
 """
@@ -19,6 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from spicedb_kubeapi_proxy_tpu.models import workloads as wl
 from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap, create_endpoint
+from spicedb_kubeapi_proxy_tpu.utils import tracing
 from spicedb_kubeapi_proxy_tpu.spicedb.types import (
     CheckRequest,
     ObjectRef,
@@ -86,7 +92,9 @@ def main():
             sub = SubjectRef("user", w.subjects[(i * 37) % len(w.subjects)])
             t = time.perf_counter()
             try:
-                ids = await ep.lookup_resources("pod", "view", sub)
+                with tracing.request_trace(op="lookup", subject=sub.id) as tr:
+                    ids = await ep.lookup_resources("pod", "view", sub)
+                tracing.RECORDER.record(tr)
                 lookup_lat.append(time.perf_counter() - t)
                 counters["lookups"] += 1
                 assert not any("\x00" in x for x in ids)
@@ -102,7 +110,9 @@ def main():
                     ObjectRef("pod", f"ns{j % 2000}/p{j}"), "view",
                     SubjectRef("user", w.subjects[j % len(w.subjects)]))
                     for j in range(16)]
-                await ep.check_bulk_permissions(reqs)
+                with tracing.request_trace(op="check_bulk", batch=16) as tr:
+                    await ep.check_bulk_permissions(reqs)
+                tracing.RECORDER.record(tr)
                 counters["checks"] += 16
             except Exception as e:
                 counters["errors"] += 1
@@ -143,6 +153,10 @@ def main():
                     "suppression_oracle_fallbacks": st.get(
                         "suppression_oracle_fallbacks", 0),
                     "counters": dict(counters),
+                    # the window's slowest op traces, spans included —
+                    # a p99 spike names its own phase (queue vs kernel
+                    # vs extraction) instead of needing a re-run
+                    "slow_traces": tracing.RECORDER.drain()[:3],
                 })
                 print(f"window {len(windows)}: {windows[-1]}", flush=True)
 

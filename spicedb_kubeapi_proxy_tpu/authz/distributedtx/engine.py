@@ -17,6 +17,7 @@ import traceback
 from typing import Any, Awaitable, Callable, Optional
 
 from ...utils.failpoints import FailPointPanic
+from ...utils.tracing import span
 from . import journal as journal_mod
 from .journal import Journal
 
@@ -56,9 +57,12 @@ class WorkflowContext:
         if fn is None:
             raise WorkflowError(f"unknown activity {name!r}")
         try:
-            result = fn(*args)
-            if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
-                result = await result
+            # workflow step span: replayed completions above return
+            # without one (they did no work this run)
+            with span("workflow." + name):
+                result = fn(*args)
+                if asyncio.iscoroutine(result) or isinstance(result, Awaitable):
+                    result = await result
         except FailPointPanic:
             # simulated crash: do NOT journal; replay will re-execute
             raise

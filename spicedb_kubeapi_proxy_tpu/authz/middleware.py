@@ -15,6 +15,7 @@ from ..rules.engine import (
     ResolveError,
     filter_rules_with_cel_conditions,
     resolve_input_from_request)
+from ..utils.tracing import span
 from ..spicedb.endpoints import PermissionsEndpoint
 from .check import (
     UnauthorizedError,
@@ -74,11 +75,12 @@ def with_authorization(handler: Handler, failed: Handler,
         # of the request context after the chain completes
         req.context["authz_outcome"] = "denied"
         try:
-            if input_extractor is not None:
-                input = input_extractor(req, info, user)
-            else:
-                input = resolve_input_from_request(
-                    info, user, req.body, req.headers.to_dict())
+            with span("resolve", phase=True):
+                if input_extractor is not None:
+                    input = input_extractor(req, info, user)
+                else:
+                    input = resolve_input_from_request(
+                        info, user, req.body, req.headers.to_dict())
         except ResolveError as e:
             return forbidden_response(str(e))
         req.context["resolve_input"] = input
@@ -88,21 +90,28 @@ def with_authorization(handler: Handler, failed: Handler,
             req.context[FILTERER_KEY] = EmptyResponseFilterer()
             return await handler(req)
 
-        matching_rules = matcher_ref().match(info)
-        if not matching_rules:
-            return await failed(req)
-
-        try:
-            filtered_rules = filter_rules_with_cel_conditions(
-                matching_rules, input)
-        except ResolveError:
-            return await failed(req)
-        if not filtered_rules:
+        # rule matching + CEL condition filtering are one attribution
+        # phase: both walk the matched rule set against the request
+        with span("match", phase=True) as match_attrs:
+            matching_rules = matcher_ref().match(info)
+            filtered_rules: list = []
+            cel_failed = False
+            if matching_rules:
+                try:
+                    filtered_rules = filter_rules_with_cel_conditions(
+                        matching_rules, input)
+                except ResolveError:
+                    cel_failed = True
+            match_attrs["rules"] = len(filtered_rules)
+        if cel_failed or not filtered_rules:
             return await failed(req)
         req.context["matched_rules"] = [r.name for r in filtered_rules]
 
         try:
-            await run_all_matching_checks(endpoint, filtered_rules, input)
+            # informational wrapper: the dispatch layer records the
+            # queue_wait/execute phase spans for the bulk check itself
+            with span("check"):
+                await run_all_matching_checks(endpoint, filtered_rules, input)
         except (UnauthorizedError, ResolveError):
             return await failed(req)
 
@@ -122,8 +131,9 @@ def with_authorization(handler: Handler, failed: Handler,
             from .update import perform_update
             try:
                 req.context["authz_outcome"] = "allowed"
-                return await perform_update(update_rule, input, req,
-                                            workflow_client)
+                with span("workflow", phase=True):
+                    return await perform_update(update_rule, input, req,
+                                                workflow_client)
             except Exception as e:
                 return forbidden_response(f"failed to perform update: {e}")
 
@@ -156,8 +166,9 @@ def with_authorization(handler: Handler, failed: Handler,
             resp = await handler(req)
             if 200 <= resp.status < 300:
                 try:
-                    await run_all_matching_post_checks(endpoint,
-                                                       filtered_rules, input)
+                    with span("postcheck"):
+                        await run_all_matching_post_checks(
+                            endpoint, filtered_rules, input)
                 except (UnauthorizedError, ResolveError):
                     return await failed(req)
             req.context["authz_outcome"] = "allowed"
@@ -166,8 +177,9 @@ def with_authorization(handler: Handler, failed: Handler,
             resp = await handler(req)
             if 200 <= resp.status < 300 and info.verb == "list":
                 try:
-                    body = await filter_list_response(
-                        resp.body, filtered_rules, input, endpoint)
+                    with span("postfilter"):
+                        body = await filter_list_response(
+                            resp.body, filtered_rules, input, endpoint)
                 except Exception:
                     return await failed(req)
                 resp.body = body

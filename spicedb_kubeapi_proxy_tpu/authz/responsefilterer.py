@@ -28,6 +28,7 @@ from ..rules.engine import (
     resolve_rel,
 )
 from ..spicedb.endpoints import PermissionsEndpoint
+from ..utils.tracing import span
 from .lookups import PrefilterResult, run_lookup_resources
 from .rulesel import single_pre_filter_rule
 from .watch import WatchTracker, run_watch
@@ -95,8 +96,15 @@ class StandardResponseFilterer(ResponseFilterer):
 
         async def runner():
             try:
-                result = await run_lookup_resources(self.endpoint, resolved,
-                                                    self.input)
+                # the LR runs concurrently with the upstream request; the
+                # task inherits the request's trace context, so the
+                # kernel spans it triggers land in the request trace even
+                # though respfilter only WAITS for it.  NOT a phase span:
+                # it overlaps the `upstream` phase in wall time, and the
+                # phase set must tile the request without double-counting
+                with span("prefilter"):
+                    result = await run_lookup_resources(self.endpoint,
+                                                        resolved, self.input)
                 if not self._prefilter_future.done():
                     self._prefilter_future.set_result(result)
             except Exception as e:
@@ -109,8 +117,12 @@ class StandardResponseFilterer(ResponseFilterer):
         if not self._prefilter_started:
             raise FilterError("pre-filters were not started, cannot filter response")
         try:
-            result = await asyncio.wait_for(
-                asyncio.shield(self._prefilter_future), PREFILTER_TIMEOUT)
+            # the wait is NOT the respfilter phase: its wall time is the
+            # concurrent prefilter's (already attributed) — folding it in
+            # would double-count kernel time against filtering
+            with span("respfilter.wait"):
+                result = await asyncio.wait_for(
+                    asyncio.shield(self._prefilter_future), PREFILTER_TIMEOUT)
         except asyncio.TimeoutError:
             raise FilterError("timed out waiting for pre-filter") from None
         except FilterError:
@@ -118,6 +130,11 @@ class StandardResponseFilterer(ResponseFilterer):
         except Exception as e:
             raise FilterError(f"pre-filter error: {e}") from e
 
+        with span("respfilter", phase=True):
+            await self._apply_filters(resp, req, result)
+
+    async def _apply_filters(self, resp: Response, req: Request,
+                             result: PrefilterResult) -> None:
         info: RequestInfo = req.context["request_info"]
         # error responses pass through unfiltered (responsefilterer.go:229-234)
         if 400 <= resp.status <= 599:
@@ -290,6 +307,10 @@ class WatchResponseFilterer(ResponseFilterer):
             raise FilterError("watcher was not started, cannot filter response")
         if resp.stream is None:
             return  # error responses pass through
+        with span("respfilter", phase=True):
+            self._wrap_stream(resp)
+
+    def _wrap_stream(self, resp: Response) -> None:
         upstream = resp.stream
         # the upstream Content-Type decides the stream framing/codec, the
         # analog of the reference's negotiated streaming serializer

@@ -136,6 +136,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--oidc-groups-claim", default="groups")
     p.add_argument("--oidc-username-prefix", default="")
 
+    # observability (docs/observability.md)
+    p.add_argument("--trace-slow-threshold", type=float, default=0.0,
+                   help="seconds; a request slower than this logs its full "
+                        "trace (per-phase span breakdown) as structured "
+                        "JSON (0 disables the log; traces always feed "
+                        "/debug/traces and the phase histograms)")
+
     p.add_argument("-v", "--verbosity", type=int, default=3,
                    help="log verbosity (reference defaults to 3)")
     p.add_argument("--feature-gates", default="",
@@ -167,6 +174,8 @@ def validate(args: argparse.Namespace) -> list:
         errs.append("--rule-config is required")
     if not args.embedded_mode and not (0 < args.secure_port < 65536):
         errs.append(f"--secure-port {args.secure_port} is not a valid port")
+    if args.trace_slow_threshold < 0:
+        errs.append("--trace-slow-threshold must be >= 0")
     return errs
 
 
@@ -303,6 +312,7 @@ def complete(args: argparse.Namespace,
         lock_mode_default=args.lock_mode,
         ssl_context=ssl_context,
         endpoint_kwargs=endpoint_kwargs,
+        trace_slow_threshold=args.trace_slow_threshold,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,

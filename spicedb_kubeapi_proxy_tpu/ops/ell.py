@@ -648,6 +648,28 @@ class EllKernelCache:
                                                  prog.state_size)
         self._jits: dict[int, tuple] = {}
 
+    def note_main_aux_ref(self, row: int) -> bool:
+        """Incremental growth (_EllGraph._grow) pointed main row `row`
+        at an OR-tree aux node.  If the stage covering that row was
+        annotated aux-free at build time (annotate_stage_refresh), the
+        staged step would keep skipping the per-stage aux refresh and
+        every query touching the grown hub pays one extra outer sweep —
+        silently.  Flip the stage's wants_aux flag and drop the compiled
+        entry points so the next call re-jits with the refresh; returns
+        True when a flip happened (callers surface it as a stat)."""
+        if not self.stages:
+            return False
+        for i, (ranges, repeat, wants_aux) in enumerate(self.stages):
+            if any(lo <= row < hi for lo, hi in ranges):
+                if wants_aux:
+                    return False
+                self.stages = (self.stages[:i]
+                               + ((ranges, repeat, True),)
+                               + self.stages[i + 1:])
+                self._jits = {}
+                return True
+        return False
+
     def _fns(self, n_words: int) -> tuple:
         fns = self._jits.get(n_words)
         if fns is not None:

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import contextvars
 import heapq
 import logging
 import os
@@ -42,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..spicedb import schema as sch
+from ..utils import tracing
 from ..spicedb.endpoints import (
     Bootstrap,
     DEFAULT_BOOTSTRAP_SCHEMA,
@@ -386,6 +388,9 @@ class _EllGraph:
         self._dirty_aux: set = set()
         self._dirty_cav: set = set()
         self._grow_extra: dict = {}  # root row -> levels grown past build
+        # growths that flipped a build-time aux-free stage annotation
+        # (surfaced as the endpoint's stage_aux_flips stat)
+        self.stage_aux_flips = 0
         # first cav-aux row index: values >= this in the cav table are
         # OR-tree nodes whose children live in the cav table itself
         self._cav_aux_base = prog.state_size + a_shared
@@ -472,6 +477,12 @@ class _EllGraph:
         self.host_main[root_row, 1:] = self.prog.dead_index
         self._dirty_main.add(root_row)
         self._grow_extra[root_row] = grown + 1
+        # the row now reads an aux node: if its stage was annotated
+        # aux-free at build, flip the flag (and count it) instead of
+        # silently paying an extra sweep per query on this hub
+        note = getattr(self.kernel, "note_main_aux_ref", None)
+        if note is not None and note(root_row):
+            self.stage_aux_flips += 1
         return True
 
     def add_rel(self, rel: Relationship) -> bool:
@@ -666,6 +677,7 @@ class _ShardedEllGraph(_EllGraph):
         self._dirty_aux: set = set()
         self._dirty_cav: set = set()
         self._grow_extra: dict = {}  # root row -> levels grown past build
+        self.stage_aux_flips = 0  # sharded kernel has no staged step
 
     def flush(self) -> bool:
         changed = False
@@ -1207,6 +1219,11 @@ class JaxEndpoint(PermissionsEndpoint):
             self._rebuild()
             return
         self._graph_revision = applied_revision
+        flips = getattr(graph, "stage_aux_flips", 0)
+        if flips:
+            self.stats["stage_aux_flips"] = (
+                self.stats.get("stage_aux_flips", 0) + flips)
+            graph.stage_aux_flips = 0
         if graph.flush():
             self.stats["delta_batches"] += 1
 
@@ -1258,7 +1275,8 @@ class JaxEndpoint(PermissionsEndpoint):
                  2: Permissionship.HAS_PERMISSION}
 
     def _check_batch_sync(self, reqs: list) -> list:
-        with self._lock:
+        with tracing.span("kernel.prepare", kind="check", batch=len(reqs)), \
+                self._lock:
             # checked_at = the revision the drained graph actually
             # reflects (tracked through rebuilds and applied deltas) —
             # reading store.revision here instead would race loop-thread
@@ -1315,27 +1333,42 @@ class JaxEndpoint(PermissionsEndpoint):
         # fallbacks evaluate the LIVE store and carry its revision rather
         # than claiming the graph snapshot's.
         if kernel_rows:
-            out = graph.run_checks3(q_arr, gather_idx, gather_col, snap=snap)
+            with tracing.kernel_span("kernel.device", kind="check",
+                                     rows=len(kernel_rows)):
+                out = graph.run_checks3(q_arr, gather_idx, gather_col,
+                                        snap=snap)
             for j, row in enumerate(kernel_rows):
                 results[row] = (int(out[j]), rev)
-        for i in oracle_rows:
-            r = reqs[i]
-            results[i] = (self._oracle.check3(r.resource, r.permission,
-                                              r.subject),
-                          self.store.revision)
+        if oracle_rows:
+            with tracing.span("kernel.oracle", kind="check",
+                              rows=len(oracle_rows)):
+                for i in oracle_rows:
+                    r = reqs[i]
+                    results[i] = (self._oracle.check3(r.resource, r.permission,
+                                                      r.subject),
+                                  self.store.revision)
         return [CheckResult(permissionship=self._TRISTATE[v],
                             checked_at=at)
                 for (v, at) in results]
 
-    def _report_suppressed(self, n: int, sample: list, context) -> None:
+    def _report_suppressed(self, n: int, sample: list, context,
+                           retry: bool = False) -> None:
         """Count (under the lock — callers run lock-free) and log a
-        placeholder suppression with the caller's capture fingerprint."""
+        placeholder suppression with the caller's capture fingerprint.
+
+        `retry=True` marks a suppression observed during the self-heal
+        re-capture of an event already counted: it lands in a separate
+        `placeholder_suppressed_retry` counter (and logs at debug, not
+        warning) so one inconsistency is never double-counted and the
+        forensic log is not re-emitted for the same event."""
+        stat = ("placeholder_suppressed_retry" if retry
+                else "placeholder_suppressed")
         with self._lock:
-            self.stats["placeholder_suppressed"] = (
-                self.stats.get("placeholder_suppressed", 0) + n)
-        _log.warning("suppressed %d internal placeholder ids from lookup "
-                     "result (id-view/bitmap inconsistency): %r capture=%r",
-                     n, sample, context)
+            self.stats[stat] = self.stats.get(stat, 0) + n
+        log = _log.debug if retry else _log.warning
+        log("suppressed %d internal placeholder ids from lookup "
+            "result (id-view/bitmap inconsistency%s): %r capture=%r",
+            n, ", retry" if retry else "", sample, context)
 
     async def _off_loop(self, fn, *args):
         """Run a device-touching sync path in the executor: a fused
@@ -1344,9 +1377,13 @@ class JaxEndpoint(PermissionsEndpoint):
         concurrent request, watch frame, and health probe for that long.
         self._lock is a threading.RLock, so executor threads serialize
         against the delta-drain machinery exactly like loop-thread
-        callers did."""
+        callers did.  The caller's context is copied across the thread
+        hop so the active request trace (utils/tracing.py) — including a
+        dispatch-fanned-out batch trace — still resolves in the executor
+        and kernel spans land in the right request(s)."""
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(None, fn, *args)
+        ctx = contextvars.copy_context()
+        return await loop.run_in_executor(None, lambda: ctx.run(fn, *args))
 
     async def check_permission(self, req: CheckRequest) -> CheckResult:
         return (await self._off_loop(self._check_batch_sync, [req]))[0]
@@ -1368,7 +1405,8 @@ class JaxEndpoint(PermissionsEndpoint):
         out, bad_n = self._lookup_once(resource_type, permission, subject)
         if bad_n:
             self._purge_ids_view(resource_type)
-            out, bad_n = self._lookup_once(resource_type, permission, subject)
+            out, bad_n = self._lookup_once(resource_type, permission, subject,
+                                           retry=True)
             if bad_n:
                 with self._lock:
                     self.stats["suppression_oracle_fallbacks"] = (
@@ -1392,7 +1430,7 @@ class JaxEndpoint(PermissionsEndpoint):
                 graph._ids_np_published.discard(resource_type)
 
     def _lookup_once(self, resource_type: str, permission: str,
-                     subject: SubjectRef) -> tuple:
+                     subject: SubjectRef, retry: bool = False) -> tuple:
         self.schema.definition(resource_type)  # raises like the oracle
         oracle = False
         with self._lock:
@@ -1428,19 +1466,22 @@ class JaxEndpoint(PermissionsEndpoint):
                     self.stats["kernel_calls"] += 1
         if oracle:
             # host evaluation outside the lock (reads the live store)
-            return self._oracle.lookup_resources(resource_type, permission,
-                                                 subject), 0
+            with tracing.span("kernel.oracle", kind="lookup"):
+                return self._oracle.lookup_resources(resource_type, permission,
+                                                     subject), 0
         # kernel + extraction outside the lock (immutable snapshot)
-        if hasattr(graph, "run_lookup_packed"):
-            packed = graph.run_lookup_packed(rng[0], rng[1], q_arr, snap=snap)
-            idx = _word_col_indices(
-                np.ascontiguousarray(packed[:, col // 32]), col % 32)
-        else:
-            bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
-            idx = np.nonzero(bitmap[:, col])[0]
+        with tracing.kernel_span("kernel.device", kind="lookup"):
+            if hasattr(graph, "run_lookup_packed"):
+                packed = graph.run_lookup_packed(rng[0], rng[1], q_arr,
+                                                 snap=snap)
+                idx = _word_col_indices(
+                    np.ascontiguousarray(packed[:, col // 32]), col % 32)
+            else:
+                bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
+                idx = np.nonzero(bitmap[:, col])[0]
         out, bad_n, bad_sample = _ids_for(ids, idx, ph, mask)
         if bad_n:
-            self._report_suppressed(bad_n, bad_sample, _forensic)
+            self._report_suppressed(bad_n, bad_sample, _forensic, retry=retry)
         return out, bad_n
 
     async def lookup_resources(self, resource_type: str, permission: str,
@@ -1473,9 +1514,9 @@ class JaxEndpoint(PermissionsEndpoint):
             self._lookup_batch_capture(resource_type, permission, subjects))
 
     def _lookup_batch_once(self, resource_type: str, permission: str,
-                           subjects: list) -> tuple:
+                           subjects: list, retry: bool = False) -> tuple:
         ctx = self._lookup_batch_capture(resource_type, permission, subjects)
-        return self._lookup_batch_extract(ctx)
+        return self._lookup_batch_extract(ctx, retry=retry)
 
     def _lookup_batch_capture(self, resource_type: str, permission: str,
                               subjects: list) -> dict:
@@ -1512,31 +1553,40 @@ class JaxEndpoint(PermissionsEndpoint):
             ctx["all_oracle"] = True
             return ctx
         # kernel dispatch outside the lock (immutable snapshot)
-        if hasattr(graph, "run_lookup_packed"):
-            # packed fast path: per-column shift/AND/nonzero over one
-            # uint32 word column — never materializes the 32x larger
-            # bool bitmap or its [B, L] transpose.  Transposed on device
-            # so the transfer lands contiguous per word column.
-            packed_T = graph.run_lookup_packed(rng[0], rng[1], q_arr,
-                                               snap=snap).T
-            if hasattr(packed_T, "copy_to_host_async"):
-                packed_T.copy_to_host_async()
-            ctx["packed_T"] = packed_T
-        else:
-            ctx["bitmap"] = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
+        with tracing.kernel_span("kernel.dispatch", kind="lookup_batch",
+                                 batch=len(subjects)):
+            if hasattr(graph, "run_lookup_packed"):
+                # packed fast path: per-column shift/AND/nonzero over one
+                # uint32 word column — never materializes the 32x larger
+                # bool bitmap or its [B, L] transpose.  Transposed on device
+                # so the transfer lands contiguous per word column.
+                packed_T = graph.run_lookup_packed(rng[0], rng[1], q_arr,
+                                                   snap=snap).T
+                if hasattr(packed_T, "copy_to_host_async"):
+                    packed_T.copy_to_host_async()
+                ctx["packed_T"] = packed_T
+            else:
+                ctx["bitmap"] = graph.run_lookup(rng[0], rng[1], q_arr,
+                                                 snap=snap)
         ctx.update(cols=cols, unknown=unknown, ids=ids, mask=mask, ph=ph,
                    forensic=_forensic)
         return ctx
 
-    def _lookup_batch_extract(self, ctx: dict) -> tuple:
+    def _lookup_batch_extract(self, ctx: dict, retry: bool = False) -> tuple:
         """Phase 2: block on the transfer and materialize per-subject id
-        lists; returns (results, suppressed_count)."""
+        lists; returns (results, suppressed_count).  `retry` marks the
+        self-heal re-capture so its suppressions are counted separately
+        (never double-counted against the first detection)."""
         if ctx.get("all_oracle"):
             # host evaluation outside the lock (reads the live store)
-            return [self._oracle.lookup_resources(ctx["rt"], ctx["perm"], s)
-                    for s in ctx["subjects"]], 0
+            with tracing.span("kernel.oracle", kind="lookup_batch"):
+                return [self._oracle.lookup_resources(ctx["rt"], ctx["perm"], s)
+                        for s in ctx["subjects"]], 0
         if "packed_T" in ctx:
-            packed_T = np.ascontiguousarray(ctx["packed_T"])  # [W, L]
+            # the device->host sync point: this blocks until the async
+            # D2H started at capture time lands
+            with tracing.kernel_span("kernel.transfer", kind="lookup_batch"):
+                packed_T = np.ascontiguousarray(ctx["packed_T"])  # [W, L]
 
             def col_indices(col):
                 return _word_col_indices(packed_T[col // 32], col % 32)
@@ -1551,22 +1601,24 @@ class JaxEndpoint(PermissionsEndpoint):
         per_col_ids: dict = {}  # column -> id list (columns are shared)
         out = []
         total_bad = 0
-        for s in ctx["subjects"]:
-            if s in unknown:
-                out.append(self._oracle.lookup_resources(
-                    ctx["rt"], ctx["perm"], s))
-                continue
-            col = cols[s]
-            lst = per_col_ids.get(col)
-            if lst is None:
-                lst, bad_n, bad_sample = _ids_for(
-                    ids, col_indices(col), ph, mask)
-                if bad_n:
-                    total_bad += bad_n
-                    self._report_suppressed(bad_n, bad_sample,
-                                            ctx["forensic"])
-                per_col_ids[col] = lst
-            out.append(lst)
+        with tracing.span("kernel.extract", kind="lookup_batch",
+                          batch=len(ctx["subjects"])):
+            for s in ctx["subjects"]:
+                if s in unknown:
+                    out.append(self._oracle.lookup_resources(
+                        ctx["rt"], ctx["perm"], s))
+                    continue
+                col = cols[s]
+                lst = per_col_ids.get(col)
+                if lst is None:
+                    lst, bad_n, bad_sample = _ids_for(
+                        ids, col_indices(col), ph, mask)
+                    if bad_n:
+                        total_bad += bad_n
+                        self._report_suppressed(bad_n, bad_sample,
+                                                ctx["forensic"], retry=retry)
+                    per_col_ids[col] = lst
+                out.append(lst)
         return out, total_bad
 
     def _lookup_batch_finish_sync(self, ctx: dict) -> list:
@@ -1576,7 +1628,7 @@ class JaxEndpoint(PermissionsEndpoint):
         if bad_n:
             self._purge_ids_view(ctx["rt"])
             out, bad_n = self._lookup_batch_once(ctx["rt"], ctx["perm"],
-                                                 ctx["subjects"])
+                                                 ctx["subjects"], retry=True)
             if bad_n:
                 with self._lock:
                     self.stats["suppression_oracle_fallbacks"] = (

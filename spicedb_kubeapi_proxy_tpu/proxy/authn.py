@@ -317,8 +317,12 @@ class AuthenticatorChain(Authenticator):
         self.authenticators = authenticators
 
     def authenticate(self, req: Request) -> Optional[UserInfo]:
-        for a in self.authenticators:
-            user = a.authenticate(req)
-            if user is not None:
-                return user
-        return None
+        from ..utils.tracing import span
+
+        with span("authn", phase=True) as attrs:
+            for a in self.authenticators:
+                user = a.authenticate(req)
+                if user is not None:
+                    attrs["authenticator"] = type(a).__name__
+                    return user
+            return None

@@ -29,6 +29,7 @@ from ..authz.responsefilterer import FilterError
 from ..config import proxyrule
 from ..rules.engine import MapMatcher
 from ..spicedb.endpoints import Bootstrap, PermissionsEndpoint, create_endpoint
+from ..utils import tracing
 from .authn import (
     Authenticator,
     AuthenticatorChain,
@@ -54,6 +55,11 @@ from .restmapper import CachingRESTMapper
 logger = logging.getLogger("spicedb_kubeapi_proxy_tpu.proxy")
 
 _KV_TRUNCATE = 200  # keep object/body values from flooding the log line
+
+# health + introspection endpoints are not themselves traced (a scrape
+# of /debug/traces must not evict a real slow trace from the recorder)
+_UNTRACED_PATHS = frozenset(
+    ("/metrics", "/debug/traces", "/readyz", "/livez", "/healthz"))
 
 
 def format_request_kv(req) -> str:
@@ -105,6 +111,10 @@ class Options:
     endpoint_kwargs: dict = field(default_factory=dict)
     # endpoint-boundary check/LR latency + batch-size metrics (SURVEY.md §5)
     enable_metrics: bool = True
+    # requests slower than this (seconds) emit their full trace as a
+    # structured JSON log line; 0 disables the log (traces still feed
+    # /debug/traces and the phase histograms)
+    trace_slow_threshold: float = 0.0
 
 
 class ProxyServer:
@@ -181,6 +191,12 @@ class ProxyServer:
                 resp.headers.set("Content-Type",
                                  "text/plain; version=0.0.4; charset=utf-8")
                 return resp
+            # slow-trace introspection, same trust level as /metrics:
+            # any authenticated principal may read the retained traces
+            if req.path == "/debug/traces":
+                return json_response(200, {
+                    "capacity": tracing.RECORDER.capacity,
+                    "traces": tracing.RECORDER.snapshot()})
             return await authorized(req)
 
         async def with_request_info(req: Request) -> Response:
@@ -200,22 +216,56 @@ class ProxyServer:
                 "proxy_http_request_seconds",
                 "Proxied HTTP request latency by verb",
                 labels=("verb",))
+            phase_latency = REGISTRY.histogram(
+                "authz_request_phase_seconds",
+                "Request latency attributed to tracing phases (authn, "
+                "resolve, match, queue_wait, execute, upstream, "
+                "respfilter, workflow, ...)",
+                labels=("phase",))
         else:
             request_counter = None
             request_latency = None
+            phase_latency = None
+
+        slow_threshold = self.opts.trace_slow_threshold
 
         async def with_logging(req: Request) -> Response:
             from ..utils.features import GATES
+            tr = token = None
+            if req.path not in _UNTRACED_PATHS:
+                # trace-id assignment: honor a well-formed caller id so
+                # multi-hop traces correlate; anything else gets a fresh id
+                tr, token = tracing.start_trace(
+                    trace_id=tracing.clean_trace_id(
+                        req.headers.get(tracing.TRACE_ID_HEADER)),
+                    method=req.method, target=req.target)
             start = time.monotonic()
-            resp = await with_request_info(req)
+            try:
+                resp = await with_request_info(req)
+            finally:
+                if tr is not None:
+                    tracing.end_trace(token)
+                    tr.finish()
             elapsed = time.monotonic() - start
+            info = req.context.get("request_info")
+            verb = info.verb if info else req.method.lower()
+            if tr is not None:
+                user = req.context.get("user")
+                tr.attrs.update(verb=verb, status=resp.status,
+                                **({"user": user.name} if user else {}))
+                resp.headers.set(tracing.TRACE_ID_HEADER, tr.trace_id)
+                if phase_latency is not None:
+                    for phase, secs in tr.phase_durations().items():
+                        phase_latency.observe(secs, phase=phase)
+                tracing.RECORDER.record(tr)
+                if slow_threshold and tr.duration >= slow_threshold:
+                    logger.warning("slow request trace: %s",
+                                   json.dumps(tr.to_dict(), sort_keys=True))
             kv = (format_request_kv(req)
                   if GATES.enabled("StructuredRequestLog") else "")
             logger.info("%s %s -> %d (%.1fms)%s", req.method, req.target,
                         resp.status, elapsed * 1e3, kv)
             if request_counter is not None:
-                info = req.context.get("request_info")
-                verb = info.verb if info else req.method.lower()
                 request_counter.inc(verb=verb, code=resp.status)
                 request_latency.observe(elapsed, verb=verb)
             return resp
@@ -251,7 +301,8 @@ class ProxyServer:
                 up_headers.add(k, v)
             up_req = Request(method=req.method, target=req.target,
                              headers=up_headers, body=req.body)
-            resp = await upstream.round_trip(up_req)
+            with tracing.span("upstream", phase=True):
+                resp = await upstream.round_trip(up_req)
 
             filterer = req.context.get(FILTERER_KEY)
             if filterer is not None:

@@ -23,8 +23,11 @@ unrelated co-batched callers.
 from __future__ import annotations
 
 import asyncio
+import contextlib
+import time
 from typing import Iterable, Optional
 
+from ..utils import tracing
 from .endpoints import PermissionsEndpoint
 from .store import Watcher
 from .types import (
@@ -36,14 +39,80 @@ from .types import (
 )
 
 
+def _trace_ctx() -> Optional[dict]:
+    """Per-caller dispatch trace context, captured at enqueue time; the
+    drain loop stamps exec_start/exec_end into it so the caller can
+    attribute queue wait separately from fused execution.  None when the
+    request is untraced (zero overhead)."""
+    trace = tracing.current_trace()
+    if trace is None:
+        return None
+    return {"trace": trace, "enq": time.perf_counter()}
+
+
+def _record_waiter_spans(tc: Optional[dict]) -> None:
+    """queue_wait (enqueue -> drain pickup) and execute (fused inner
+    call, kernel included) phase spans for one dispatch caller."""
+    if not tc:
+        return
+    now = time.perf_counter()
+    exec_start = tc.get("exec_start", now)
+    trace = tc["trace"]
+    trace.add_span("queue_wait", tc["enq"], exec_start, phase=True)
+    trace.add_span("execute", exec_start, tc.get("exec_end", now), phase=True)
+
+
+def _mark_exec_start(waiters: list) -> None:
+    t0 = time.perf_counter()
+    for w in waiters:
+        if w[2] is not None:
+            w[2].setdefault("exec_start", t0)
+
+
+def _mark_exec_end(waiters: list) -> None:
+    t1 = time.perf_counter()
+    for w in waiters:
+        if w[2] is not None:
+            w[2]["exec_end"] = t1
+
+
+@contextlib.contextmanager
+def _activate_batch_trace(waiters: list):
+    """Activate the co-batched callers' traces (fanned out) for the
+    duration of a fused inner call, so spans the backend records (e.g.
+    jax:// kernel spans) land in EVERY member request's trace.
+
+    Always overrides the contextvar — the drain task was created from
+    some caller's _kick() and INHERITED that caller's trace context, so
+    an all-untraced batch must actively null the sink or its kernel
+    spans would leak into the unrelated kicking request's trace."""
+    traces: list = []
+    seen: set = set()
+    for w in waiters:
+        tc = w[2]
+        if tc is not None and id(tc["trace"]) not in seen:
+            seen.add(id(tc["trace"]))
+            traces.append(tc["trace"])
+    sink = (None if not traces
+            else traces[0] if len(traces) == 1
+            else tracing.FanoutTrace(traces))
+    token = tracing.activate(sink)
+    try:
+        yield
+    finally:
+        tracing.deactivate(token)
+
+
 class BatchingEndpoint(PermissionsEndpoint):
     def __init__(self, inner: PermissionsEndpoint, max_batch: int = 4096):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self.inner = inner
         self.max_batch = max_batch
-        self._check_queue: list = []   # (CheckRequest, Future)
-        self._lr_queue: dict = {}      # (type, perm) -> list[(SubjectRef, Future)]
+        # waiters are (item, Future, trace-ctx-or-None) triples
+        self._check_queue: list = []   # [(CheckRequest, Future, tc)]
+        self._lr_queue: dict = {}      # (type, perm) -> [(SubjectRef, Future, tc)]
+        self._inflight: list = []      # waiters of the batch being executed
         self._drain_task: Optional[asyncio.Task] = None
         self._stats = {"drains": 0, "fused_checks": 0, "fused_lookups": 0,
                        "max_fused_batch": 0}
@@ -74,37 +143,76 @@ class BatchingEndpoint(PermissionsEndpoint):
         pending = None  # (waiters, ctx) started but not finished
         two_phase = (hasattr(self.inner, "lookup_resources_batch_start")
                      and hasattr(self.inner, "lookup_resources_batch_finish"))
-        while self._check_queue or self._lr_queue or pending:
-            self._stats["drains"] += 1
-            if self._check_queue:
-                batch = self._check_queue[: self.max_batch]
-                del self._check_queue[: len(batch)]
-                await self._run_checks(batch)
-            if self._lr_queue:
-                key, waiters = next(iter(self._lr_queue.items()))
-                del self._lr_queue[key]
-                rest = waiters[self.max_batch:]
-                waiters = waiters[: self.max_batch]
-                if rest:
-                    self._lr_queue.setdefault(key, []).extend(rest)
-                if two_phase:
-                    started = await self._start_lookups(key, waiters)
-                    if pending:
-                        await self._finish_lookups(*pending)
-                    pending = started  # None if start failed (handled)
-                else:
-                    await self._run_lookups(key, waiters)
-            elif pending:
-                await self._finish_lookups(*pending)
-                pending = None
+        try:
+            while self._check_queue or self._lr_queue or pending:
+                self._stats["drains"] += 1
+                if self._check_queue:
+                    batch = self._check_queue[: self.max_batch]
+                    del self._check_queue[: len(batch)]
+                    self._inflight = batch
+                    await self._run_checks(batch)
+                    self._inflight = []
+                if self._lr_queue:
+                    key, waiters = next(iter(self._lr_queue.items()))
+                    del self._lr_queue[key]
+                    rest = waiters[self.max_batch:]
+                    waiters = waiters[: self.max_batch]
+                    if rest:
+                        self._lr_queue.setdefault(key, []).extend(rest)
+                    if two_phase:
+                        self._inflight = waiters
+                        started = await self._start_lookups(key, waiters)
+                        self._inflight = []
+                        # `started` becomes `pending` BEFORE the previous
+                        # batch's blocking finish, so a drain death during
+                        # that await still knows about both batches
+                        prev, pending = pending, started
+                        if prev:
+                            self._inflight = prev[0]
+                            await self._finish_lookups(*prev)
+                            self._inflight = []
+                    else:
+                        self._inflight = waiters
+                        await self._run_lookups(key, waiters)
+                        self._inflight = []
+                elif pending:
+                    prev, pending = pending, None
+                    self._inflight = prev[0]
+                    await self._finish_lookups(*prev)
+                    self._inflight = []
+        except BaseException as e:
+            # A cancelled/dying drain task must FAIL its waiters — queued,
+            # in-flight, and started-but-unfinished — or every caller
+            # awaiting a future hangs forever (ADVICE round-5 finding).
+            failure = (RuntimeError("batch dispatch drain task cancelled")
+                       if isinstance(e, asyncio.CancelledError) else e)
+            stranded = list(self._inflight)
+            self._inflight = []
+            if pending:
+                stranded.extend(pending[0])
+            stranded.extend(self._check_queue)
+            del self._check_queue[:]
+            for ws in self._lr_queue.values():
+                stranded.extend(ws)
+            self._lr_queue.clear()
+            for w in stranded:
+                if not w[1].done():
+                    w[1].set_exception(failure)
+            raise
 
     async def _retry_individually(self, waiters: list, single_call) -> None:
         """Per-member fallback after a fused call failed (concurrently —
         a poison request must not serialize the drain loop) so one
         malformed query can't fail unrelated co-batched callers."""
-        async def retry_one(item, fut):
+        async def retry_one(w):
+            item, fut, tc = w
             if fut.done():
                 return
+            # each retry is ONE member's work: activate that member's
+            # trace (or none), never the fused batch fanout — gather's
+            # tasks copy the ambient context, so this reset is needed
+            # even when called inside _activate_batch_trace
+            token = tracing.activate(tc["trace"] if tc else None)
             try:
                 res = await single_call(item)
             except Exception as e:
@@ -113,29 +221,36 @@ class BatchingEndpoint(PermissionsEndpoint):
             else:
                 if not fut.done():
                     fut.set_result(res)
+            finally:
+                tracing.deactivate(token)
 
-        await asyncio.gather(*[retry_one(it, f) for it, f in waiters])
+        await asyncio.gather(*[retry_one(w) for w in waiters])
 
     @staticmethod
     def _resolve(waiters: list, results: list) -> None:
-        for (_, fut), res in zip(waiters, results):
-            if not fut.done():
-                fut.set_result(res)
+        for w, res in zip(waiters, results):
+            if not w[1].done():
+                w[1].set_result(res)
 
     async def _run_fused(self, waiters: list, stat: str, fused_call,
                          single_call) -> None:
-        """One fused inner call for `waiters` ([(item, Future)]); on
+        """One fused inner call for `waiters` ([(item, Future, tc)]); on
         failure, retry members individually."""
-        items = [it for it, _ in waiters]
+        items = [w[0] for w in waiters]
         self._stats[stat] += 1
         self._stats["max_fused_batch"] = max(self._stats["max_fused_batch"],
                                             len(items))
+        _mark_exec_start(waiters)
         try:
-            results = await fused_call(items)
-        except Exception:
-            await self._retry_individually(waiters, single_call)
-            return
-        self._resolve(waiters, results)
+            with _activate_batch_trace(waiters):
+                try:
+                    results = await fused_call(items)
+                except Exception:
+                    await self._retry_individually(waiters, single_call)
+                    return
+            self._resolve(waiters, results)
+        finally:
+            _mark_exec_end(waiters)
 
     async def _run_checks(self, batch: list) -> None:
         await self._run_fused(
@@ -161,9 +276,11 @@ class BatchingEndpoint(PermissionsEndpoint):
         self._stats["fused_lookups"] += 1
         self._stats["max_fused_batch"] = max(self._stats["max_fused_batch"],
                                             len(waiters))
+        _mark_exec_start(waiters)
         try:
-            ctx = await self.inner.lookup_resources_batch_start(
-                resource_type, permission, [s for s, _ in waiters])
+            with _activate_batch_trace(waiters):
+                ctx = await self.inner.lookup_resources_batch_start(
+                    resource_type, permission, [w[0] for w in waiters])
         except Exception:
             self._stats["fused_lookups"] -= 1  # _run_fused recounts
             await self._run_lookups(key, waiters)
@@ -176,55 +293,75 @@ class BatchingEndpoint(PermissionsEndpoint):
         key, ctx = started
         resource_type, permission = key
         try:
-            results = await self.inner.lookup_resources_batch_finish(ctx)
-        except Exception:
-            await self._retry_individually(
-                waiters, lambda s: self.inner.lookup_resources(
-                    resource_type, permission, s))
-            return
-        self._resolve(waiters, results)
+            with _activate_batch_trace(waiters):
+                try:
+                    results = await self.inner.lookup_resources_batch_finish(ctx)
+                except Exception:
+                    await self._retry_individually(
+                        waiters, lambda s: self.inner.lookup_resources(
+                            resource_type, permission, s))
+                    return
+            self._resolve(waiters, results)
+        finally:
+            _mark_exec_end(waiters)
 
     # -- batched verbs -------------------------------------------------------
 
     async def check_permission(self, req: CheckRequest):
+        tc = _trace_ctx()
         fut = asyncio.get_running_loop().create_future()
-        self._check_queue.append((req, fut))
+        self._check_queue.append((req, fut, tc))
         self._kick()
-        return await fut
+        try:
+            return await fut
+        finally:
+            _record_waiter_spans(tc)
 
     async def check_bulk_permissions(self, reqs: list) -> list:
         if not reqs:
             return []
         loop = asyncio.get_running_loop()
+        tc = _trace_ctx()  # one shared ctx: the bulk is one caller
         futs = []
         for r in reqs:
             fut = loop.create_future()
-            self._check_queue.append((r, fut))
+            self._check_queue.append((r, fut, tc))
             futs.append(fut)
         self._kick()
-        return list(await asyncio.gather(*futs))
+        try:
+            return list(await asyncio.gather(*futs))
+        finally:
+            _record_waiter_spans(tc)
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
+        tc = _trace_ctx()
         fut = asyncio.get_running_loop().create_future()
         self._lr_queue.setdefault((resource_type, permission), []).append(
-            (subject, fut))
+            (subject, fut, tc))
         self._kick()
-        return await fut
+        try:
+            return await fut
+        finally:
+            _record_waiter_spans(tc)
 
     async def lookup_resources_batch(self, resource_type: str, permission: str,
                                      subjects: list) -> list:
         if not subjects:
             return []
         loop = asyncio.get_running_loop()
+        tc = _trace_ctx()  # one shared ctx: the batch is one caller
         futs = []
         bucket = self._lr_queue.setdefault((resource_type, permission), [])
         for s in subjects:
             fut = loop.create_future()
-            bucket.append((s, fut))
+            bucket.append((s, fut, tc))
             futs.append(fut)
         self._kick()
-        return list(await asyncio.gather(*futs))
+        try:
+            return list(await asyncio.gather(*futs))
+        finally:
+            _record_waiter_spans(tc)
 
     # -- passthrough verbs ---------------------------------------------------
 

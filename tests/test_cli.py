@@ -313,3 +313,18 @@ def test_serve_tls_end_to_end(tmp_path):
     assert ok.status == 200
     assert json.loads(ok.body)["metadata"]["name"] == "ns1"
     assert anon.status == 401
+
+
+def test_trace_slow_threshold_flag_wires_through(tmp_path):
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES)
+    args = parse(["--rule-config", str(rules), "--use-in-cluster-config",
+                  "--embedded-mode", "--trace-slow-threshold", "1.5"])
+    assert cli.validate(args) == []
+    completed = cli.complete(args, upstream_transport=NullTransport())
+    assert completed.server_options.trace_slow_threshold == 1.5
+    # default off; negative rejected at validate time
+    assert parse([]).trace_slow_threshold == 0.0
+    bad = parse(["--rule-config", str(rules), "--use-in-cluster-config",
+                 "--embedded-mode", "--trace_slow_threshold", "-1"])
+    assert any("trace-slow-threshold" in e for e in cli.validate(bad))

@@ -280,3 +280,65 @@ def test_two_phase_back_to_back_batches_pipeline():
     outs = asyncio.run(run())
     assert all(sorted(o) == ["d0", "d1", "d2", "d3"] for o in outs)
     assert inner.start_calls >= 2
+
+
+def test_cancelled_drain_fails_waiters_instead_of_hanging():
+    """Regression (ADVICE round 5): a drain task cancelled mid-batch
+    must fail every waiter — in-flight AND queued — not strand their
+    futures forever."""
+    class BlockingEndpoint(CountingEndpoint):
+        gate = None
+
+        async def check_bulk_permissions(self, reqs):
+            await self.gate.wait()  # never set: simulates a hung backend
+            return await super().check_bulk_permissions(reqs)
+
+    inner = BlockingEndpoint(sch.parse_schema(SCHEMA))
+    ep = BatchingEndpoint(inner)
+
+    async def run():
+        inner.gate = asyncio.Event()
+        first = asyncio.create_task(ep.check_permission(check("alice")))
+        await asyncio.sleep(0.01)   # drain running, blocked in the fused call
+        second = asyncio.create_task(ep.check_permission(check("bob")))
+        await asyncio.sleep(0.01)   # queued behind the in-flight batch
+        assert not first.done() and not second.done()
+        ep._drain_task.cancel()
+        for waiter in (first, second):
+            with pytest.raises(RuntimeError, match="drain task cancelled"):
+                await asyncio.wait_for(waiter, 2)
+
+    asyncio.run(run())
+
+
+def test_dying_drain_fails_pending_two_phase_waiters():
+    """A started-but-unfinished double-buffered batch (`pending`) must
+    also fail when the drain dies during the NEXT batch's blocking
+    phase."""
+    class ExplodingTwoPhase(CountingEndpoint):
+        started = 0
+
+        async def lookup_resources_batch_start(self, rt, perm, subjects):
+            self.started += 1
+            return ("ctx", rt, perm, subjects)
+
+        async def lookup_resources_batch_finish(self, ctx):
+            raise asyncio.CancelledError()  # drain dies inside phase 2
+
+        async def lookup_resources(self, rt, perm, subject):
+            raise RuntimeError("retry path must not mask the drain death")
+
+    inner = ExplodingTwoPhase(sch.parse_schema(SCHEMA))
+    ep = BatchingEndpoint(inner)
+
+    async def run():
+        a = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        b = asyncio.create_task(
+            ep.lookup_resources("doc", "viewer", SubjectRef("user", "alice")))
+        with pytest.raises((RuntimeError, asyncio.CancelledError)):
+            await asyncio.wait_for(a, 2)
+        with pytest.raises((RuntimeError, asyncio.CancelledError)):
+            await asyncio.wait_for(b, 2)
+
+    asyncio.run(run())

@@ -210,3 +210,111 @@ definition namespace {
     assert 'proxy_http_requests_total{verb="get",code="200"}' in text
     # /metrics requires authentication (kube-apiserver semantics)
     assert denied.status == 401
+
+
+# -- exposition edge cases ---------------------------------------------------
+
+def _unescape_label(v: str) -> str:
+    """Reverse of metrics._escape, for round-trip assertions."""
+    out = []
+    i = 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == '"':
+                out.append('"')
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(v[i])
+        i += 1
+    return "".join(out)
+
+
+def test_label_value_escaping_round_trips():
+    c = m.Counter("esc_total", labels=("path",))
+    tricky = 'a\\b"c\nd'
+    c.inc(path=tricky)
+    (line,) = c.render()
+    # the exposition line itself must stay single-line and parseable
+    assert "\n" not in line
+    assert line.endswith(" 1")
+    start = line.index('path="') + len('path="')
+    end = line.rindex('"')
+    assert _unescape_label(line[start:end]) == tricky
+
+
+def test_histogram_inf_bucket_and_count_stay_consistent():
+    h = m.Histogram("edge_h", buckets=(0.1, 1.0))
+    # boundary values are le-inclusive; 50.0 lands only in +Inf
+    for v in (0.0, 0.1, 1.0, 1.0000001, 50.0):
+        h.observe(v)
+    lines = h.render()
+    bucket_counts = [int(line.rsplit(" ", 1)[1])
+                     for line in lines if "_bucket" in line]
+    assert bucket_counts == [2, 3, 5]  # cumulative, monotone
+    inf = int([line for line in lines
+               if 'le="+Inf"' in line][0].rsplit(" ", 1)[1])
+    count = int([line for line in lines
+                 if line.startswith("edge_h_count")][0].rsplit(" ", 1)[1])
+    assert inf == count == h.count() == 5
+
+
+def test_gauge_callback_raising_at_scrape_keeps_last_value():
+    state = {"fail": False}
+
+    def sampler():
+        if state["fail"]:
+            raise RuntimeError("sampler broke at scrape time")
+        return 2.0
+
+    g = m.Gauge("g_cb", callback=sampler)
+    assert g.render() == ["g_cb 2"]
+    state["fail"] = True
+    # a raising callback must never break the whole /metrics scrape;
+    # the last good value is served
+    assert g.render() == ["g_cb 2"]
+
+
+def test_gauge_callback_raising_before_first_sample_renders_default():
+    def sampler():
+        raise RuntimeError("always broken")
+
+    g = m.Gauge("g_cb_never", callback=sampler)
+    assert g.render() == ["g_cb_never 0"]
+
+
+def test_concurrent_observe_from_threads_is_consistent():
+    import threading
+
+    h = m.Histogram("conc_h", buckets=(0.5,))
+
+    def work():
+        for i in range(1000):
+            h.observe(0.25 if i % 2 else 0.75)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count() == 8000
+    lines = h.render()
+    first = int([line for line in lines
+                 if 'le="0.5"' in line][0].rsplit(" ", 1)[1])
+    inf = int([line for line in lines
+               if 'le="+Inf"' in line][0].rsplit(" ", 1)[1])
+    total = int([line for line in lines
+                 if line.startswith("conc_h_count")][0].rsplit(" ", 1)[1])
+    assert first == 4000
+    assert inf == total == 8000
+    s = float([line for line in lines
+               if line.startswith("conc_h_sum")][0].rsplit(" ", 1)[1])
+    assert abs(s - (4000 * 0.25 + 4000 * 0.75)) < 1e-6
