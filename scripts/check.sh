@@ -15,7 +15,9 @@ python -m compileall -q spicedb_kubeapi_proxy_tpu tests bench.py __graft_entry__
 
 echo "== lint gate (scripts/lint.py; CI additionally runs ruff)"
 # the default paths cover the whole package tree — including the tracing
-# module (spicedb_kubeapi_proxy_tpu/utils/tracing.py)
+# module (spicedb_kubeapi_proxy_tpu/utils/tracing.py) — and enforce the
+# metrics-cardinality allowlist (M001: identities live in audit events,
+# never in metric labels)
 python scripts/lint.py
 
 if [[ "${1:-}" != "--fast" ]]; then

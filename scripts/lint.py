@@ -13,6 +13,12 @@ Checks:
   W291  trailing whitespace
   E501  line longer than 100 characters
   TAB   hard tab in indentation
+  M001  metric label name outside the bounded-cardinality allowlist
+        (package code only): audit EVENTS carry identities (usernames,
+        object names); metric LABELS must never — a `user=` label is an
+        unbounded time-series explosion and an identity leak in every
+        scrape.  Extend ALLOWED_METRIC_LABELS only with label names
+        whose value set is bounded by config/schema, not by traffic.
 
 (E712 `== True` is deliberately NOT enforced: the codebase compares
 numpy bools where `is True` would silently change semantics.)
@@ -27,6 +33,19 @@ from pathlib import Path
 DEFAULT_PATHS = ["spicedb_kubeapi_proxy_tpu", "tests", "scripts",
                  "bench.py", "__graft_entry__.py"]
 MAX_LINE = 100
+
+# bounded-cardinality metric label names (M001).  Everything here has a
+# value set bounded by configuration or schema: verbs, status codes,
+# tracing phases, backend schemes, kube resource names, drop reasons,
+# audit stages/decisions, gc generations, histogram `le`.
+ALLOWED_METRIC_LABELS = frozenset((
+    "verb", "code", "phase", "backend", "resource", "reason", "stage",
+    "decision", "generation", "le",
+))
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+# the cardinality contract applies to shipping code; tests/scripts mint
+# throwaway registries with synthetic labels
+_M001_PREFIX = "spicedb_kubeapi_proxy_tpu"
 
 
 def iter_py(paths):
@@ -99,6 +118,50 @@ class Visitor(ast.NodeVisitor):
                         (self.path, node.lineno, "E711",
                          "comparison to None with ==/!= (use is/is not)"))
         self.generic_visit(node)
+
+    def visit_Call(self, node):
+        self._check_metric_labels(node)
+        self.generic_visit(node)
+
+    def _check_metric_labels(self, node):
+        """M001: registry.counter/gauge/histogram(labels=(...)) label
+        names must come from the bounded-cardinality allowlist."""
+        # package-path test by parts, so absolute paths (pre-commit
+        # hooks, IDEs) don't silently disable the gate
+        if _M001_PREFIX not in Path(self.path).parts:
+            return
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in _METRIC_FACTORIES):
+            return
+        label_values = [kw.value for kw in node.keywords
+                        if kw.arg == "labels"]
+        # labels is also the third positional parameter of
+        # counter/gauge/histogram — positional call sites must not
+        # bypass the gate
+        if len(node.args) >= 3:
+            label_values.append(node.args[2])
+        for value in label_values:
+            if not isinstance(value, (ast.Tuple, ast.List)):
+                self.findings.append(
+                    (self.path, node.lineno, "M001",
+                     "metric labels must be a literal tuple/list so the "
+                     "cardinality gate can verify the names"))
+                continue
+            for el in value.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)):
+                    self.findings.append(
+                        (self.path, el.lineno, "M001",
+                         "metric label name must be a string literal"))
+                    continue
+                if el.value not in ALLOWED_METRIC_LABELS:
+                    self.findings.append(
+                        (self.path, el.lineno, "M001",
+                         f"metric label {el.value!r} is not in the "
+                         f"bounded-cardinality allowlist "
+                         f"(identities belong in audit events, not "
+                         f"metric labels)"))
 
 
 def lint_file(path, findings):

@@ -8,13 +8,24 @@ be HAS_PERMISSION.
 
 from __future__ import annotations
 
+from typing import Optional
+
 from ..rules.engine import ResolveInput
 from ..spicedb.endpoints import PermissionsEndpoint
 from ..spicedb.types import CheckRequest, ObjectRef, SubjectRef
 
 
 class UnauthorizedError(Exception):
-    pass
+    """A failed bulk check; carries the failing relationship and the rule
+    that generated it so the audit event (and an explain witness) can
+    name the exact check that denied the request."""
+
+    def __init__(self, message: str, rel=None, rule: str = "",
+                 check_type: str = ""):
+        super().__init__(message)
+        self.rel = rel            # resolved relationship (rel_string-able)
+        self.rule = rule          # ProxyRule name the template came from
+        self.check_type = check_type
 
 
 def check_request_from_rel(rel) -> CheckRequest:
@@ -27,16 +38,21 @@ def check_request_from_rel(rel) -> CheckRequest:
 
 
 async def check_relationships(endpoint: PermissionsEndpoint, resolved_rels: list,
-                              check_type: str) -> None:
-    """One bulk check; all must pass (reference check.go:18-72)."""
+                              check_type: str,
+                              rules_of: Optional[list] = None) -> None:
+    """One bulk check; all must pass (reference check.go:18-72).
+    `rules_of` (parallel to `resolved_rels`) attributes each rel to the
+    ProxyRule that generated it for the UnauthorizedError."""
     if not resolved_rels:
         return
     reqs = [check_request_from_rel(rel) for rel in resolved_rels]
     results = await endpoint.check_bulk_permissions(reqs)
-    for rel, result in zip(resolved_rels, results):
+    for i, (rel, result) in enumerate(zip(resolved_rels, results)):
         if not result.allowed:
             raise UnauthorizedError(
-                f"bulk {check_type} failed for {rel.rel_string()}")
+                f"bulk {check_type} failed for {rel.rel_string()}",
+                rel=rel, rule=rules_of[i] if rules_of else "",
+                check_type=check_type)
 
 
 async def _run_exprs(endpoint: PermissionsEndpoint, rules_list: list,
@@ -44,11 +60,16 @@ async def _run_exprs(endpoint: PermissionsEndpoint, rules_list: list,
     # All templates across all matched rules resolve first, then fold into
     # ONE CheckBulkPermissions call for the whole request (reference
     # check.go:23-48 collects every checkRel before the single bulk RPC).
-    resolved = [rel
-                for r in rules_list
-                for expr in getattr(r, attr)
-                for rel in expr.generate_relationships(input)]
-    await check_relationships(endpoint, resolved, check_type)
+    resolved: list = []
+    rules_of: list = []
+    for r in rules_list:
+        rule_name = getattr(r, "name", "")
+        for expr in getattr(r, attr):
+            for rel in expr.generate_relationships(input):
+                resolved.append(rel)
+                rules_of.append(rule_name)
+    await check_relationships(endpoint, resolved, check_type,
+                              rules_of=rules_of)
 
 
 async def run_all_matching_checks(endpoint: PermissionsEndpoint,

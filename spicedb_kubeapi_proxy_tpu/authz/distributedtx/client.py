@@ -18,11 +18,16 @@ from .workflow import (
 def setup_workflow_engine(endpoint: PermissionsEndpoint,
                           kube_transport: Transport,
                           database_path: str = "",
-                          default_lock_mode: str = STRATEGY_PESSIMISTIC) -> tuple:
+                          default_lock_mode: str = STRATEGY_PESSIMISTIC,
+                          audit=None) -> tuple:
     """Returns (engine-as-client, engine-as-worker); the caller starts the
-    worker (reference SetupWithSQLiteBackend / SetupWithMemoryBackend)."""
+    worker (reference SetupWithSQLiteBackend / SetupWithMemoryBackend).
+    `audit` (utils/audit.AuditSink) receives one dual-write decision
+    event per completed workflow instance."""
+    from ...utils.audit import NULL_SINK
     journal = SQLiteJournal(database_path) if database_path else MemoryJournal()
-    engine = WorkflowEngine(journal)
+    engine = WorkflowEngine(journal, audit=audit if audit is not None
+                            else NULL_SINK)
     handler = ActivityHandler(endpoint, kube_transport)
     engine.register_activity("write_to_spicedb", handler.write_to_spicedb)
     engine.register_activity("read_relationships", handler.read_relationships)

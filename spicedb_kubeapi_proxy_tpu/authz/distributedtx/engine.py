@@ -16,6 +16,13 @@ import json
 import traceback
 from typing import Any, Awaitable, Callable, Optional
 
+from ...utils.audit import (
+    AuditEvent,
+    NULL_SINK,
+    OUTCOME_ALLOWED,
+    OUTCOME_DENIED,
+    OUTCOME_ERROR,
+)
 from ...utils.failpoints import FailPointPanic
 from ...utils.tracing import span
 from . import journal as journal_mod
@@ -39,6 +46,11 @@ class WorkflowContext:
         self._activities = activities
         self._replay = journal.events(instance_id)
         self._seq = 0
+        # out-of-band run annotations (NOT journaled): workflows record
+        # rollback reasons here so the completion audit event can report
+        # the rollback outcome; replayed (already-journaled) activities
+        # re-record their notes because the workflow body re-runs
+        self.notes: dict = {}
 
     async def execute_activity(self, name: str, *args: Any) -> Any:
         """Run (or replay) the next activity in the deterministic sequence."""
@@ -97,7 +109,8 @@ Workflow = Callable[[WorkflowContext, dict], Awaitable[Optional[dict]]]
 class WorkflowEngine:
     """Client + monoprocess worker (reference client.go:32-77)."""
 
-    def __init__(self, journal: Journal, max_crash_replays: int = 50):
+    def __init__(self, journal: Journal, max_crash_replays: int = 50,
+                 audit=NULL_SINK):
         self.journal = journal
         self._workflows: dict[str, Workflow] = {}
         self._activities: dict[str, Callable] = {}
@@ -105,6 +118,15 @@ class WorkflowEngine:
         self._task: Optional[asyncio.Task] = None
         self._done_events: dict[str, asyncio.Event] = {}
         self.max_crash_replays = max_crash_replays
+        self.audit = audit
+        # strong refs to eagerly-launched instance tasks: the event loop
+        # holds tasks only weakly, so a fire-and-forget ensure_future is
+        # collectable by the cyclic gc MID-FLIGHT — the instance then
+        # hangs forever and its waiter times out ("Task was destroyed
+        # but it is pending").  Latent since the eager path existed; it
+        # surfaces whenever allocation churn lands a gen-2 collection
+        # inside the workflow window.
+        self._eager_tasks: set = set()
 
     # -- registration --------------------------------------------------------
 
@@ -122,8 +144,11 @@ class WorkflowEngine:
         self.journal.create_instance(instance_id, workflow, input)
         self._done_events[instance_id] = asyncio.Event()
         if self._task is None:
-            # no polling worker: execute eagerly in this loop
-            asyncio.ensure_future(self._run_instance(instance_id))
+            # no polling worker: execute eagerly in this loop (keeping a
+            # strong reference — see _eager_tasks)
+            task = asyncio.ensure_future(self._run_instance(instance_id))
+            self._eager_tasks.add(task)
+            task.add_done_callback(self._eager_tasks.discard)
         else:
             self._wakeup.set()
         return instance_id
@@ -195,6 +220,7 @@ class WorkflowEngine:
                 instance_id, None, error=f"unknown workflow {rec.workflow!r}")
             self._signal(instance_id)
             return
+        ctx = None
         while True:
             ctx = WorkflowContext(instance_id, self.journal, self._activities)
             try:
@@ -217,7 +243,53 @@ class WorkflowEngine:
                 break
             self.journal.complete_instance(instance_id, result)
             break
+        self._audit_instance(instance_id, ctx)
         self._signal(instance_id)
+
+    def _audit_instance(self, instance_id: str, ctx) -> None:
+        """Dual-write decision audit: one event per completed instance —
+        committed / rolled-back (kube 409 etc.) / failed — with any
+        rollback reasons the workflow noted."""
+        if not self.audit.enabled:
+            return
+        rec = self.journal.get_instance(instance_id)
+        if rec is None:
+            return
+        input = rec.input or {}
+        result = rec.result or {}
+        notes = list((getattr(ctx, "notes", None) or {}).get("rollbacks", ()))
+        code = result.get("status_code", 0)
+        if rec.status == journal_mod.STATUS_FAILED:
+            decision = OUTCOME_ERROR
+            message = (rec.error or "workflow failed").splitlines()[0]
+            if notes:
+                message += "; " + "; ".join(notes)
+        elif notes or (code and code >= 400):
+            # the write did NOT land as requested: SpiceDB conflict
+            # surfaced as kube 409, or a kube failure forced a rollback
+            decision = OUTCOME_DENIED
+            message = "; ".join(notes) if notes else f"status {code}"
+        else:
+            decision, message = OUTCOME_ALLOWED, ""
+        from ...utils import tracing
+        tr = tracing.current_trace()
+        name = input.get("object_name") or input.get("request_name") or ""
+        if message:
+            message = f"instance {instance_id}: {message}"
+        self.audit.emit(AuditEvent(
+            stage="dualwrite", decision=decision,
+            user=input.get("user_name", ""),
+            verb=input.get("verb", ""),
+            api_group=input.get("api_group", ""),
+            resource=input.get("resource", ""),
+            names=(name,) if name else (), count=1,
+            rule=rec.workflow,
+            backend=getattr(self.audit, "backend", ""),
+            # prefer the journaled originating trace id: crash-recovery
+            # replays complete outside any live request context
+            trace_id=(input.get("trace_id", "")
+                      or getattr(tr, "trace_id", "")),
+            message=message))
 
     def _signal(self, instance_id: str) -> None:
         event = self._done_events.get(instance_id)

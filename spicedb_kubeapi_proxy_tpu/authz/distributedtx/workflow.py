@@ -46,6 +46,12 @@ async def _cleanup(ctx: WorkflowContext, rollback_updates: list,
     the instance durable across crashes; the client's 30s result timeout
     does not stop the workflow) and bails only on unrecoverable
     invalid-argument errors."""
+    if reason.startswith("rollback"):
+        # note the outcome for the engine's dual-write audit event (the
+        # post-success lock cleanup is not a rollback and is not noted)
+        notes = getattr(ctx, "notes", None)
+        if notes is not None:
+            notes.setdefault("rollbacks", []).append(reason)
     updates = [_invert(u) for u in rollback_updates]
     while True:
         try:

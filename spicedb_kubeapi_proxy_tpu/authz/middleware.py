@@ -3,10 +3,16 @@
 Per-request orchestration: extract ResolveInput -> match rules -> CEL filter
 -> run Checks (concurrent bulk) -> dispatch to the update workflow / watch
 filter / prefilter+response-filter / post-check / post-filter path.
+
+Every decision taken here emits a structured audit event (utils/audit.py):
+stage names which gate decided (resolve/match/check/postcheck/update/watch),
+and — with explain mode on — a denial carries the relation-path witness
+(authz/explain.py) naming the check that excluded the caller.
 """
 
 from __future__ import annotations
 
+import time
 
 from ..proxy.httpcore import Handler, Request, Response, json_response
 from ..proxy.kube import RequestInfo
@@ -15,6 +21,15 @@ from ..rules.engine import (
     ResolveError,
     filter_rules_with_cel_conditions,
     resolve_input_from_request)
+from ..utils.audit import (
+    AuditEvent,
+    AuditSink,
+    NULL_SINK,
+    OUTCOME_ALLOWED,
+    OUTCOME_ALWAYS_ALLOW,
+    OUTCOME_DENIED,
+    OUTCOME_ERROR,
+)
 from ..utils.tracing import span
 from ..spicedb.endpoints import PermissionsEndpoint
 from .check import (
@@ -33,6 +48,8 @@ from .rulesel import MultipleRulesError, single_pre_filter_rule, single_update_r
 UPDATE_VERBS = ("create", "update", "patch", "delete")
 
 FILTERER_KEY = "response_filterer"
+AUDIT_KEY = "audit_sink"
+EXPLAIN_KEY = "audit_explain"
 
 
 def forbidden_response(message: str) -> Response:
@@ -56,12 +73,79 @@ def should_run_post_filters(verb: str, rules_list: list) -> bool:
     return verb == "list" and any(r.post_filter for r in rules_list)
 
 
+def audit_event_for(req: Request, stage: str, decision: str,
+                    **overrides) -> AuditEvent:
+    """Build an AuditEvent from the request context: identity, verb/GVR,
+    matched rules, and the active trace id/latency come for free so
+    decision sites only add stage/decision and payload fields."""
+    from ..utils import tracing
+
+    ev = AuditEvent(stage=stage, decision=decision)
+    user = req.context.get("user")
+    if user is not None:
+        ev.user = user.name
+        ev.groups = tuple(user.groups)
+    info = req.context.get("request_info")
+    if info is not None:
+        ev.verb = info.verb
+        ev.api_group = info.api_group
+        ev.api_version = info.api_version
+        ev.resource = info.resource
+        ev.namespace = info.namespace
+        if info.name:
+            ev.names = (info.name,)
+            ev.count = 1
+    rules = req.context.get("matched_rules")
+    if rules:
+        ev.rule = ",".join(rules)
+    tr = tracing.current_trace()
+    trace_id = getattr(tr, "trace_id", "")
+    if trace_id:
+        ev.trace_id = trace_id
+        ev.latency_ms = (time.perf_counter() - tr.t0) * 1e3
+    sink: AuditSink = req.context.get(AUDIT_KEY) or NULL_SINK
+    ev.backend = getattr(sink, "backend", "")
+    for k, v in overrides.items():
+        setattr(ev, k, v)
+    return ev
+
+
+def _emit(req: Request, stage: str, decision: str, **overrides) -> None:
+    sink: AuditSink = req.context.get(AUDIT_KEY) or NULL_SINK
+    if not sink.enabled:
+        return
+    sink.emit(audit_event_for(req, stage, decision, **overrides))
+
+
+def explain_requested(req: Request) -> bool:
+    """Explain mode: the sink-wide flag (--audit-explain) or a per-request
+    `?explain=1` query parameter."""
+    sink = req.context.get(AUDIT_KEY) or NULL_SINK
+    if getattr(sink, "explain", False):
+        return sink.enabled
+    target = getattr(req, "target", "") or ""
+    _, _, query = target.partition("?")
+    return sink.enabled and any(
+        p in ("explain=1", "explain=true") for p in query.split("&"))
+
+
+async def _denial_witness(req: Request, endpoint, rel):
+    """Relation-path witness for a failed check (None when explain is off
+    or the backend cannot witness)."""
+    if rel is None or not explain_requested(req):
+        return None
+    from .explain import witness_dict_for_rel
+
+    return await witness_dict_for_rel(endpoint, rel)
+
+
 def with_authorization(handler: Handler, failed: Handler,
                        rest_mapper: CachingRESTMapper,
                        endpoint: PermissionsEndpoint,
                        matcher_ref,  # callable returning the current matcher
                        workflow_client=None,
-                       input_extractor=None) -> Handler:
+                       input_extractor=None,
+                       audit: AuditSink = NULL_SINK) -> Handler:
     """Build the authorization handler (reference authz.go:23-197).
 
     `matcher_ref` is a zero-arg callable returning the active MapMatcher so
@@ -72,8 +156,11 @@ def with_authorization(handler: Handler, failed: Handler,
         user = req.context["user"]
         # structured request logging (reference requestlogger.go +
         # rules.go:242-279): the logging middleware reads these back out
-        # of the request context after the chain completes
-        req.context["authz_outcome"] = "denied"
+        # of the request context after the chain completes.  The outcome
+        # vocabulary is the shared enum in utils/audit.py so metrics,
+        # traces, and audit events join by trace id.
+        req.context["authz_outcome"] = OUTCOME_DENIED
+        req.context[AUDIT_KEY] = audit
         try:
             with span("resolve", phase=True):
                 if input_extractor is not None:
@@ -82,12 +169,14 @@ def with_authorization(handler: Handler, failed: Handler,
                     input = resolve_input_from_request(
                         info, user, req.body, req.headers.to_dict())
         except ResolveError as e:
+            _emit(req, "resolve", OUTCOME_DENIED, message=str(e))
             return forbidden_response(str(e))
         req.context["resolve_input"] = input
 
         if always_allow(info):
-            req.context["authz_outcome"] = "always_allow"
+            req.context["authz_outcome"] = OUTCOME_ALWAYS_ALLOW
             req.context[FILTERER_KEY] = EmptyResponseFilterer()
+            _emit(req, "match", OUTCOME_ALWAYS_ALLOW)
             return await handler(req)
 
         # rule matching + CEL condition filtering are one attribution
@@ -104,6 +193,9 @@ def with_authorization(handler: Handler, failed: Handler,
                     cel_failed = True
             match_attrs["rules"] = len(filtered_rules)
         if cel_failed or not filtered_rules:
+            _emit(req, "match", OUTCOME_DENIED,
+                  message=("CEL condition resolution failed" if cel_failed
+                           else "no rule matched"))
             return await failed(req)
         req.context["matched_rules"] = [r.name for r in filtered_rules]
 
@@ -112,16 +204,28 @@ def with_authorization(handler: Handler, failed: Handler,
             # queue_wait/execute phase spans for the bulk check itself
             with span("check"):
                 await run_all_matching_checks(endpoint, filtered_rules, input)
-        except (UnauthorizedError, ResolveError):
+        except UnauthorizedError as e:
+            _emit(req, "check", OUTCOME_DENIED,
+                  rule=e.rule or ",".join(r.name for r in filtered_rules),
+                  rel=e.rel.rel_string() if e.rel is not None else "",
+                  message=str(e),
+                  explain=await _denial_witness(req, endpoint, e.rel))
+            return await failed(req)
+        except ResolveError as e:
+            _emit(req, "check", OUTCOME_ERROR, message=str(e))
             return await failed(req)
 
         try:
             update_rule = single_update_rule(filtered_rules)
-        except MultipleRulesError:
+        except MultipleRulesError as e:
+            _emit(req, "match", OUTCOME_DENIED, message=str(e))
             return await failed(req)
 
         if update_rule is not None:
             if info.verb not in UPDATE_VERBS:
+                _emit(req, "update", OUTCOME_DENIED,
+                      rule=update_rule.name,
+                      message=f"update rule on non-update verb {info.verb}")
                 return await failed(req)
             if workflow_client is None:
                 return json_response(500, {
@@ -130,28 +234,38 @@ def with_authorization(handler: Handler, failed: Handler,
                     "message": "update engine not configured"})
             from .update import perform_update
             try:
-                req.context["authz_outcome"] = "allowed"
+                req.context["authz_outcome"] = OUTCOME_ALLOWED
+                _emit(req, "update", OUTCOME_ALLOWED, rule=update_rule.name)
                 with span("workflow", phase=True):
                     return await perform_update(update_rule, input, req,
                                                 workflow_client)
             except Exception as e:
+                req.context["authz_outcome"] = OUTCOME_ERROR
+                _emit(req, "update", OUTCOME_ERROR, rule=update_rule.name,
+                      message=str(e))
                 return forbidden_response(f"failed to perform update: {e}")
 
         if info.verb == "watch":
             try:
                 watch_rule = single_pre_filter_rule(filtered_rules)
-            except MultipleRulesError:
+            except MultipleRulesError as e:
+                _emit(req, "match", OUTCOME_DENIED, message=str(e))
                 return await failed(req)
             if watch_rule is None:
+                _emit(req, "watch", OUTCOME_DENIED,
+                      message="no pre-filter rule for watch")
                 return await failed(req)
             filterer = WatchResponseFilterer(rest_mapper, input, watch_rule,
-                                             endpoint)
+                                             endpoint, audit=audit)
             try:
                 filterer.run_watcher()
-            except Exception:
+            except Exception as e:
+                _emit(req, "watch", OUTCOME_ERROR, rule=watch_rule.name,
+                      message=str(e))
                 return await failed(req)
             req.context[FILTERER_KEY] = filterer
-            req.context["authz_outcome"] = "allowed"
+            req.context["authz_outcome"] = OUTCOME_ALLOWED
+            _emit(req, "watch", OUTCOME_ALLOWED, rule=watch_rule.name)
             return await handler(req)
 
         filterer = StandardResponseFilterer(rest_mapper, input,
@@ -159,7 +273,8 @@ def with_authorization(handler: Handler, failed: Handler,
         req.context[FILTERER_KEY] = filterer
         try:
             filterer.run_pre_filters()
-        except Exception:
+        except Exception as e:
+            _emit(req, "check", OUTCOME_ERROR, message=str(e))
             return await failed(req)
 
         if should_run_post_checks(info.verb):
@@ -169,9 +284,20 @@ def with_authorization(handler: Handler, failed: Handler,
                     with span("postcheck"):
                         await run_all_matching_post_checks(
                             endpoint, filtered_rules, input)
-                except (UnauthorizedError, ResolveError):
+                except UnauthorizedError as e:
+                    _emit(req, "postcheck", OUTCOME_DENIED,
+                          rule=e.rule,
+                          rel=(e.rel.rel_string() if e.rel is not None
+                               else ""),
+                          message=str(e),
+                          explain=await _denial_witness(req, endpoint,
+                                                        e.rel))
                     return await failed(req)
-            req.context["authz_outcome"] = "allowed"
+                except ResolveError as e:
+                    _emit(req, "postcheck", OUTCOME_ERROR, message=str(e))
+                    return await failed(req)
+            req.context["authz_outcome"] = OUTCOME_ALLOWED
+            _emit(req, "check", OUTCOME_ALLOWED)
             return resp
         if should_run_post_filters(info.verb, filtered_rules):
             resp = await handler(req)
@@ -180,14 +306,17 @@ def with_authorization(handler: Handler, failed: Handler,
                     with span("postfilter"):
                         body = await filter_list_response(
                             resp.body, filtered_rules, input, endpoint)
-                except Exception:
+                except Exception as e:
+                    _emit(req, "postfilter", OUTCOME_ERROR, message=str(e))
                     return await failed(req)
                 resp.body = body
                 resp.headers.set("Content-Type", "application/json")
                 resp.headers.set("Content-Length", str(len(body)))
-            req.context["authz_outcome"] = "allowed"
+            req.context["authz_outcome"] = OUTCOME_ALLOWED
+            _emit(req, "postfilter", OUTCOME_ALLOWED)
             return resp
-        req.context["authz_outcome"] = "allowed"
+        req.context["authz_outcome"] = OUTCOME_ALLOWED
+        _emit(req, "check", OUTCOME_ALLOWED)
         return await handler(req)
 
     return authorized

@@ -28,14 +28,23 @@ from ..rules.engine import (
     resolve_rel,
 )
 from ..spicedb.endpoints import PermissionsEndpoint
+from ..utils.audit import (
+    MAX_NAMES_PER_EVENT,
+    NULL_SINK,
+    OUTCOME_ALLOWED,
+    OUTCOME_DENIED,
+)
 from ..utils.tracing import span
 from .lookups import PrefilterResult, run_lookup_resources
 from .rulesel import single_pre_filter_rule
-from .watch import WatchTracker, run_watch
+from .watch import WATCH_FILTERED_TOTAL, WatchTracker, run_watch
 
 PREFILTER_TIMEOUT = 10.0
 # max not-yet-authorized frames buffered per watch (overflow drops oldest)
 WATCH_BUFFER_CAP = 1024
+# explained hidden objects per filtered list: one witness per hidden name
+# up to this bound (a 10k-pod list must not trigger 10k oracle walks)
+MAX_EXPLAINED_DENIALS = MAX_NAMES_PER_EVENT
 
 
 class FilterError(Exception):
@@ -48,6 +57,39 @@ def _unauthorized_status(message: str) -> dict:
         "status": "Failure", "message": message, "reason": "Unauthorized",
         "code": 401,
     }
+
+
+class _RecordingResult:
+    """PrefilterResult wrapper recording each membership decision so the
+    batched filter pass fans ONE audit event per object-group (allowed /
+    denied), never one per object.  Bounded: counters plus a fixed-size
+    name sample — a 10k-pod list must not allocate 10k tuples on the hot
+    filter path just to feed an 8-name audit sample."""
+
+    _SAMPLE = max(MAX_NAMES_PER_EVENT, MAX_EXPLAINED_DENIALS)
+
+    def __init__(self, inner: PrefilterResult):
+        self.inner = inner
+        self.allowed_count = 0
+        self.denied_count = 0
+        self.allowed_names: list = []  # first _SAMPLE (namespace, name)
+        self.denied_names: list = []
+
+    @property
+    def all_allowed(self) -> bool:
+        return self.inner.all_allowed
+
+    def is_allowed(self, namespace: str, name: str) -> bool:
+        ok = self.inner.is_allowed(namespace, name)
+        if ok:
+            self.allowed_count += 1
+            if len(self.allowed_names) < self._SAMPLE:
+                self.allowed_names.append((namespace, name))
+        else:
+            self.denied_count += 1
+            if len(self.denied_names) < self._SAMPLE:
+                self.denied_names.append((namespace, name))
+        return ok
 
 
 class ResponseFilterer:
@@ -69,6 +111,12 @@ class StandardResponseFilterer(ResponseFilterer):
         self.endpoint = endpoint
         self._prefilter_started = False
         self._prefilter_future: Optional[asyncio.Future] = None
+        # strong ref: the loop holds tasks weakly; an unreferenced LR
+        # task is collectable by the cyclic gc mid-flight (same latent
+        # bug as the workflow engine's eager path)
+        self._prefilter_task: Optional[asyncio.Task] = None
+        self._resolved_prefilter: Optional[ResolvedPreFilter] = None
+        self._prefilter_rule_name = ""
 
     def run_pre_filters(self) -> None:
         """Start the LR concurrently with the upstream request
@@ -93,6 +141,8 @@ class StandardResponseFilterer(ResponseFilterer):
             namespace_from_object_id=f.namespace_from_object_id,
             rel=rel,
         )
+        self._resolved_prefilter = resolved
+        self._prefilter_rule_name = rule.name
 
         async def runner():
             try:
@@ -111,7 +161,7 @@ class StandardResponseFilterer(ResponseFilterer):
                 if not self._prefilter_future.done():
                     self._prefilter_future.set_exception(e)
 
-        asyncio.ensure_future(runner())
+        self._prefilter_task = asyncio.ensure_future(runner())
 
     async def filter_resp(self, resp: Response, req: Request) -> None:
         if not self._prefilter_started:
@@ -130,8 +180,78 @@ class StandardResponseFilterer(ResponseFilterer):
         except Exception as e:
             raise FilterError(f"pre-filter error: {e}") from e
 
+        from .middleware import AUDIT_KEY
+
+        sink = req.context.get(AUDIT_KEY) or NULL_SINK
+        if sink.enabled:
+            # record membership decisions so the pass fans one audit
+            # event per object-GROUP (allowed / denied), not per object
+            result = _RecordingResult(result)
         with span("respfilter", phase=True):
             await self._apply_filters(resp, req, result)
+        if isinstance(result, _RecordingResult):
+            await self._audit_groups(req, sink, result)
+
+    async def _audit_groups(self, req: Request, sink,
+                            rec: "_RecordingResult") -> None:
+        """One event per decision group; explained denials attach a
+        relation-path witness per hidden object (bounded)."""
+        from .middleware import audit_event_for, explain_requested
+
+        rule = self._prefilter_rule_name
+        if not rule:
+            # no prefilter rule (all_allowed pass-through): keep the
+            # request's matched rules from the context
+            rule = ",".join(req.context.get("matched_rules") or ())
+        if rec.allowed_count:
+            sink.emit(audit_event_for(
+                req, "respfilter", OUTCOME_ALLOWED, rule=rule,
+                names=tuple(f"{ns}/{n}" if ns else n
+                            for ns, n in
+                            rec.allowed_names[:MAX_NAMES_PER_EVENT]),
+                count=rec.allowed_count))
+        if not rec.denied_count:
+            return
+        explain = None
+        rel = (self._resolved_prefilter.rel
+               if self._resolved_prefilter is not None else None)
+        if rel is not None and explain_requested(req):
+            from .explain import witness_dict_for_rel
+
+            explain = {}
+            for ns, n in rec.denied_names[:MAX_EXPLAINED_DENIALS]:
+                oid = self._explain_oid(rel, ns, n)
+                w = await witness_dict_for_rel(self.endpoint, rel,
+                                               object_id=oid)
+                if w is not None:
+                    explain[oid] = w
+        sink.emit(audit_event_for(
+            req, "respfilter", OUTCOME_DENIED, rule=rule,
+            rel=rel.rel_string() if rel is not None else "",
+            names=tuple(f"{ns}/{n}" if ns else n
+                        for ns, n in
+                        rec.denied_names[:MAX_NAMES_PER_EVENT]),
+            count=rec.denied_count,
+            explain=explain))
+
+    def _explain_oid(self, rel, namespace: str, name: str) -> str:
+        """Best-effort inverse of the rule's fromObjectID expressions:
+        the proxy's dominant id convention is namespacedName ("ns/name",
+        bare name cluster-scoped).  Rules whose namespace comes from the
+        REQUEST (lookups.py namespace fallback) key objects by bare
+        name — detected by asking the endpoint's store which id it
+        actually knows, so the witness never probes a fabricated id."""
+        primary = f"{namespace}/{name}" if namespace else name
+        if namespace:
+            store = getattr(self.endpoint, "store", None)
+            if store is not None:
+                try:
+                    ids = store.object_ids_of_type(rel.resource_type)
+                    if primary not in ids and name in ids:
+                        return name
+                except Exception:
+                    pass
+        return primary
 
     async def _apply_filters(self, resp: Response, req: Request,
                              result: PrefilterResult) -> None:
@@ -272,14 +392,55 @@ def new_empty_response_filterer(rest_mapper, input) -> EmptyResponseFilterer:
 
 
 class WatchResponseFilterer(ResponseFilterer):
+    # class-level defaults so partially-constructed instances (tests
+    # drive _filtered_stream directly) still count and audit safely
+    input: Optional[ResolveInput] = None
+    watch_rule: Optional[RunnableRule] = None
+    audit = NULL_SINK
+
     def __init__(self, rest_mapper: CachingRESTMapper, input: ResolveInput,
-                 watch_rule: RunnableRule, endpoint: PermissionsEndpoint):
+                 watch_rule: RunnableRule, endpoint: PermissionsEndpoint,
+                 audit=NULL_SINK):
         self.rest_mapper = rest_mapper
         self.input = input
         self.watch_rule = watch_rule
         self.endpoint = endpoint
+        self.audit = audit
         self._tracker: Optional[WatchTracker] = None
         self._watch_task: Optional[asyncio.Task] = None
+
+    @property
+    def _resource(self) -> str:
+        """Bounded metric label: the kube resource this watch serves."""
+        info = self.input.request if self.input is not None else None
+        return (info.resource if info is not None else "") or "unknown"
+
+    def _count_filtered(self) -> None:
+        WATCH_FILTERED_TOTAL.inc(resource=self._resource)
+
+    def _audit_watch(self, decision: str, namespace: str, name: str,
+                     message: str = "") -> None:
+        """Mid-stream decision event (no live Request context: watch
+        frames outlive the request that opened the stream)."""
+        if not self.audit.enabled:
+            return
+        from ..utils.audit import AuditEvent
+        from ..utils import tracing
+
+        user = self.input.user if self.input is not None else None
+        info = (self.input.request if self.input is not None
+                else None) or RequestInfo()
+        tr = tracing.current_trace()
+        self.audit.emit(AuditEvent(
+            stage="watch", decision=decision,
+            user=user.name if user else "",
+            groups=tuple(user.groups) if user else (),
+            verb="watch", api_group=info.api_group,
+            api_version=info.api_version, resource=info.resource,
+            namespace=namespace, names=(name,) if name else (), count=1,
+            rule=self.watch_rule.name if self.watch_rule else "",
+            backend=getattr(self.audit, "backend", ""),
+            trace_id=getattr(tr, "trace_id", ""), message=message))
 
     def run_watcher(self) -> None:
         """Start the SpiceDB-side watch (reference responsefilterer.go:434-460)."""
@@ -396,13 +557,26 @@ class WatchResponseFilterer(ResponseFilterer):
                 if kind == "change":
                     nn = (payload.namespace, payload.name)
                     if payload.allowed:
+                        if nn not in allowed:
+                            # grant events are audited symmetrically
+                            # with revocations (per-frame deliveries are
+                            # not — one decision, not one per frame)
+                            self._audit_watch(OUTCOME_ALLOWED, *nn,
+                                              message="granted")
                         allowed.add(nn)
                         if nn in buffered:
                             raw = buffered.pop(nn)
                             yield raw
                     else:
+                        was_visible = nn in allowed or nn in buffered
+                        if nn in buffered:
+                            # a buffered frame the client will never see
+                            self._count_filtered()
                         allowed.discard(nn)
                         buffered.pop(nn, None)
+                        if was_visible:
+                            self._audit_watch(OUTCOME_DENIED, *nn,
+                                              message="revoked")
                     continue
                 raw = payload
                 try:
@@ -417,6 +591,7 @@ class WatchResponseFilterer(ResponseFilterer):
                     logging.getLogger(__name__).error(
                         "dropping undecodable watch frame (%d bytes, "
                         "proto=%s): %s", len(raw), proto, e)
+                    self._count_filtered()
                     continue
                 if is_status:
                     # status events pass through and the stream CONTINUES
@@ -429,10 +604,14 @@ class WatchResponseFilterer(ResponseFilterer):
                     if nn in allowed:
                         yield raw
                     else:
+                        # buffered, NOT yet counted as filtered: a later
+                        # grant may still deliver it — only definitive
+                        # drops (revocation/overflow/undecodable) count
                         buffered[nn] = raw
                         if len(buffered) > WATCH_BUFFER_CAP:
                             victim = next(iter(buffered))
                             buffered.pop(victim)
+                            self._count_filtered()
                             import logging
                             logging.getLogger(__name__).warning(
                                 "watch buffer cap %d exceeded; dropped "

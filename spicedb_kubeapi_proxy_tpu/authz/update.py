@@ -96,8 +96,14 @@ def build_write_input(rule: RunnableRule, input: ResolveInput,
     probe_uri = req.path
     if input.name and not req.name:
         probe_uri = f"{req.path}/{input.name}"
+    # the originating trace id rides the (journaled) workflow input so
+    # the dual-write audit event still correlates when the instance is
+    # replayed at crash recovery, outside any live request context
+    from ..utils import tracing
+    trace_id = getattr(tracing.current_trace(), "trace_id", "")
     return {
         "verb": req.verb,
+        "trace_id": trace_id,
         "request_uri": request_uri,
         "request_path": req.path,
         "request_name": req.name,

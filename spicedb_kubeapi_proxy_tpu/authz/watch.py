@@ -4,6 +4,15 @@ Watches the tuple store for updates on the prefilter's resource type; each
 update triggers a CheckPermission for the watching subject and pushes an
 allow/revoke change keyed by NamespacedName into the tracker consumed by
 the watch response filterer.
+
+Filtering accounting: watch filtering used to be entirely silent — a
+denied check or a dropped frame left no counter anywhere.
+`authz_watch_events_filtered_total{resource=}` counts two DISJOINT
+series: denied per-update checks here (labeled by the SpiceDB resource
+type, e.g. `pod`) and definitively-dropped frames in the response
+filterer (revocation of a buffered frame, buffer overflow, undecodable
+frames — labeled by the kube resource, e.g. `pods`).  Buffering alone is
+not counted: a buffered frame may still be delivered by a later grant.
 """
 
 from __future__ import annotations
@@ -14,7 +23,16 @@ from dataclasses import dataclass, field
 from ..rules.engine import ResolveInput, ResolvedPreFilter
 from ..spicedb.endpoints import PermissionsEndpoint
 from ..spicedb.types import CheckRequest, ObjectRef, SubjectRef
+from ..utils.metrics import REGISTRY
 from .lookups import extract_namespaced_name
+
+# one counter, two increment sites (see module docstring); the label is
+# a resource name — bounded by the schema/rules, never an identity
+WATCH_FILTERED_TOTAL = REGISTRY.counter(
+    "authz_watch_events_filtered_total",
+    "Watch events filtered away from clients (denied update checks and "
+    "dropped/withheld frames), by resource",
+    labels=("resource",))
 
 
 @dataclass
@@ -55,6 +73,9 @@ async def run_watch(endpoint: PermissionsEndpoint, tracker: WatchTracker,
                                        config.rel.subject_id,
                                        config.rel.subject_relation),
                 ))
+                if not result.allowed:
+                    WATCH_FILTERED_TOTAL.inc(
+                        resource=config.rel.resource_type)
                 namespace, name = extract_namespaced_name(
                     config, input, resource_id, u.rel.subject.id)
                 await tracker.changes.put(ResultChange(

@@ -142,6 +142,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "trace (per-phase span breakdown) as structured "
                         "JSON (0 disables the log; traces always feed "
                         "/debug/traces and the phase histograms)")
+    p.add_argument("--audit-level", default="Metadata",
+                   help="decision-audit level: None (off), Metadata "
+                        "(identity + decision), Request (adds relationship "
+                        "strings, caveat context, explain witnesses); "
+                        "recent decisions serve at /debug/decisions")
+    p.add_argument("--audit-sample-every", type=int, default=1,
+                   help="emit 1 of every N ALLOWED decisions per "
+                        "(user, verb); denials and errors always pass")
+    p.add_argument("--audit-explain", action="store_true",
+                   help="attach the relation-path witness to every audited "
+                        "denial (otherwise only requests with ?explain=1 "
+                        "are explained)")
 
     p.add_argument("-v", "--verbosity", type=int, default=3,
                    help="log verbosity (reference defaults to 3)")
@@ -176,6 +188,13 @@ def validate(args: argparse.Namespace) -> list:
         errs.append(f"--secure-port {args.secure_port} is not a valid port")
     if args.trace_slow_threshold < 0:
         errs.append("--trace-slow-threshold must be >= 0")
+    from .utils.audit import parse_level
+    try:
+        parse_level(args.audit_level)
+    except ValueError as e:
+        errs.append(f"--audit-level: {e}")
+    if args.audit_sample_every < 1:
+        errs.append("--audit-sample-every must be >= 1")
     return errs
 
 
@@ -313,6 +332,9 @@ def complete(args: argparse.Namespace,
         ssl_context=ssl_context,
         endpoint_kwargs=endpoint_kwargs,
         trace_slow_threshold=args.trace_slow_threshold,
+        audit_level=args.audit_level,
+        audit_sample_every=args.audit_sample_every,
+        audit_explain=args.audit_explain,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,

@@ -779,9 +779,11 @@ class JaxEndpoint(PermissionsEndpoint):
         self._caveated_pairs: set = set()
         self._caveat_affected: set = set()
         self._caveated_keys: set = set()
+        # explain_checks pre-seeded: InstrumentedEndpoint registers its
+        # scrape-time gauges from the keys present at construction
         self.stats = {"rebuilds": 0, "delta_batches": 0, "kernel_calls": 0,
                       "oracle_residual_checks": 0, "spare_assignments": 0,
-                      "spare_reclaims": 0}
+                      "spare_reclaims": 0, "explain_checks": 0}
         self._spare_pool: dict = {}
         # (type, id) -> live tuple keys, for spare-ASSIGNED ids only: when
         # the set empties the row is renamed back to a placeholder and
@@ -1672,6 +1674,62 @@ class JaxEndpoint(PermissionsEndpoint):
 
     def watch(self, object_types: Optional[Iterable[str]] = None) -> Watcher:
         return self.store.subscribe(object_types)
+
+    # -- decision explain ----------------------------------------------------
+
+    def explain_check(self, resource: ObjectRef, permission: str,
+                      subject: SubjectRef):
+        """Per-check evaluation witness (authz/explain.py Witness).
+
+        One targeted re-check through the real kernel path pins the
+        decision; the witness path comes from the host replay of the
+        staged SpMV iterate over the compiled program (allowed rows:
+        which relation hop / fixpoint iteration admitted the subject —
+        no device work beyond the re-check).  Incremental deltas applied
+        since the last compile live in the device tables, not the
+        program's edge arrays, so a replay that disagrees with the
+        kernel — and every denial/conditional — is explained by the
+        (always-current) host oracle instead.
+        """
+        from ..authz.explain import device_witness, oracle_witness
+
+        req = CheckRequest(resource=resource, permission=permission,
+                           subject=subject)
+        result = self._check_batch_sync([req])[0]
+        decision = {
+            Permissionship.HAS_PERMISSION: "allowed",
+            Permissionship.CONDITIONAL_PERMISSION: "conditional",
+            Permissionship.NO_PERMISSION: "denied",
+        }[result.permissionship]
+        with self._lock:
+            self.stats["explain_checks"] += 1
+        prog = sidx = tidx = None
+        if decision == "allowed":
+            with self._lock:
+                graph = self._graph
+                if graph is not None:
+                    prog = graph.prog
+                    sidx = prog.subject_index(subject.type, subject.id,
+                                              subject.relation)
+                    tidx = prog.state_index(resource.type, permission,
+                                            resource.id)
+        if prog is not None and sidx is not None and tidx is not None:
+            # prog arrays are immutable after compile: replay runs
+            # outside the lock
+            w = device_witness(prog, sidx, tidx)
+            if w.decision == decision:
+                w.backend = "jax"
+                return w
+            # replay disagreed (post-compile deltas / caveat planes):
+            # the oracle reads the live store and stays authoritative
+        w = oracle_witness(self.schema, self.store, resource, permission,
+                           subject)
+        w.backend = "jax"
+        if w.decision != decision:
+            w.note = (f"kernel decision {decision!r} diverges from oracle "
+                      f"witness {w.decision!r}")
+            w.decision = decision
+        return w
 
     # -- maintenance hooks --------------------------------------------------
 

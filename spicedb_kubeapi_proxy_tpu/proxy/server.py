@@ -30,6 +30,7 @@ from ..config import proxyrule
 from ..rules.engine import MapMatcher
 from ..spicedb.endpoints import Bootstrap, PermissionsEndpoint, create_endpoint
 from ..utils import tracing
+from ..utils.audit import AuditSink, LEVEL_METADATA, normalize_outcome
 from .authn import (
     Authenticator,
     AuthenticatorChain,
@@ -59,7 +60,8 @@ _KV_TRUNCATE = 200  # keep object/body values from flooding the log line
 # health + introspection endpoints are not themselves traced (a scrape
 # of /debug/traces must not evict a real slow trace from the recorder)
 _UNTRACED_PATHS = frozenset(
-    ("/metrics", "/debug/traces", "/readyz", "/livez", "/healthz"))
+    ("/metrics", "/debug/traces", "/debug/decisions", "/readyz", "/livez",
+     "/healthz"))
 
 
 def format_request_kv(req) -> str:
@@ -90,7 +92,8 @@ def format_request_kv(req) -> str:
         parts.append(("rules", ",".join(rules)))
     outcome = req.context.get("authz_outcome")
     if outcome is not None:
-        parts.append(("authz", outcome))
+        from ..utils.audit import normalize_outcome as _norm
+        parts.append(("authz", _norm(outcome)))
     if not parts:
         return ""
     return " " + " ".join(f"{k}={v!r}" for k, v in parts)
@@ -115,6 +118,13 @@ class Options:
     # structured JSON log line; 0 disables the log (traces still feed
     # /debug/traces and the phase histograms)
     trace_slow_threshold: float = 0.0
+    # decision audit (utils/audit.py): level policy (None/Metadata/Request),
+    # 1-in-N per-user+verb sampling of ALLOWED decisions (denials always
+    # pass), and explain mode (every audited denial carries the
+    # relation-path witness; off, `?explain=1` still explains per request)
+    audit_level: str = LEVEL_METADATA
+    audit_sample_every: int = 1
+    audit_explain: bool = False
 
 
 class ProxyServer:
@@ -127,15 +137,19 @@ class ProxyServer:
         self.endpoint: PermissionsEndpoint = create_endpoint(
             opts.spicedb_endpoint, bootstrap=opts.bootstrap,
             **opts.endpoint_kwargs)
+        # label = URL scheme; a scheme-less host:port endpoint is a
+        # remote gRPC dial — label it "grpc" rather than leaking the
+        # hostname into metric label cardinality
+        ep_str = opts.spicedb_endpoint
+        backend = (ep_str.split(":")[0] if "://" in ep_str else "grpc")
         if opts.enable_metrics:
             from ..spicedb.instrumented import InstrumentedEndpoint
-            # label = URL scheme; a scheme-less host:port endpoint is a
-            # remote gRPC dial — label it "grpc" rather than leaking the
-            # hostname into metric label cardinality
-            ep_str = opts.spicedb_endpoint
-            backend = (ep_str.split(":")[0] if "://" in ep_str else "grpc")
             self.endpoint = InstrumentedEndpoint(
                 self.endpoint, backend_label=backend)
+        self.audit = AuditSink(level=opts.audit_level,
+                               sample_every=opts.audit_sample_every,
+                               explain=opts.audit_explain,
+                               backend=backend)
         configs = list(opts.rule_configs)
         if opts.rules_yaml:
             configs.extend(proxyrule.parse(opts.rules_yaml))
@@ -150,6 +164,7 @@ class ProxyServer:
         self._worker = None
         self.handler = self._build_chain()
         self._http: Optional[HttpServer] = None
+        self._lag_probe = None
 
     # -- dual-write wiring ---------------------------------------------------
 
@@ -158,7 +173,8 @@ class ProxyServer:
         self.workflow_client, self._worker = setup_workflow_engine(
             self.endpoint, self.opts.upstream_transport,
             self.opts.workflow_database_path,
-            default_lock_mode=self.opts.lock_mode_default)
+            default_lock_mode=self.opts.lock_mode_default,
+            audit=self.audit)
         self.handler = self._build_chain()
 
     # -- chain ---------------------------------------------------------------
@@ -172,7 +188,8 @@ class ProxyServer:
         authorized = with_authorization(
             cluster_proxy, failed, self.rest_mapper, self.endpoint,
             matcher_ref=lambda: self.matcher,
-            workflow_client=self.workflow_client)
+            workflow_client=self.workflow_client,
+            audit=self.audit)
 
         async def authenticated(req: Request) -> Response:
             user = self.authenticator.authenticate(req)
@@ -197,6 +214,15 @@ class ProxyServer:
                 return json_response(200, {
                     "capacity": tracing.RECORDER.capacity,
                     "traces": tracing.RECORDER.snapshot()})
+            # decision-audit introspection (same trust level): the ring
+            # buffer of recent decisions, newest first, at the sink's
+            # configured level
+            if req.path == "/debug/decisions":
+                return json_response(200, {
+                    "level": self.audit.level,
+                    "ring_capacity": self.audit.ring_capacity,
+                    "sample_every": self.audit.sample_every,
+                    "decisions": self.audit.recent()})
             return await authorized(req)
 
         async def with_request_info(req: Request) -> Response:
@@ -249,10 +275,19 @@ class ProxyServer:
             elapsed = time.monotonic() - start
             info = req.context.get("request_info")
             verb = info.verb if info else req.method.lower()
+            # one outcome vocabulary across log kv, trace attrs, and
+            # audit events (utils/audit.py OUTCOME_*), so the three
+            # surfaces join by trace id without value translation
+            raw_outcome = req.context.get("authz_outcome")
+            outcome = (normalize_outcome(raw_outcome)
+                       if raw_outcome is not None else None)
+            if raw_outcome is not None:
+                req.context["authz_outcome"] = outcome
             if tr is not None:
                 user = req.context.get("user")
                 tr.attrs.update(verb=verb, status=resp.status,
-                                **({"user": user.name} if user else {}))
+                                **({"user": user.name} if user else {}),
+                                **({"outcome": outcome} if outcome else {}))
                 resp.headers.set(tracing.TRACE_ID_HEADER, tr.trace_id)
                 if phase_latency is not None:
                     for phase, secs in tr.phase_durations().items():
@@ -325,6 +360,17 @@ class ProxyServer:
         bound = await self._http.start(host, port)
         if self._worker is not None:
             await self._worker.start()
+        # audit writer + runtime self-metrics ride the serving lifecycle;
+        # embedded (handler-only) use still audits through the ring
+        # buffer — only the JSON-line writer needs the loop task
+        await self.audit.start()
+        if self.opts.enable_metrics:
+            from ..utils.metrics import EventLoopLagProbe, \
+                install_runtime_metrics
+            install_runtime_metrics()
+            if self._lag_probe is None:
+                self._lag_probe = EventLoopLagProbe()
+            await self._lag_probe.start()
         return bound
 
     async def stop(self) -> None:
@@ -333,6 +379,9 @@ class ProxyServer:
             self._http = None
         if self._worker is not None:
             await self._worker.stop()
+        if self._lag_probe is not None:
+            await self._lag_probe.stop()
+        await self.audit.stop()
 
     # -- embedded client (reference server.go:317-364, pkg/inmemory) ---------
 

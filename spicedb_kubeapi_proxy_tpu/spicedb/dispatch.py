@@ -114,8 +114,10 @@ class BatchingEndpoint(PermissionsEndpoint):
         self._lr_queue: dict = {}      # (type, perm) -> [(SubjectRef, Future, tc)]
         self._inflight: list = []      # waiters of the batch being executed
         self._drain_task: Optional[asyncio.Task] = None
+        # explain_bypass pre-seeded so InstrumentedEndpoint's one-shot
+        # gauge registration sees the key
         self._stats = {"drains": 0, "fused_checks": 0, "fused_lookups": 0,
-                       "max_fused_batch": 0}
+                       "max_fused_batch": 0, "explain_bypass": 0}
 
     @property
     def stats(self) -> dict:
@@ -364,6 +366,18 @@ class BatchingEndpoint(PermissionsEndpoint):
             _record_waiter_spans(tc)
 
     # -- passthrough verbs ---------------------------------------------------
+
+    def explain_check(self, resource, permission, subject):
+        """Witness capture bypasses the fused queue: an explain is a
+        targeted re-check on a rare debug path — co-batching it would
+        make the captured iterate depend on whatever requests it fused
+        with, and a queue backlog would stall the audit event it feeds."""
+        self._stats["explain_bypass"] += 1
+        fn = getattr(self.inner, "explain_check", None)
+        if fn is not None:
+            return fn(resource, permission, subject)
+        from ..authz.explain import witness_for
+        return witness_for(self.inner, resource, permission, subject)
 
     async def read_relationships(self, flt: RelationshipFilter) -> list:
         return await self.inner.read_relationships(flt)

@@ -230,3 +230,110 @@ class Timer:
     def __exit__(self, *exc):
         self.histogram.observe(time.perf_counter() - self._t0, **self.labels)
         return False
+
+
+# -- runtime self-metrics ----------------------------------------------------
+# The round-5 soak correlated RSS/latency spikes only through EXTERNAL
+# sampling (SOAK_r05.json); these put the same signals in the proxy's own
+# scrape so one Prometheus query joins them with the request metrics.
+
+
+def _read_rss_bytes() -> float:
+    """Resident set size; /proc on linux, ru_maxrss (high-water mark, the
+    closest portable signal) elsewhere."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+        rss = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        # ru_maxrss is KB on linux/bsd but BYTES on macOS
+        return rss if sys.platform == "darwin" else rss * 1024.0
+    except Exception:
+        return 0.0
+
+
+_gc_hook_installed = False
+
+
+def install_runtime_metrics(registry: Optional[Registry] = None) -> None:
+    """Register the process self-metrics (idempotent):
+
+    - `process_resident_memory_bytes` gauge, sampled at scrape time;
+    - `proxy_gc_collections_total{generation=}` + `proxy_gc_pause_seconds`
+      via gc callbacks (each collection's stop-the-world pause).
+    """
+    global _gc_hook_installed
+    registry = registry or REGISTRY
+    registry.gauge("process_resident_memory_bytes",
+                   "Resident set size of the proxy process",
+                   callback=_read_rss_bytes)
+    gc_collections = registry.counter(
+        "proxy_gc_collections_total",
+        "Garbage collections observed via gc callbacks, by generation",
+        labels=("generation",))
+    gc_pause = registry.histogram(
+        "proxy_gc_pause_seconds",
+        "Stop-the-world pause of each observed gc collection")
+    if _gc_hook_installed:
+        return
+    _gc_hook_installed = True
+    import gc
+
+    starts: dict = {}
+
+    def _gc_callback(phase, info):
+        gen = info.get("generation", -1)
+        if phase == "start":
+            starts[gen] = time.perf_counter()
+        else:
+            t0 = starts.pop(gen, None)
+            gc_collections.inc(generation=str(gen))
+            if t0 is not None:
+                gc_pause.observe(time.perf_counter() - t0)
+
+    gc.callbacks.append(_gc_callback)
+
+
+class EventLoopLagProbe:
+    """Event-loop responsiveness via timer drift: sleep(interval) and
+    observe how late the wakeup lands.  A multi-second `execute` phase
+    blocking the loop (the failure mode the off-loop kernel dispatch
+    exists to prevent) shows up here before it shows up as p99."""
+
+    def __init__(self, interval: float = 0.25,
+                 registry: Optional[Registry] = None):
+        registry = registry or REGISTRY
+        self.interval = interval
+        self.lag = registry.histogram(
+            "proxy_event_loop_lag_seconds",
+            "Wakeup drift of a periodic event-loop timer (scheduling lag)")
+        self._task = None
+
+    async def start(self) -> None:
+        import asyncio
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        import asyncio
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+    async def _run(self) -> None:
+        import asyncio
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(self.interval)
+            self.lag.observe(max(0.0, loop.time() - t0 - self.interval))

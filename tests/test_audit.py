@@ -1,0 +1,577 @@
+"""Decision audit subsystem (utils/audit.py + the middleware/server
+integration): sink backpressure and sampling, level policy, ring-buffer
+eviction, /debug/decisions authn, per-stage events through the full
+proxy chain, watch filtering counters, and dual-write audit."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import FakeKubeApiServer
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
+from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipFilter,
+    RelationshipUpdate,
+    UpdateOp,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.utils.audit import (
+    AuditEvent,
+    AuditSink,
+    LEVEL_METADATA,
+    LEVEL_NONE,
+    LEVEL_REQUEST,
+    OUTCOME_ALLOWED,
+    OUTCOME_DENIED,
+    normalize_outcome,
+    parse_level,
+)
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+definition pod {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+"""
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [get]}]
+check: [{tpl: "pod:{{namespacedName}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-watch-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list, watch]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+check: [{tpl: "namespace:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+"""
+
+
+def make_proxy(level=LEVEL_METADATA, **audit_kw):
+    kube = FakeKubeApiServer()
+    for i in range(4):
+        ns = "team-a" if i % 2 == 0 else "team-b"
+        kube.seed("", "v1", "pods",
+                  {"metadata": {"name": f"p{i}", "namespace": ns}})
+    proxy = ProxyServer(Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=HandlerTransport(kube),
+        audit_level=level,
+        **audit_kw,
+    ))
+    proxy.endpoint.store.bulk_load([parse_relationship(r) for r in (
+        "namespace:team-a#creator@user:alice",
+        "pod:team-a/p0#creator@user:alice",
+        "pod:team-a/p2#creator@user:alice",
+        "pod:team-b/p1#creator@user:bob",
+        "pod:team-b/p3#creator@user:bob",
+    )])
+    return proxy, kube
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def events(proxy, stage=None, decision=None):
+    out = proxy.audit.recent()
+    if stage is not None:
+        out = [e for e in out if e["stage"] == stage]
+    if decision is not None:
+        out = [e for e in out if e["decision"] == decision]
+    return out
+
+
+class TestSinkUnit:
+    def test_parse_level(self):
+        assert parse_level("metadata") == LEVEL_METADATA
+        assert parse_level("NONE") == LEVEL_NONE
+        with pytest.raises(ValueError):
+            parse_level("nope")
+
+    def test_normalize_outcome(self):
+        assert normalize_outcome("allowed") == OUTCOME_ALLOWED
+        assert normalize_outcome("always_allow") == "always_allow"
+        assert normalize_outcome(None) == "error"
+        assert normalize_outcome("weird") == "error"
+
+    def test_level_none_disables(self):
+        sink = AuditSink(level=LEVEL_NONE)
+        assert not sink.enabled
+        assert not sink.emit(AuditEvent(stage="check",
+                                        decision=OUTCOME_DENIED))
+        assert sink.dropped_total.value(reason="level") >= 1
+        assert sink.recent() == []
+
+    def test_backpressure_drops_counted_deterministically(self):
+        """A writer that never drains: exactly `capacity` events are
+        queued, every further emit is dropped and counted — and emit
+        never blocks (no writer task is even running)."""
+        sink = AuditSink(level=LEVEL_METADATA, capacity=8,
+                         ring_capacity=1024)
+        base = sink.dropped_total.value(reason="backpressure")
+        accepted = sum(
+            1 for i in range(50)
+            if sink.emit(AuditEvent(stage="check", decision=OUTCOME_DENIED,
+                                    user=f"u{i}")))
+        assert accepted == 8
+        assert sink.dropped_total.value(reason="backpressure") - base == 42
+        # the ring still retains every event (independent of the writer)
+        assert len(sink.recent()) == 50
+
+    def test_slow_writer_never_blocks_emitters(self):
+        """A pathologically slow writer callable: emits stay sub-ms and
+        the queue stays bounded."""
+        import time as _time
+
+        def glacial(line):
+            _time.sleep(10)  # would hang the test if emit ever called it
+
+        sink = AuditSink(level=LEVEL_METADATA, capacity=4, writer=glacial)
+        t0 = _time.perf_counter()
+        for i in range(100):
+            sink.emit(AuditEvent(stage="check", decision=OUTCOME_DENIED))
+        assert _time.perf_counter() - t0 < 1.0
+        assert len(sink._queue) <= 4
+
+    def test_ring_eviction(self):
+        sink = AuditSink(level=LEVEL_METADATA, ring_capacity=4,
+                         capacity=1000)
+        for i in range(10):
+            sink.emit(AuditEvent(stage="check", decision=OUTCOME_DENIED,
+                                 user=f"u{i}"))
+        recent = sink.recent()
+        assert [e["user"] for e in recent] == ["u9", "u8", "u7", "u6"]
+
+    def test_sampling_per_user_verb_allowed_only(self):
+        sink = AuditSink(level=LEVEL_METADATA, sample_every=5,
+                         capacity=1000)
+        allowed = sum(
+            1 for _ in range(20)
+            if sink.emit(AuditEvent(stage="check", decision=OUTCOME_ALLOWED,
+                                    user="alice", verb="get")))
+        assert allowed == 4  # 1 in 5
+        # denials bypass sampling entirely
+        denied = sum(
+            1 for _ in range(20)
+            if sink.emit(AuditEvent(stage="check", decision=OUTCOME_DENIED,
+                                    user="alice", verb="get")))
+        assert denied == 20
+        # a different (user, verb) key samples independently
+        assert sink.emit(AuditEvent(stage="check", decision=OUTCOME_ALLOWED,
+                                    user="bob", verb="get"))
+
+    def test_writer_task_drains_json_lines(self):
+        lines = []
+        sink = AuditSink(level=LEVEL_REQUEST, writer=lines.append)
+
+        async def go():
+            await sink.start()
+            sink.emit(AuditEvent(stage="check", decision=OUTCOME_DENIED,
+                                 user="alice", rel="pod:x#view@user:alice",
+                                 message="nope"))
+            for _ in range(50):
+                if lines:
+                    break
+                await asyncio.sleep(0.02)
+            await sink.stop()
+        run(go())
+        assert len(lines) == 1
+        ev = json.loads(lines[0])
+        assert ev["user"] == "alice"
+        assert ev["rel"] == "pod:x#view@user:alice"  # Request level
+        assert ev["message"] == "nope"
+
+    def test_metadata_level_strips_request_payload(self):
+        ev = AuditEvent(stage="check", decision=OUTCOME_DENIED,
+                        user="alice", rel="pod:x#view@user:alice",
+                        caveat_context={"k": "v"}, message="m")
+        md = ev.to_dict(LEVEL_METADATA)
+        assert "rel" not in md and "caveat_context" not in md
+        assert "message" not in md
+        full = ev.to_dict(LEVEL_REQUEST)
+        assert full["rel"] and full["caveat_context"] == {"k": "v"}
+
+
+class TestProxyIntegration:
+    def test_denied_get_emits_check_event(self):
+        proxy, _ = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            assert (await alice.get(
+                "/api/v1/namespaces/team-b/pods/p1")).status == 403
+        run(go())
+        evs = events(proxy, stage="check", decision=OUTCOME_DENIED)
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["user"] == "alice"
+        assert ev["verb"] == "get"
+        assert ev["gvr"].endswith("v1/pods")
+        assert ev["names"] == ["p1"]
+        assert ev["rule"] == "get-pods"
+        assert ev["backend"] == "embedded"
+        assert ev["trace_id"]
+
+    def test_list_fans_one_event_per_group(self):
+        """A filtered list emits exactly one allowed-group and one
+        denied-group event, not one per object."""
+        proxy, _ = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            assert (await alice.get("/api/v1/pods")).status == 200
+        run(go())
+        allowed = events(proxy, stage="respfilter", decision=OUTCOME_ALLOWED)
+        denied = events(proxy, stage="respfilter", decision=OUTCOME_DENIED)
+        assert len(allowed) == 1 and len(denied) == 1
+        assert sorted(allowed[0]["names"]) == ["team-a/p0", "team-a/p2"]
+        assert allowed[0]["count"] == 2
+        assert sorted(denied[0]["names"]) == ["team-b/p1", "team-b/p3"]
+        assert denied[0]["count"] == 2
+
+    def test_explain_query_attaches_witness_per_hidden_pod(self):
+        proxy, _ = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            assert (await alice.get("/api/v1/pods?explain=1")).status == 200
+        run(go())
+        denied = events(proxy, stage="respfilter", decision=OUTCOME_DENIED)
+        assert denied and denied[0]["explain"]
+        for oid, witness in denied[0]["explain"].items():
+            assert witness["decision"] == "denied"
+            rels = [h["rel"] for h in witness["probed"]]
+            assert any(oid in r for r in rels)
+
+    def test_match_denial_audited(self):
+        proxy, _ = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            assert (await alice.get("/api/v1/nodes/n1")).status == 403
+        run(go())
+        evs = events(proxy, stage="match", decision=OUTCOME_DENIED)
+        assert evs and evs[0]["gvr"].endswith("v1/nodes")
+
+    def test_always_allow_audited_with_shared_enum(self):
+        proxy, _ = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            assert (await alice.get("/api")).status == 200
+        run(go())
+        evs = events(proxy, stage="match", decision="always_allow")
+        assert evs
+
+    def test_level_none_emits_nothing(self):
+        proxy, _ = make_proxy(level="None")
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            await alice.get("/api/v1/pods")
+            await alice.get("/api/v1/namespaces/team-b/pods/p1")
+        run(go())
+        assert proxy.audit.recent() == []
+
+    def test_debug_decisions_requires_authn(self):
+        proxy, _ = make_proxy()
+        anon = proxy.get_embedded_client()  # no identity headers
+
+        async def go():
+            resp = await anon.get("/debug/decisions")
+            assert resp.status == 401
+            alice = proxy.get_embedded_client(user="alice")
+            await alice.get("/api/v1/namespaces/team-b/pods/p1")
+            resp = await alice.get("/debug/decisions")
+            assert resp.status == 200
+            body = json.loads(resp.body)
+            assert body["level"] == LEVEL_METADATA
+            assert any(e["decision"] == OUTCOME_DENIED
+                       for e in body["decisions"])
+        run(go())
+
+    def test_debug_decisions_not_self_audited(self):
+        proxy, _ = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            for _ in range(3):
+                await alice.get("/debug/decisions")
+        run(go())
+        assert proxy.audit.recent() == []
+
+    def test_dualwrite_commit_audited(self):
+        proxy, _ = make_proxy()
+        proxy.enable_dual_writes()
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            resp = await alice.post(
+                "/api/v1/namespaces/team-a/pods",
+                {"kind": "Pod", "apiVersion": "v1",
+                 "metadata": {"name": "web-0", "namespace": "team-a"}})
+            assert resp.status in (200, 201), resp.body
+        run(go())
+        update = events(proxy, stage="update", decision=OUTCOME_ALLOWED)
+        assert update and update[0]["rule"] == "create-pods"
+        dual = events(proxy, stage="dualwrite")
+        assert dual and dual[0]["decision"] == OUTCOME_ALLOWED
+        assert dual[0]["names"] == ["web-0"]
+        # the dualwrite event joins the request's update event by trace
+        # id (the id rides the journaled workflow input, so recovery
+        # replays keep the correlation too)
+        assert dual[0]["trace_id"] == update[0]["trace_id"] != ""
+
+    def test_dualwrite_rollback_audited(self):
+        """A kube write that always fails rolls the SpiceDB write back;
+        the dualwrite event reports the rollback outcome."""
+        proxy, kube = make_proxy(level="Request")
+        proxy.enable_dual_writes()
+
+        async def exploding(req):
+            raise RuntimeError("kube down")
+        proxy.workflow_client._activities["write_to_kube"] = exploding
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            resp = await alice.post(
+                "/api/v1/namespaces/team-a/pods",
+                {"kind": "Pod", "apiVersion": "v1",
+                 "metadata": {"name": "web-err", "namespace": "team-a"}})
+            assert resp.status >= 400
+        run(go())
+        dual = events(proxy, stage="dualwrite")
+        assert dual
+        assert dual[0]["decision"] in (OUTCOME_DENIED, "error")
+        assert "rollback" in dual[0].get("message", "")
+
+    def test_outcome_normalized_in_log_kv(self, caplog):
+        import logging
+
+        proxy, _ = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+        with caplog.at_level(logging.INFO,
+                             logger="spicedb_kubeapi_proxy_tpu.proxy"):
+            run(alice.get("/api"))
+        line = next(r.message for r in caplog.records
+                    if " /api " in r.message)
+        assert "authz='always_allow'" in line
+
+
+class TestWatchFiltering:
+    def test_filtered_watch_events_counted(self):
+        from spicedb_kubeapi_proxy_tpu.authz.watch import (
+            WATCH_FILTERED_TOTAL)
+
+        proxy, kube = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+        base_pods = WATCH_FILTERED_TOTAL.value(resource="pods")
+        base_type = WATCH_FILTERED_TOTAL.value(resource="pod")
+
+        async def go():
+            resp = await alice.get("/api/v1/pods?watch=true")
+            assert resp.status == 200
+            frames: asyncio.Queue = asyncio.Queue()
+
+            async def consume():
+                async for frame in resp.stream:
+                    await frames.put(json.loads(frame))
+
+            task = asyncio.ensure_future(consume())
+            try:
+                # a pod alice cannot see: the frame is withheld silently
+                # — but no longer uncounted
+                kube.seed("", "v1", "pods", {
+                    "metadata": {"name": "hidden", "namespace": "team-b"}})
+                await kube._notify(
+                    ("", "v1", "pods"), "ADDED",
+                    kube.objects[("", "v1", "pods")]["team-b"]["hidden"])
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(frames.get(), 0.5)
+                # a write granting bob (not alice) triggers a denied
+                # check on the spicedb side of the watch bridge
+                await proxy.endpoint.write_relationships([
+                    RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                        "pod:team-b/hidden#viewer@user:bob"))])
+                await asyncio.sleep(0.5)
+            finally:
+                task.cancel()
+        run(go())
+        assert WATCH_FILTERED_TOTAL.value(resource="pods") > base_pods
+        assert WATCH_FILTERED_TOTAL.value(resource="pod") > base_type
+
+    def test_watch_grant_and_revoke_audited(self):
+        proxy, kube = make_proxy()
+        alice = proxy.get_embedded_client(user="alice")
+
+        async def go():
+            resp = await alice.get("/api/v1/pods?watch=true")
+            assert resp.status == 200
+            frames: asyncio.Queue = asyncio.Queue()
+
+            async def consume():
+                async for frame in resp.stream:
+                    await frames.put(json.loads(frame))
+
+            task = asyncio.ensure_future(consume())
+            try:
+                kube.seed("", "v1", "pods", {
+                    "metadata": {"name": "pnew", "namespace": "team-b"}})
+                await kube._notify(
+                    ("", "v1", "pods"), "ADDED",
+                    kube.objects[("", "v1", "pods")]["team-b"]["pnew"])
+                await asyncio.sleep(0.3)
+                # late grant flushes the buffered frame -> allowed event
+                await proxy.endpoint.write_relationships([
+                    RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                        "pod:team-b/pnew#viewer@user:alice"))])
+                ev = await asyncio.wait_for(frames.get(), 5)
+                assert ev["object"]["metadata"]["name"] == "pnew"
+                # revocation -> denied event
+                await proxy.endpoint.delete_relationships(
+                    RelationshipFilter(resource_type="pod",
+                                       resource_id="team-b/pnew"))
+                await asyncio.sleep(0.5)
+            finally:
+                task.cancel()
+        run(go())
+        watch_evs = events(proxy, stage="watch")
+        decisions = {e["decision"] for e in watch_evs}
+        assert OUTCOME_ALLOWED in decisions
+        assert OUTCOME_DENIED in decisions
+
+
+class TestEagerWorkflowTaskRetention:
+    def test_eager_instance_survives_gc(self):
+        """Regression: the eager (no-worker) workflow path used to
+        fire-and-forget its task; the event loop holds tasks weakly, so
+        a cyclic gc pass mid-flight collected it and the waiter hung for
+        the full 30s timeout ('Task was destroyed but it is pending').
+        The engine must hold a strong reference until completion."""
+        import gc
+
+        from spicedb_kubeapi_proxy_tpu.authz.distributedtx.engine import (
+            WorkflowEngine)
+        from spicedb_kubeapi_proxy_tpu.authz.distributedtx.journal import (
+            MemoryJournal)
+
+        engine = WorkflowEngine(MemoryJournal())
+
+        async def wf(ctx, input):
+            for _ in range(5):
+                await asyncio.sleep(0)
+                gc.collect()
+            return {"status_code": 200, "body": "{}"}
+
+        engine.register_workflow("gc-probe", wf)
+
+        async def go():
+            engine.create_instance("i1", "gc-probe", {"user_name": "u"})
+            assert engine._eager_tasks  # strong ref held
+            gc.collect()
+            result = await engine.get_result("i1", timeout=5)
+            assert result["status_code"] == 200
+            assert not engine._eager_tasks  # released on completion
+        run(go())
+
+
+class TestRuntimeMetrics:
+    def test_rss_and_gc_metrics_registered(self):
+        from spicedb_kubeapi_proxy_tpu.utils import metrics as m
+
+        m.install_runtime_metrics()
+        m.install_runtime_metrics()  # idempotent
+        rendered = m.REGISTRY.render()
+        assert "process_resident_memory_bytes" in rendered
+        assert "proxy_gc_collections_total" in rendered
+        assert "proxy_gc_pause_seconds" in rendered
+        # RSS reads something real on linux
+        assert m._read_rss_bytes() > 0
+
+    def test_gc_pause_observed(self):
+        import gc
+
+        from spicedb_kubeapi_proxy_tpu.utils import metrics as m
+
+        m.install_runtime_metrics()
+        before = m.REGISTRY.counter(
+            "proxy_gc_collections_total",
+            labels=("generation",)).value(generation="2")
+        gc.collect()
+        after = m.REGISTRY.counter(
+            "proxy_gc_collections_total",
+            labels=("generation",)).value(generation="2")
+        assert after > before
+
+    def test_event_loop_lag_probe(self):
+        from spicedb_kubeapi_proxy_tpu.utils import metrics as m
+
+        probe = m.EventLoopLagProbe(interval=0.02)
+
+        async def go():
+            await probe.start()
+            await asyncio.sleep(0.2)
+            await probe.stop()
+        run(go())
+        assert probe.lag.count() >= 3
+
+
+class TestCardinalityLint:
+    def test_identity_label_rejected(self, tmp_path):
+        import subprocess
+        import sys
+
+        pkg = tmp_path / "spicedb_kubeapi_proxy_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(
+            "from .utils.metrics import REGISTRY\n"
+            'C = REGISTRY.counter("x_total", "t", labels=("user",))\n')
+        lint = Path(__file__).resolve().parent.parent / "scripts/lint.py"
+        out = subprocess.run(
+            [sys.executable, str(lint), "spicedb_kubeapi_proxy_tpu"],
+            cwd=tmp_path, capture_output=True, text=True)
+        assert out.returncode == 1
+        assert "M001" in out.stdout
+
+    def test_bounded_labels_accepted(self, tmp_path):
+        import subprocess
+        import sys
+
+        pkg = tmp_path / "spicedb_kubeapi_proxy_tpu"
+        pkg.mkdir()
+        (pkg / "ok.py").write_text(
+            "from .utils.metrics import REGISTRY\n"
+            'C = REGISTRY.counter("x_total", "t", labels=("verb", "code"))\n')
+        lint = Path(__file__).resolve().parent.parent / "scripts/lint.py"
+        out = subprocess.run(
+            [sys.executable, str(lint), "spicedb_kubeapi_proxy_tpu"],
+            cwd=tmp_path, capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout
