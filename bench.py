@@ -609,6 +609,153 @@ def v5e8_projection(ep, workload, batch: int, roofline: dict) -> dict:
         fixed_overhead_s=fixed)
 
 
+def _cache_chain(workload, cache_on: bool):
+    """Production proxy-chain wiring for the cache benches:
+    jax:// -> BatchingEndpoint -> (DecisionCacheEndpoint when on)."""
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.decision_cache import (
+        DecisionCacheEndpoint)
+    from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+
+    schema = sch.parse_schema(workload.schema_text)
+    inner = JaxEndpoint(schema)
+    inner.store.bulk_load_text("\n".join(workload.relationships))
+    ep = BatchingEndpoint(inner)
+    if cache_on:
+        ep = DecisionCacheEndpoint(ep)
+    return ep, inner
+
+
+def _cache_workload():
+    from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+    return wl.pods_depth1(n_pods=10_000, n_users=100, n_tuples=30_000)
+
+
+def bench_warm_repeat_list(args) -> dict:
+    """Decision-cache headline: the SAME user lists 10k pods N times
+    (no interleaved writes), cache on vs off — the repeat-list is the
+    production hot path the cache exists for.  Reports proxy-chain
+    filter throughput both ways plus the on/off speedup (acceptance:
+    >=5x) and the cache hit rate."""
+    import asyncio
+
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+    workload = _cache_workload()
+    lists = 32
+    subject = SubjectRef("user", workload.subjects[0])
+    out = {}
+    for cache_on in (False, True):
+        label = "on" if cache_on else "off"
+        stage(f"warm-repeat-list cache={label}")
+        ep, inner = _cache_chain(workload, cache_on)
+
+        async def run():
+            # warmup: compile + first frontier (and the one cache fill)
+            first = await ep.lookup_resources(
+                workload.resource_type, workload.permission, subject)
+            t0 = time.time()
+            for _ in range(lists):
+                got = await ep.lookup_resources(
+                    workload.resource_type, workload.permission, subject)
+            elapsed = time.time() - t0
+            assert sorted(got) == sorted(first)
+            return len(first), elapsed
+
+        n_allowed, elapsed = asyncio.run(run())
+        n_obj = workload.expected_objects
+        out[f"cache_{label}_lists_per_s"] = round(lists / elapsed, 2)
+        out[f"cache_{label}_checks_per_s"] = round(
+            lists * n_obj / elapsed, 1)
+        if cache_on:
+            st = ep.cache.stats
+            probes = st["hits"] + st["misses"]
+            out["hit_rate"] = round(st["hits"] / max(probes, 1), 4)
+        log(f"warm-repeat-list cache={label}: "
+            f"{lists / elapsed:.1f} lists/s ({n_allowed} allowed ids)")
+    out["speedup"] = round(out["cache_on_lists_per_s"]
+                           / max(out["cache_off_lists_per_s"], 1e-9), 2)
+    out["objects"] = workload.expected_objects
+    log(f"warm-repeat-list speedup (on/off): {out['speedup']}x "
+        f"(acceptance >=5x), hit rate {out.get('hit_rate')}")
+    return out
+
+
+def bench_delta_churn(args) -> dict:
+    """Decision-cache correctness under interleaved writes: every round
+    commits a write (touch/delete of viewer tuples), then the cache-on
+    chain's lookups are refereed against the host oracle over the SAME
+    store.  Divergences must be zero; the hit rate shows the
+    relation-scoped invalidation keeping unrelated entries warm."""
+    import asyncio
+
+    from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+        RelationshipUpdate, SubjectRef, UpdateOp, parse_relationship)
+
+    workload = _cache_workload()
+    stage("delta-churn build")
+    ep, inner = _cache_chain(workload, cache_on=True)
+    oracle = Evaluator(inner.schema, inner.store)
+    rounds = 12
+    subjects = [SubjectRef("user", workload.subjects[i % len(workload.subjects)])
+                for i in range(3)]
+    divergences = 0
+
+    async def run():
+        nonlocal divergences
+        stage("delta-churn warmup")
+        for s in subjects:
+            await ep.lookup_resources(workload.resource_type,
+                                      workload.permission, s)
+        stage("delta-churn rounds (interleaved writes)")
+        chain_s = 0.0
+        n_lists = 0
+        for r in range(rounds):
+            op = UpdateOp.TOUCH if r % 2 == 0 else UpdateOp.DELETE
+            rel = parse_relationship(
+                f"pod:p{r % 7}#viewer@user:{workload.subjects[0]}")
+            await ep.write_relationships([RelationshipUpdate(op=op, rel=rel)])
+            for s in subjects:
+                # the revision is frozen between writes: one oracle
+                # frontier referees BOTH passes (pass 2 serves
+                # unchanged-footprint entries from cache)
+                want = sorted(oracle.lookup_resources(
+                    workload.resource_type, workload.permission, s))
+                for _pass in range(2):
+                    t0 = time.time()
+                    got = sorted(await ep.lookup_resources(
+                        workload.resource_type, workload.permission, s))
+                    chain_s += time.time() - t0
+                    n_lists += 1
+                    if got != want:
+                        divergences += 1
+        return n_lists, chain_s
+
+    n_lists, elapsed = asyncio.run(run())
+    st = ep.cache.stats
+    probes = st["hits"] + st["misses"]
+    out = {
+        "divergences": divergences,
+        "rounds": rounds,
+        "lists_per_s": round(n_lists / elapsed, 2),
+        "hit_rate": round(st["hits"] / max(probes, 1), 4),
+        "invalidations": st["invalidations"],
+    }
+    log(f"delta-churn: {divergences} divergences over {n_lists} refereed "
+        f"lists, hit rate {out['hit_rate']}, "
+        f"{st['invalidations']} invalidations")
+    return out
+
+
+# decision-cache bench configs (ISSUE 3): run standalone via --config or
+# appended to the --all sweep artifact
+CACHE_CONFIGS = {
+    "warm-repeat-list": bench_warm_repeat_list,
+    "delta-churn": bench_delta_churn,
+}
+
 CONFIGS = {
     "namespace-baseline": ("namespace_baseline", {}),
     "pods-depth1": ("pods_depth1", {}),
@@ -626,7 +773,8 @@ CONFIGS = {
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", default="multitenant-1m", choices=CONFIGS)
+    ap.add_argument("--config", default="multitenant-1m",
+                    choices=list(CONFIGS) + list(CACHE_CONFIGS))
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--oracle-queries", type=int, default=2)
@@ -686,6 +834,20 @@ def main() -> None:
     log(f"devices: {devs}")
 
     warmup_tiny()
+
+    if args.config in CACHE_CONFIGS:
+        # standalone decision-cache config: its own headline metric
+        stage(f"cache config {args.config}")
+        res = CACHE_CONFIGS[args.config](args)
+        value = (res.get("cache_on_checks_per_s")
+                 or res.get("lists_per_s", 0.0))
+        _STATE["metric"] = f"decision-cache {args.config}"
+        emit({"metric": _STATE["metric"], "value": value,
+              "unit": ("checks/s" if "cache_on_checks_per_s" in res
+                       else "lists/s"),
+              "platform": _STATE["platform"],
+              "baseline": "cache-off proxy chain", **res})
+        return
 
     from spicedb_kubeapi_proxy_tpu.models import workloads as wl
 
@@ -842,6 +1004,15 @@ def main() -> None:
                 run_one(name, with_oracle=False,
                         rounds=max(3, args.rounds // 2))
             except Exception as e:  # keep the headline alive
+                log(f"config {name} failed: {e!r}")
+                _STATE["partial"].setdefault("configs", {})[name] = {
+                    "error": repr(e)}
+        # decision-cache configs ride the sweep artifact too (hit rate,
+        # on/off speedup, and the churn referee's divergence count)
+        for name, fn in CACHE_CONFIGS.items():
+            try:
+                _STATE["partial"].setdefault("configs", {})[name] = fn(args)
+            except Exception as e:
                 log(f"config {name} failed: {e!r}")
                 _STATE["partial"].setdefault("configs", {})[name] = {
                     "error": repr(e)}

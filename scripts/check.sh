@@ -30,6 +30,13 @@ else
   python -m pytest tests/ -q
 fi
 
+echo "== decision-cache coherence smoke (deterministic, CPU, small sizes)"
+# relation-scoped invalidation bugs fail HERE, in seconds, without the
+# slow bench: random delta streams with the host oracle as referee plus
+# the footprint unit tests (tests/test_decision_cache.py)
+JAX_PLATFORMS=cpu python -m pytest tests/test_decision_cache.py -q \
+    -p no:cacheprovider -k "coherence or Footprint or Invalidation"
+
 echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"
 JAX_PLATFORMS=cpu python __graft_entry__.py 8
 

@@ -29,6 +29,9 @@ class PrefilterResult:
     all_allowed: bool = False
     allowed: set = field(default_factory=set)  # {(namespace, name)}
     error: Optional[Exception] = None
+    # evaluator that produced the frontier (cache|kernel|oracle; "" when
+    # the endpoint chain doesn't attribute) — audit decision_source
+    source: str = ""
 
     def is_allowed(self, namespace: str, name: str) -> bool:
         if self.all_allowed:
@@ -80,10 +83,23 @@ async def run_lookup_resources(endpoint: PermissionsEndpoint,
     if filter.rel.resource_id != MATCHING_ID_FIELD_VALUE:
         raise PreFilterError("preFilter called with non-$ resource ID")
     result = PrefilterResult()
+    subject = SubjectRef(filter.rel.subject_type, filter.rel.subject_id,
+                         filter.rel.subject_relation)
+    if getattr(endpoint, "decision_cache_enabled", False):
+        # decision-cached chain: a warm hit materializes the full frontier
+        # without touching the dispatcher or the device, and carries the
+        # decision source (cache|kernel|oracle) for the audit event.  The
+        # id stream's transfer-overlap is moot here — hits are host lists
+        # and misses are materialized before the cache fill anyway.
+        ids = await endpoint.lookup_resources(
+            filter.rel.resource_type, filter.rel.resource_relation, subject)
+        result.source = getattr(ids, "source", "")
+        for rid in ids:
+            result.allowed.add(extract_namespaced_name(filter, input, rid))
+        return result
     async for rid in endpoint.lookup_resources_stream(
             filter.rel.resource_type,
             filter.rel.resource_relation,
-            SubjectRef(filter.rel.subject_type, filter.rel.subject_id,
-                       filter.rel.subject_relation)):
+            subject):
         result.allowed.add(extract_namespaced_name(filter, input, rid))
     return result

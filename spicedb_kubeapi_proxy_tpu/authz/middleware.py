@@ -34,6 +34,7 @@ from ..utils.tracing import span
 from ..spicedb.endpoints import PermissionsEndpoint
 from .check import (
     UnauthorizedError,
+    decision_source_of,
     run_all_matching_checks,
     run_all_matching_post_checks,
 )
@@ -98,6 +99,7 @@ def audit_event_for(req: Request, stage: str, decision: str,
     rules = req.context.get("matched_rules")
     if rules:
         ev.rule = ",".join(rules)
+    ev.decision_source = req.context.get("decision_source", "")
     tr = tracing.current_trace()
     trace_id = getattr(tr, "trace_id", "")
     if trace_id:
@@ -203,8 +205,14 @@ def with_authorization(handler: Handler, failed: Handler,
             # informational wrapper: the dispatch layer records the
             # queue_wait/execute phase spans for the bulk check itself
             with span("check"):
-                await run_all_matching_checks(endpoint, filtered_rules, input)
+                check_results = await run_all_matching_checks(
+                    endpoint, filtered_rules, input)
+            # which evaluator decided (cache|kernel|oracle|mixed): stashed
+            # so every later event built for this request carries it
+            req.context["decision_source"] = decision_source_of(
+                check_results)
         except UnauthorizedError as e:
+            req.context["decision_source"] = e.source
             _emit(req, "check", OUTCOME_DENIED,
                   rule=e.rule or ",".join(r.name for r in filtered_rules),
                   rel=e.rel.rel_string() if e.rel is not None else "",
@@ -282,9 +290,13 @@ def with_authorization(handler: Handler, failed: Handler,
             if 200 <= resp.status < 300:
                 try:
                     with span("postcheck"):
-                        await run_all_matching_post_checks(
+                        post_results = await run_all_matching_post_checks(
                             endpoint, filtered_rules, input)
+                    src = decision_source_of(post_results)
+                    if src:
+                        req.context["decision_source"] = src
                 except UnauthorizedError as e:
+                    req.context["decision_source"] = e.source
                     _emit(req, "postcheck", OUTCOME_DENIED,
                           rule=e.rule,
                           rel=(e.rel.rel_string() if e.rel is not None

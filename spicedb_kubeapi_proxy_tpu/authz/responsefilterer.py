@@ -203,13 +203,18 @@ class StandardResponseFilterer(ResponseFilterer):
             # no prefilter rule (all_allowed pass-through): keep the
             # request's matched rules from the context
             rule = ",".join(req.context.get("matched_rules") or ())
+        # the frontier's evaluator (cache|kernel|oracle) outranks the
+        # check-phase source for filtered-list events: the GROUP decision
+        # is the prefilter's
+        source = getattr(rec.inner, "source", "")
         if rec.allowed_count:
             sink.emit(audit_event_for(
                 req, "respfilter", OUTCOME_ALLOWED, rule=rule,
                 names=tuple(f"{ns}/{n}" if ns else n
                             for ns, n in
                             rec.allowed_names[:MAX_NAMES_PER_EVENT]),
-                count=rec.allowed_count))
+                count=rec.allowed_count,
+                **({"decision_source": source} if source else {})))
         if not rec.denied_count:
             return
         explain = None
@@ -232,7 +237,8 @@ class StandardResponseFilterer(ResponseFilterer):
                         for ns, n in
                         rec.denied_names[:MAX_NAMES_PER_EVENT]),
             count=rec.denied_count,
-            explain=explain))
+            explain=explain,
+            **({"decision_source": source} if source else {})))
 
     def _explain_oid(self, rel, namespace: str, name: str) -> str:
         """Best-effort inverse of the rule's fromObjectID expressions:

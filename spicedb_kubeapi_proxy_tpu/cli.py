@@ -67,6 +67,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spicedb-bootstrap", default="",
                    help="YAML file with bootstrap schema/relationships for "
                         "embedded:// and jax:// endpoints")
+    p.add_argument("--decision-cache", action="store_true",
+                   help="revision-keyed decision cache with relation-scoped "
+                        "invalidation in front of the endpoint: repeated "
+                        "identical checks/LookupResources are served from "
+                        "cache until a write touches a relation in their "
+                        "compiled footprint (embedded:// and jax:// only; "
+                        "see docs/performance.md)")
+    p.add_argument("--decision-cache-bytes", type=int, default=0,
+                   help="decision-cache LRU bound in bytes "
+                        "(0 = default 128MiB)")
 
     # upstream cluster (options.go:203-206)
     p.add_argument("--backend-kubeconfig", default="",
@@ -188,6 +198,12 @@ def validate(args: argparse.Namespace) -> list:
         errs.append(f"--secure-port {args.secure_port} is not a valid port")
     if args.trace_slow_threshold < 0:
         errs.append("--trace-slow-threshold must be >= 0")
+    if (args.decision_cache
+            and not args.spicedb_endpoint.startswith(("embedded", "jax"))):
+        errs.append("--decision-cache requires a store-backed endpoint "
+                    "(embedded:// or jax://)")
+    if args.decision_cache_bytes < 0:
+        errs.append("--decision-cache-bytes must be >= 0")
     from .utils.audit import parse_level
     try:
         parse_level(args.audit_level)
@@ -310,6 +326,13 @@ def complete(args: argparse.Namespace,
         authenticators.append(ClientCertAuthenticator())
 
     endpoint_kwargs = {}
+    if args.decision_cache:
+        endpoint_kwargs["decision_cache"] = True
+    if args.decision_cache_bytes:
+        # independent of --decision-cache: the cache may also come up via
+        # `?cache=1` or the DecisionCache gate, and a bound the operator
+        # set must apply then too
+        endpoint_kwargs["decision_cache_bytes"] = args.decision_cache_bytes
     if not args.spicedb_endpoint.startswith(("embedded", "jax")):
         # every non-local endpoint dials gRPC — including the reference's
         # scheme-less `host:port` default shape (options.go:107) — and

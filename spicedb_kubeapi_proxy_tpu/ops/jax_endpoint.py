@@ -52,6 +52,7 @@ from ..spicedb.endpoints import (
 from ..spicedb.evaluator import Evaluator
 from ..spicedb.store import TupleStore, Watcher
 from ..spicedb.types import (
+    AnnotatedIds,
     CheckRequest,
     CheckResult,
     ObjectRef,
@@ -147,6 +148,20 @@ def _object_ids_np(graph, resource_type: str) -> tuple:
     # copy-on-write instead of patching it in place (see _rename_row)
     graph._ids_np_published.add(resource_type)
     return entry
+
+
+def _evict_id_views(graph) -> None:
+    """Drop an outgoing graph generation's cached numpy id views: a
+    stale (arr, mask) pair must never outlive its graph, and clearing
+    releases the O(universe) object arrays immediately.  In-flight
+    lookups that already captured a pair under the lock keep their own
+    references — clear() empties the dict, never the arrays."""
+    if graph is None:
+        return
+    cache = getattr(graph, "_ids_np_cache", None)
+    if cache is not None:
+        cache.clear()
+        graph._ids_np_published.clear()
 
 
 def _word_col_indices(wcol: np.ndarray, bit: int) -> np.ndarray:
@@ -876,6 +891,7 @@ class JaxEndpoint(PermissionsEndpoint):
         # run off-loop now, so writes race the rebuild).
         self._drain_pending()
         self._graph_invalid = False
+        _evict_id_views(self._graph)
         # phantom-subject columns (one reserved column per type so
         # first-contact subjects still hit the kernel) + the spare object
         # pool for rebuild-free object creation.  Pool size amortizes the
@@ -1094,7 +1110,9 @@ class JaxEndpoint(PermissionsEndpoint):
         """Drain store deltas into the device graph (under self._lock)."""
         if self._graph_invalid:
             self._graph_invalid = False
+            dead = self._graph
             self._graph = None
+            _evict_id_views(dead)
         graph = self._graph
         if graph is None:
             self._rebuild()
@@ -1321,7 +1339,11 @@ class JaxEndpoint(PermissionsEndpoint):
                         # surface schema errors like the oracle does
                         oracle_rows.append(i)
                     else:
-                        results[i] = (0, rev)  # unknown object: no tuples
+                        # unknown object: not in the compiled universe, so
+                        # it has no tuples and the kernel would gather all
+                        # zeros — the short-circuit is the kernel path's
+                        # answer (source stays "kernel" below)
+                        results[i] = (0, rev)
                     continue
                 gather_idx.append(state_idx)
                 gather_col.append(cols[r.subject])
@@ -1349,9 +1371,11 @@ class JaxEndpoint(PermissionsEndpoint):
                     results[i] = (self._oracle.check3(r.resource, r.permission,
                                                       r.subject),
                                   self.store.revision)
+        oracle_set = set(oracle_rows)
         return [CheckResult(permissionship=self._TRISTATE[v],
-                            checked_at=at)
-                for (v, at) in results]
+                            checked_at=at,
+                            source="oracle" if i in oracle_set else "kernel")
+                for i, (v, at) in enumerate(results)]
 
     def _report_suppressed(self, n: int, sample: list, context,
                            retry: bool = False) -> None:
@@ -1413,8 +1437,10 @@ class JaxEndpoint(PermissionsEndpoint):
                 with self._lock:
                     self.stats["suppression_oracle_fallbacks"] = (
                         self.stats.get("suppression_oracle_fallbacks", 0) + 1)
-                out = self._oracle.lookup_resources(resource_type, permission,
-                                                    subject)
+                out = AnnotatedIds(
+                    self._oracle.lookup_resources(resource_type, permission,
+                                                  subject),
+                    source="oracle")
         return out
 
     def _purge_ids_view(self, resource_type: str) -> None:
@@ -1469,8 +1495,10 @@ class JaxEndpoint(PermissionsEndpoint):
         if oracle:
             # host evaluation outside the lock (reads the live store)
             with tracing.span("kernel.oracle", kind="lookup"):
-                return self._oracle.lookup_resources(resource_type, permission,
-                                                     subject), 0
+                return AnnotatedIds(
+                    self._oracle.lookup_resources(resource_type, permission,
+                                                  subject),
+                    source="oracle"), 0
         # kernel + extraction outside the lock (immutable snapshot)
         with tracing.kernel_span("kernel.device", kind="lookup"):
             if hasattr(graph, "run_lookup_packed"):
@@ -1484,7 +1512,7 @@ class JaxEndpoint(PermissionsEndpoint):
         out, bad_n, bad_sample = _ids_for(ids, idx, ph, mask)
         if bad_n:
             self._report_suppressed(bad_n, bad_sample, _forensic, retry=retry)
-        return out, bad_n
+        return AnnotatedIds(out, source="kernel"), bad_n
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
@@ -1582,7 +1610,10 @@ class JaxEndpoint(PermissionsEndpoint):
         if ctx.get("all_oracle"):
             # host evaluation outside the lock (reads the live store)
             with tracing.span("kernel.oracle", kind="lookup_batch"):
-                return [self._oracle.lookup_resources(ctx["rt"], ctx["perm"], s)
+                return [AnnotatedIds(
+                            self._oracle.lookup_resources(
+                                ctx["rt"], ctx["perm"], s),
+                            source="oracle")
                         for s in ctx["subjects"]], 0
         if "packed_T" in ctx:
             # the device->host sync point: this blocks until the async
@@ -1607,8 +1638,8 @@ class JaxEndpoint(PermissionsEndpoint):
                           batch=len(ctx["subjects"])):
             for s in ctx["subjects"]:
                 if s in unknown:
-                    out.append(self._oracle.lookup_resources(
-                        ctx["rt"], ctx["perm"], s))
+                    out.append(AnnotatedIds(self._oracle.lookup_resources(
+                        ctx["rt"], ctx["perm"], s), source="oracle"))
                     continue
                 col = cols[s]
                 lst = per_col_ids.get(col)
@@ -1619,7 +1650,8 @@ class JaxEndpoint(PermissionsEndpoint):
                         total_bad += bad_n
                         self._report_suppressed(bad_n, bad_sample,
                                                 ctx["forensic"], retry=retry)
-                    per_col_ids[col] = lst
+                    per_col_ids[col] = lst = AnnotatedIds(lst,
+                                                          source="kernel")
                 out.append(lst)
         return out, total_bad
 
@@ -1635,7 +1667,10 @@ class JaxEndpoint(PermissionsEndpoint):
                 with self._lock:
                     self.stats["suppression_oracle_fallbacks"] = (
                         self.stats.get("suppression_oracle_fallbacks", 0) + 1)
-                out = [self._oracle.lookup_resources(ctx["rt"], ctx["perm"], s)
+                out = [AnnotatedIds(
+                           self._oracle.lookup_resources(
+                               ctx["rt"], ctx["perm"], s),
+                           source="oracle")
                        for s in ctx["subjects"]]
         return out
 

@@ -76,6 +76,29 @@ def _mark_exec_end(waiters: list) -> None:
             w[2]["exec_end"] = t1
 
 
+def _follow(leader: asyncio.Future, loop) -> asyncio.Future:
+    """A caller-facing future mirroring an internal singleflight leader.
+    The leader is never handed to callers, so one caller's cancellation
+    can never poison the co-flighted others; results are shared (the
+    fused extract path already hands the SAME id list to every waiter of
+    a column, so sharing is the established contract)."""
+    fut = loop.create_future()
+
+    def _copy(lf: asyncio.Future) -> None:
+        if fut.done():
+            return
+        if lf.cancelled():
+            fut.set_exception(
+                RuntimeError("singleflight leader cancelled"))
+        elif lf.exception() is not None:
+            fut.set_exception(lf.exception())
+        else:
+            fut.set_result(lf.result())
+
+    leader.add_done_callback(_copy)
+    return fut
+
+
 @contextlib.contextmanager
 def _activate_batch_trace(waiters: list):
     """Activate the co-batched callers' traces (fanned out) for the
@@ -112,19 +135,31 @@ class BatchingEndpoint(PermissionsEndpoint):
         # waiters are (item, Future, trace-ctx-or-None) triples
         self._check_queue: list = []   # [(CheckRequest, Future, tc)]
         self._lr_queue: dict = {}      # (type, perm) -> [(SubjectRef, Future, tc)]
+        # in-flight singleflight index: (type, perm, subject) -> the
+        # QUEUED leader future.  Entries are removed at drain pickup, so
+        # arrivals during execution start a fresh query (a write may have
+        # committed since the executing batch drained deltas, and a later
+        # arrival must observe it — full consistency).
+        self._lr_pending: dict = {}
         self._inflight: list = []      # waiters of the batch being executed
         self._drain_task: Optional[asyncio.Task] = None
         # explain_bypass pre-seeded so InstrumentedEndpoint's one-shot
         # gauge registration sees the key
         self._stats = {"drains": 0, "fused_checks": 0, "fused_lookups": 0,
-                       "max_fused_batch": 0, "explain_bypass": 0}
+                       "max_fused_batch": 0, "explain_bypass": 0,
+                       "singleflight_hits": 0}
 
     @property
     def stats(self) -> dict:
-        """Own dispatch counters merged over the inner backend's stats."""
+        """Own dispatch counters merged over the inner backend's stats,
+        plus live queue-depth / current-fused-batch gauges (sampled at
+        scrape time through InstrumentedEndpoint's stats callbacks)."""
         inner_stats = getattr(self.inner, "stats", None)
         out = dict(inner_stats) if isinstance(inner_stats, dict) else {}
         out.update(self._stats)
+        out["check_queue_depth"] = len(self._check_queue)
+        out["lr_queue_depth"] = sum(len(v) for v in self._lr_queue.values())
+        out["inflight_batch"] = len(self._inflight)
         return out
 
     # -- queue plumbing ------------------------------------------------------
@@ -161,6 +196,7 @@ class BatchingEndpoint(PermissionsEndpoint):
                     waiters = waiters[: self.max_batch]
                     if rest:
                         self._lr_queue.setdefault(key, []).extend(rest)
+                    self._unregister_pending(key, waiters)
                     if two_phase:
                         self._inflight = waiters
                         started = await self._start_lookups(key, waiters)
@@ -197,10 +233,39 @@ class BatchingEndpoint(PermissionsEndpoint):
             for ws in self._lr_queue.values():
                 stranded.extend(ws)
             self._lr_queue.clear()
+            self._lr_pending.clear()
             for w in stranded:
                 if not w[1].done():
                     w[1].set_exception(failure)
             raise
+
+    def _unregister_pending(self, key: tuple, waiters: list) -> None:
+        """Close the singleflight window for a batch being picked up:
+        identical queries arriving from now on must start fresh (the
+        batch's delta drain happens at pickup, not at their arrival)."""
+        resource_type, permission = key
+        for w in waiters:
+            k = (resource_type, permission, w[0])
+            if self._lr_pending.get(k) is w[1]:
+                del self._lr_pending[k]
+
+    def _enqueue_lookup(self, resource_type: str, permission: str,
+                        subject: SubjectRef, tc) -> asyncio.Future:
+        """Queue one lookup, singleflight-deduped: an identical query
+        already QUEUED shares its waiter (one kernel column, one cache
+        fill upstream) through an internal leader future; the returned
+        future is always caller-private (see _follow)."""
+        loop = asyncio.get_running_loop()
+        k = (resource_type, permission, subject)
+        leader = self._lr_pending.get(k)
+        if leader is None:
+            leader = loop.create_future()
+            self._lr_pending[k] = leader
+            self._lr_queue.setdefault((resource_type, permission), []).append(
+                (subject, leader, tc))
+        else:
+            self._stats["singleflight_hits"] += 1
+        return _follow(leader, loop)
 
     async def _retry_individually(self, waiters: list, single_call) -> None:
         """Per-member fallback after a fused call failed (concurrently —
@@ -338,9 +403,7 @@ class BatchingEndpoint(PermissionsEndpoint):
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
         tc = _trace_ctx()
-        fut = asyncio.get_running_loop().create_future()
-        self._lr_queue.setdefault((resource_type, permission), []).append(
-            (subject, fut, tc))
+        fut = self._enqueue_lookup(resource_type, permission, subject, tc)
         self._kick()
         try:
             return await fut
@@ -351,14 +414,9 @@ class BatchingEndpoint(PermissionsEndpoint):
                                      subjects: list) -> list:
         if not subjects:
             return []
-        loop = asyncio.get_running_loop()
         tc = _trace_ctx()  # one shared ctx: the batch is one caller
-        futs = []
-        bucket = self._lr_queue.setdefault((resource_type, permission), [])
-        for s in subjects:
-            fut = loop.create_future()
-            bucket.append((s, fut, tc))
-            futs.append(fut)
+        futs = [self._enqueue_lookup(resource_type, permission, s, tc)
+                for s in subjects]
         self._kick()
         try:
             return list(await asyncio.gather(*futs))

@@ -25,6 +25,7 @@ from . import schema as sch
 from .evaluator import Evaluator
 from .store import TupleStore, Watcher
 from .types import (
+    AnnotatedIds,
     CheckRequest,
     CheckResult,
     Permissionship,
@@ -255,6 +256,7 @@ class EmbeddedEndpoint(PermissionsEndpoint):
         return CheckResult(
             permissionship=self._TRISTATE[value],
             checked_at=self.store.revision,
+            source="oracle",
         )
 
     async def check_permission(self, req: CheckRequest) -> CheckResult:
@@ -265,7 +267,10 @@ class EmbeddedEndpoint(PermissionsEndpoint):
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
-        return self.evaluator.lookup_resources(resource_type, permission, subject)
+        return AnnotatedIds(
+            self.evaluator.lookup_resources(resource_type, permission,
+                                            subject),
+            source="oracle")
 
     async def read_relationships(self, flt: RelationshipFilter) -> list:
         return self.store.read(flt)
@@ -287,6 +292,47 @@ class EndpointConfigError(ValueError):
     pass
 
 
+def _resolve_cache_config(url: str, params: dict, kwargs: dict):
+    """Decision-cache wiring decision for create_endpoint: the explicit
+    kwarg (CLI --decision-cache) or the `?cache=1` URL param or the
+    DecisionCache feature gate turns it on; returns
+    (enabled, explicit, max_bytes) after POPPING the cache kwargs so
+    backend constructors never see them.  `explicit` distinguishes a
+    user-requested cache (refusing it is an error) from a gate-derived
+    default (silently inapplicable for store-less backends)."""
+    want = kwargs.pop("decision_cache", None)
+    max_bytes = kwargs.pop("decision_cache_bytes", None)
+    explicit = want is not None
+    raw = (params.get("cache") or [""])[0].lower()
+    if want is None:
+        if raw in ("1", "true", "yes"):
+            want, explicit = True, True
+        elif raw in ("0", "false", "no"):
+            want, explicit = False, True
+        elif raw == "":
+            from ..utils.features import GATES
+            want = GATES.enabled("DecisionCache")
+        else:
+            raise EndpointConfigError(
+                f"invalid cache={raw!r} in {url!r} "
+                f"(expected 1/true/yes/0/false/no)")
+    raw_bytes = (params.get("cache_bytes") or [""])[0]
+    if raw_bytes:
+        try:
+            max_bytes = int(raw_bytes)
+        except ValueError as e:
+            raise EndpointConfigError(
+                f"invalid cache_bytes in {url!r}: {e}") from e
+    return bool(want), explicit, max_bytes
+
+
+def _wrap_decision_cache(ep: PermissionsEndpoint,
+                         max_bytes: Optional[int]) -> PermissionsEndpoint:
+    from .decision_cache import DEFAULT_MAX_BYTES, DecisionCacheEndpoint
+    return DecisionCacheEndpoint(
+        ep, max_bytes=max_bytes if max_bytes else DEFAULT_MAX_BYTES)
+
+
 def create_endpoint(url: str,
                     bootstrap: Optional[Bootstrap] = None,
                     **kwargs: Any) -> PermissionsEndpoint:
@@ -302,8 +348,17 @@ def create_endpoint(url: str,
     split = urlsplit(url)
     scheme = split.scheme
     params = parse_qs(split.query)
+    cache_on, cache_explicit, cache_bytes = _resolve_cache_config(
+        url, params, kwargs)
+    if scheme not in ("embedded", "jax") and cache_on:
+        if cache_explicit:
+            raise EndpointConfigError(
+                f"--decision-cache requires a store-backed endpoint "
+                f"(embedded:// or jax://), not {url!r}")
+        cache_on = False  # gate-derived default: inapplicable, not fatal
     if scheme == "embedded":
-        return EmbeddedEndpoint.from_bootstrap(bootstrap)
+        ep = EmbeddedEndpoint.from_bootstrap(bootstrap)
+        return _wrap_decision_cache(ep, cache_bytes) if cache_on else ep
     if scheme == "jax":
         from ..ops.jax_endpoint import JaxEndpoint  # lazy: pulls in jax
         # multi-host: `jax://?distributed=1` joins the jax.distributed
@@ -368,6 +423,11 @@ def create_endpoint(url: str,
         elif dispatch != "direct":
             raise EndpointConfigError(
                 f"unknown dispatch mode {dispatch!r}; use batched|direct")
+        if cache_on:
+            # the cache sits ABOVE the dispatcher: a warm hit returns
+            # before any queue/kernel work; misses flow through the fused
+            # (singleflight-deduped) dispatch path and fill on return
+            ep = _wrap_decision_cache(ep, cache_bytes)
         return ep
     if scheme in ("grpc", "grpcs", "http", "https"):
         # remote permissions service over gRPC (reference options.go:331-368:

@@ -218,10 +218,27 @@ class CheckRequest:
 class CheckResult:
     permissionship: Permissionship
     checked_at: int = 0  # store revision
+    # which evaluator produced this verdict (kernel | oracle | cache);
+    # "" for backends that don't attribute — feeds audit decision_source
+    source: str = ""
 
     @property
     def allowed(self) -> bool:
         return self.permissionship == Permissionship.HAS_PERMISSION
+
+
+class AnnotatedIds(list):
+    """Allowed-id list annotated with the decision source that produced
+    it (kernel | oracle | cache).  A plain list to every consumer — the
+    annotation only feeds audit decision_source attribution, so layers
+    that lose it (e.g. the id stream) degrade to an empty source, never
+    to a wrong result."""
+
+    __slots__ = ("source",)
+
+    def __init__(self, ids=(), source: str = ""):
+        super().__init__(ids)
+        self.source = source
 
 
 @dataclass(frozen=True)

@@ -110,6 +110,11 @@ class AuditEvent:
     count: int = 0                # group size when > len(names) sampled
     rule: str = ""                # matched ProxyRule name
     backend: str = ""             # jax | embedded | grpc
+    # which evaluator produced the decision: cache (decision cache hit) |
+    # kernel (device) | oracle (host evaluator) | mixed; "" when the
+    # backend doesn't attribute.  Keeps audit truthful when the decision
+    # cache answers without touching the evaluator at all.
+    decision_source: str = ""
     trace_id: str = ""
     latency_ms: float = 0.0
     # Request-level payload (dropped at Metadata)
@@ -129,6 +134,8 @@ class AuditEvent:
              "count": self.count or len(self.names), "rule": self.rule,
              "backend": self.backend, "trace_id": self.trace_id,
              "latency_ms": round(self.latency_ms, 3)}
+        if self.decision_source:
+            d["decision_source"] = self.decision_source
         if self.explain is not None:
             # witnesses are explicitly requested (--audit-explain or
             # ?explain=1): render them at any level that emits at all

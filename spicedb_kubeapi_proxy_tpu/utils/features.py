@@ -84,3 +84,7 @@ GATES.register("LoggingBetaOptions", stage=BETA, default=True)
 # build-specific gates
 GATES.register("StructuredRequestLog", stage=BETA, default=True)
 GATES.register("CrossRequestBatching", stage=GA, default=True)
+# revision-keyed decision cache with relation-scoped invalidation
+# (spicedb/decision_cache.py); also switchable per endpoint via
+# `?cache=1` or the --decision-cache CLI flag
+GATES.register("DecisionCache", stage=ALPHA, default=False)

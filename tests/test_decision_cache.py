@@ -1,0 +1,482 @@
+"""Revision-keyed decision cache (spicedb/decision_cache.py): relation
+footprints, relation-scoped invalidation (a write touching relation R
+invalidates ONLY entries whose compiled footprint includes R), LRU/bytes
+bounds, expiry-driven invalidation, decision_source annotation, explain
+bypass, endpoint wiring, and the cache-on vs cache-off coherence property
+(the oracle is the referee) under random delta streams."""
+
+import asyncio
+import random
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.ops.graph_compile import relation_footprint
+from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.decision_cache import (
+    DecisionCache,
+    DecisionCacheEndpoint,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+    EmbeddedEndpoint,
+    EndpointConfigError,
+    create_endpoint,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = """
+definition user {}
+definition group {
+  relation member: user
+}
+definition namespace {
+  relation creator: user
+  relation viewer: user | group#member
+  permission view = viewer + creator
+}
+definition pod {
+  relation namespace: namespace
+  relation creator: user
+  relation viewer: user
+  permission edit = creator
+  permission view = viewer + creator + namespace->view
+}
+"""
+
+
+def _schema():
+    return sch.parse_schema(SCHEMA)
+
+
+def touch(rel_str):
+    return RelationshipUpdate(op=UpdateOp.TOUCH,
+                              rel=parse_relationship(rel_str))
+
+
+def delete(rel_str):
+    return RelationshipUpdate(op=UpdateOp.DELETE,
+                              rel=parse_relationship(rel_str))
+
+
+def make_cached(kind="embedded", **kw):
+    schema = _schema()
+    inner = (JaxEndpoint(schema) if kind == "jax"
+             else EmbeddedEndpoint(schema))
+    return DecisionCacheEndpoint(inner, **kw), inner
+
+
+# -- relation footprint ------------------------------------------------------
+
+class TestRelationFootprint:
+    def test_direct_relation(self):
+        fp = relation_footprint(_schema(), "pod", "creator")
+        assert fp == frozenset({("pod", "creator")})
+
+    def test_permission_union(self):
+        fp = relation_footprint(_schema(), "pod", "edit")
+        assert fp == frozenset({("pod", "creator")})
+
+    def test_arrow_and_userset_closure(self):
+        fp = relation_footprint(_schema(), "pod", "view")
+        # view = viewer + creator + namespace->view: the arrow pulls in
+        # the namespace relations, and namespace.viewer's group#member
+        # annotation pulls in the group membership relation
+        assert fp == frozenset({
+            ("pod", "viewer"), ("pod", "creator"), ("pod", "namespace"),
+            ("namespace", "viewer"), ("namespace", "creator"),
+            ("group", "member"),
+        })
+
+    def test_disjoint_permissions_have_disjoint_footprints(self):
+        edit = relation_footprint(_schema(), "pod", "edit")
+        ns_view = relation_footprint(_schema(), "namespace", "view")
+        assert not (edit & ns_view)
+
+    def test_unknown_names_are_empty(self):
+        assert relation_footprint(_schema(), "nosuch", "view") == frozenset()
+        assert relation_footprint(_schema(), "pod", "nosuch") == frozenset()
+
+
+# -- relation-scoped invalidation (the acceptance criterion) -----------------
+
+class TestRelationScopedInvalidation:
+    def test_write_invalidates_only_footprint_entries(self):
+        """A write touching relation R invalidates only cached entries
+        whose compiled footprint includes R — asserted on the entries
+        themselves, not just the metric."""
+        ep, _ = make_cached()
+
+        async def run():
+            await ep.write_relationships([
+                touch("pod:p1#viewer@user:alice"),
+                touch("pod:p1#creator@user:bob"),
+                touch("namespace:ns1#viewer@user:alice"),
+            ])
+            alice = SubjectRef("user", "alice")
+            # fill: pod/view (footprint includes namespace.viewer via the
+            # arrow) and pod/edit (footprint = pod.creator only)
+            await ep.lookup_resources("pod", "view", alice)
+            await ep.lookup_resources("pod", "edit", alice)
+            view_key = ("lr", "pod", "view", alice)
+            edit_key = ("lr", "pod", "edit", alice)
+            assert ep.cache.contains_valid(view_key)
+            assert ep.cache.contains_valid(edit_key)
+            # write touching namespace.viewer: in view's footprint, NOT
+            # in edit's
+            await ep.write_relationships(
+                [touch("namespace:ns1#viewer@user:carol")])
+            assert not ep.cache.contains_valid(view_key)
+            assert ep.cache.contains_valid(edit_key)
+            # the surviving entry is served as a hit; the invalidated one
+            # re-fills
+            hits0 = ep.cache.stats["hits"]
+            inv0 = ep.cache.stats["invalidations"]
+            await ep.lookup_resources("pod", "edit", alice)
+            assert ep.cache.stats["hits"] == hits0 + 1
+            out = await ep.lookup_resources("pod", "view", alice)
+            assert sorted(out) == ["p1"]
+            assert ep.cache.stats["invalidations"] == inv0 + 1
+
+        asyncio.run(run())
+
+    def test_check_entries_are_relation_scoped_too(self):
+        ep, _ = make_cached()
+
+        async def run():
+            await ep.write_relationships([
+                touch("pod:p1#creator@user:bob"),
+                touch("pod:p1#viewer@user:alice"),
+            ])
+            bob = SubjectRef("user", "bob")
+            req = CheckRequest(resource=ObjectRef("pod", "p1"),
+                               permission="edit", subject=bob)
+            r1 = await ep.check_permission(req)
+            assert r1.allowed and r1.source in ("oracle", "kernel")
+            r2 = await ep.check_permission(req)
+            assert r2.allowed and r2.source == "cache"
+            # pod.viewer is not in edit's footprint: entry survives
+            await ep.write_relationships(
+                [touch("pod:p1#viewer@user:carol")])
+            r3 = await ep.check_permission(req)
+            assert r3.source == "cache"
+            # pod.creator IS: entry invalidates and the answer flips
+            await ep.write_relationships(
+                [delete("pod:p1#creator@user:bob")])
+            r4 = await ep.check_permission(req)
+            assert r4.source != "cache"
+            assert not r4.allowed
+
+        asyncio.run(run())
+
+    def test_bulk_load_invalidates_wholesale(self):
+        ep, inner = make_cached()
+
+        async def run():
+            await ep.write_relationships([touch("pod:p1#viewer@user:alice")])
+            alice = SubjectRef("user", "alice")
+            assert await ep.lookup_resources("pod", "view", alice) == ["p1"]
+            key = ("lr", "pod", "view", alice)
+            assert ep.cache.contains_valid(key)
+            inner.store.bulk_load(
+                [parse_relationship("pod:p2#viewer@user:alice")])
+            assert not ep.cache.contains_valid(key)
+            out = await ep.lookup_resources("pod", "view", alice)
+            assert sorted(out) == ["p1", "p2"]
+
+        asyncio.run(run())
+
+
+# -- bounds / expiry ---------------------------------------------------------
+
+class TestCacheBounds:
+    def test_lru_eviction_by_entry_count(self):
+        c = DecisionCache(max_bytes=1 << 30, max_entries=2)
+        tok = c.snapshot_epochs(frozenset(), 0.0)
+        c.put(("a",), [1], tok, 10)
+        c.put(("b",), [2], tok, 10)
+        assert c.get(("a",), 0.0) == [1]  # refresh a
+        c.put(("c",), [3], tok, 10)       # evicts b (LRU)
+        assert c.stats["evictions"] == 1
+        assert c.get(("b",), 0.0) is not c.get(("a",), 0.0)
+        assert not c.contains_valid(("b",))
+        assert c.contains_valid(("a",)) and c.contains_valid(("c",))
+
+    def test_bytes_bound_and_accounting(self):
+        c = DecisionCache(max_bytes=100, max_entries=1000)
+        tok = c.snapshot_epochs(frozenset(), 0.0)
+        c.put(("a",), [1], tok, 60)
+        c.put(("b",), [2], tok, 60)  # 120 > 100: evicts a
+        assert c.stats["evictions"] == 1
+        assert c.resident_bytes == 60
+        c.put(("b",), [3], tok, 40)  # replace adjusts accounting
+        assert c.resident_bytes == 40
+
+    def test_expiring_tuple_invalidates_at_expiry(self):
+        clock = [1000.0]
+        from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+        store = TupleStore(clock=lambda: clock[0])
+        expiring_schema = sch.parse_schema("""
+use expiration
+definition user {}
+definition pod {
+  relation viewer: user with expiration
+  permission view = viewer
+}
+""")
+        inner = EmbeddedEndpoint(expiring_schema, store=store)
+        ep = DecisionCacheEndpoint(inner)
+
+        async def run():
+            await ep.write_relationships([
+                RelationshipUpdate(op=UpdateOp.TOUCH, rel=parse_relationship(
+                    f"pod:p1#viewer@user:alice[expiration:{clock[0] + 50}]")),
+            ])
+            alice = SubjectRef("user", "alice")
+            assert await ep.lookup_resources("pod", "view", alice) == ["p1"]
+            key = ("lr", "pod", "view", alice)
+            assert ep.cache.contains_valid(key)
+            hits0 = ep.cache.stats["hits"]
+            assert await ep.lookup_resources("pod", "view", alice) == ["p1"]
+            assert ep.cache.stats["hits"] == hits0 + 1
+            clock[0] += 60  # past the expiration
+            out = await ep.lookup_resources("pod", "view", alice)
+            assert out == [] and getattr(out, "source", "") != "cache"
+
+        asyncio.run(run())
+
+
+# -- wiring / flags ----------------------------------------------------------
+
+class TestWiring:
+    def test_url_param_wires_cache_for_jax_and_embedded(self):
+        ep = create_endpoint("jax://?cache=1")
+        assert isinstance(ep, DecisionCacheEndpoint)
+        ep2 = create_endpoint("embedded://?cache=1")
+        assert isinstance(ep2, DecisionCacheEndpoint)
+        ep3 = create_endpoint("jax://")
+        assert not isinstance(ep3, DecisionCacheEndpoint)
+        with pytest.raises(EndpointConfigError):
+            create_endpoint("jax://?cache=bogus")
+
+    def test_kwarg_and_bytes_override(self):
+        ep = create_endpoint("embedded://", decision_cache=True,
+                             decision_cache_bytes=4096)
+        assert isinstance(ep, DecisionCacheEndpoint)
+        assert ep.cache.max_bytes == 4096
+        ep2 = create_endpoint("jax://?cache=1&cache_bytes=8192")
+        assert ep2.cache.max_bytes == 8192
+
+    def test_cache_refused_for_remote_endpoints(self):
+        with pytest.raises(EndpointConfigError, match="store-backed"):
+            create_endpoint("grpc://localhost:50051", decision_cache=True)
+
+    def test_cli_flag_round_trip(self):
+        from spicedb_kubeapi_proxy_tpu.cli import build_parser, validate
+        args = build_parser().parse_args([
+            "--backend-kubeconfig", "x", "--rule-config", "y",
+            "--spicedb-endpoint", "jax://", "--decision-cache"])
+        assert args.decision_cache and not validate(args)
+        bad = build_parser().parse_args([
+            "--backend-kubeconfig", "x", "--rule-config", "y",
+            "--spicedb-endpoint", "grpc://h:1", "--decision-cache"])
+        assert any("store-backed" in e for e in validate(bad))
+
+    def test_explain_bypasses_cache(self):
+        ep, _ = make_cached(kind="jax")
+
+        async def run():
+            await ep.write_relationships([touch("pod:p1#viewer@user:alice")])
+            alice = SubjectRef("user", "alice")
+            req = CheckRequest(resource=ObjectRef("pod", "p1"),
+                               permission="view", subject=alice)
+            await ep.check_permission(req)
+            await ep.check_permission(req)  # cached now
+            fills0 = ep.cache.stats["fills"]
+            hits0 = ep.cache.stats["hits"]
+            w = ep.explain_check(ObjectRef("pod", "p1"), "view", alice)
+            assert w.decision == "allowed"
+            # the witness re-derived the decision: no cache traffic at all
+            assert ep.cache.stats["fills"] == fills0
+            assert ep.cache.stats["hits"] == hits0
+
+        asyncio.run(run())
+
+    def test_prefilter_result_carries_cache_source(self):
+        # lookups.run_lookup_resources uses the annotated path when the
+        # chain exposes decision_cache_enabled
+        ep, _ = make_cached()
+        assert getattr(ep, "decision_cache_enabled", False)
+
+        async def run():
+            await ep.write_relationships([touch("pod:p1#viewer@user:alice")])
+            alice = SubjectRef("user", "alice")
+            first = await ep.lookup_resources("pod", "view", alice)
+            assert getattr(first, "source", "") in ("oracle", "kernel")
+            second = await ep.lookup_resources("pod", "view", alice)
+            assert getattr(second, "source", "") == "cache"
+
+        asyncio.run(run())
+
+
+# -- cache-on vs cache-off coherence (the referee property) ------------------
+
+SUBJECTS = [SubjectRef("user", u) for u in ("alice", "bob", "carol")]
+QUERIES = [("pod", "view"), ("pod", "edit"), ("namespace", "view")]
+
+from spicedb_kubeapi_proxy_tpu.spicedb.types import Permissionship  # noqa: E402
+
+_TRI_OF = {Permissionship.NO_PERMISSION: 0,
+           Permissionship.CONDITIONAL_PERMISSION: 1,
+           Permissionship.HAS_PERMISSION: 2}
+
+
+def _random_update(rng):
+    pod = f"p{rng.randrange(4)}"
+    ns = f"ns{rng.randrange(2)}"
+    user = rng.choice(("alice", "bob", "carol"))
+    group = f"g{rng.randrange(2)}"
+    candidates = (
+        f"pod:{pod}#viewer@user:{user}",
+        f"pod:{pod}#creator@user:{user}",
+        f"pod:{pod}#namespace@namespace:{ns}",
+        f"namespace:{ns}#viewer@user:{user}",
+        f"namespace:{ns}#viewer@group:{group}#member",
+        f"namespace:{ns}#creator@user:{user}",
+        f"group:{group}#member@user:{user}",
+    )
+    op = UpdateOp.TOUCH if rng.random() < 0.7 else UpdateOp.DELETE
+    return RelationshipUpdate(op=op,
+                              rel=parse_relationship(rng.choice(candidates)))
+
+
+@pytest.mark.parametrize("kind", ["embedded", "jax"])
+def test_cache_coherence_under_random_delta_stream(kind):
+    """Property: for a random delta stream, the cache-on endpoint returns
+    results identical to the cache-off oracle at EVERY revision.  Each
+    query runs twice per revision so the second round exercises genuine
+    cache hits, and the oracle (host evaluator over the same store) is
+    the referee."""
+    rng = random.Random(1234)
+    schema = _schema()
+    inner = (JaxEndpoint(schema) if kind == "jax"
+             else EmbeddedEndpoint(schema))
+    ep = DecisionCacheEndpoint(inner)
+    oracle = Evaluator(schema, inner.store)
+
+    async def run():
+        for step in range(30):
+            await ep.write_relationships([_random_update(rng)])
+            for _round in range(2):  # second round serves from cache
+                for (rt, perm) in QUERIES:
+                    for s in SUBJECTS:
+                        got = sorted(await ep.lookup_resources(rt, perm, s))
+                        want = sorted(oracle.lookup_resources(rt, perm, s))
+                        assert got == want, (
+                            f"step {step}: lookup({rt},{perm},{s}) "
+                            f"cache-on={got} oracle={want}")
+                        req = CheckRequest(
+                            resource=ObjectRef(rt, f"{'p' if rt == 'pod' else 'ns'}0"),
+                            permission=perm, subject=s)
+                        res = await ep.check_permission(req)
+                        want3 = oracle.check3(req.resource, perm, s)
+                        got3 = _TRI_OF[res.permissionship]
+                        assert got3 == want3, (
+                            f"step {step}: check({req}) cache-on={got3} "
+                            f"oracle={want3}")
+        # the property must have actually exercised the cache
+        assert ep.cache.stats["hits"] > 0
+        assert ep.cache.stats["invalidations"] > 0
+
+    asyncio.run(run())
+
+
+# -- audit decision_source threading -----------------------------------------
+
+def test_audit_event_carries_decision_source():
+    from spicedb_kubeapi_proxy_tpu.authz.middleware import audit_event_for
+    from spicedb_kubeapi_proxy_tpu.proxy.httpcore import Request
+    from spicedb_kubeapi_proxy_tpu.utils.audit import LEVEL_METADATA
+
+    req = Request(method="GET", target="/api/v1/pods")
+    req.context["decision_source"] = "cache"
+    ev = audit_event_for(req, "check", "allowed")
+    assert ev.decision_source == "cache"
+    assert ev.to_dict(LEVEL_METADATA)["decision_source"] == "cache"
+    # absent source stays out of the rendered event
+    req2 = Request(method="GET", target="/api/v1/pods")
+    ev2 = audit_event_for(req2, "check", "allowed")
+    assert "decision_source" not in ev2.to_dict(LEVEL_METADATA)
+
+
+def test_decision_source_of_collapses_mixed_results():
+    from spicedb_kubeapi_proxy_tpu.authz.check import decision_source_of
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+        CheckResult, Permissionship)
+
+    def res(src):
+        return CheckResult(permissionship=Permissionship.HAS_PERMISSION,
+                           source=src)
+
+    assert decision_source_of([]) == ""
+    assert decision_source_of([res("cache"), res("cache")]) == "cache"
+    assert decision_source_of([res("cache"), res("kernel")]) == "mixed"
+    assert decision_source_of([res(""), res("oracle")]) == "oracle"
+
+
+# -- review-fix regressions ---------------------------------------------------
+
+def test_gate_derived_cache_is_inapplicable_not_fatal_for_remote():
+    """With the DecisionCache feature gate on (no explicit request), a
+    remote endpoint must come up cache-less instead of hard-failing on a
+    flag the user never passed; the explicit forms still error."""
+    from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+    GATES.set("DecisionCache", True)
+    try:
+        try:
+            ep = create_endpoint("grpc://127.0.0.1:1")
+            assert not isinstance(ep, DecisionCacheEndpoint)
+        except EndpointConfigError as e:
+            # grpcio may be absent in this image: the only acceptable
+            # error is the missing-dependency one, never "store-backed"
+            assert "store-backed" not in str(e)
+        # gate-on embedded DOES wire the cache
+        assert isinstance(create_endpoint("embedded://"),
+                          DecisionCacheEndpoint)
+    finally:
+        GATES.set("DecisionCache", False)
+
+
+def test_cache_bytes_flag_applies_without_decision_cache_flag():
+    from spicedb_kubeapi_proxy_tpu.cli import build_parser
+    args = build_parser().parse_args([
+        "--backend-kubeconfig", "x", "--rule-config", "y",
+        "--spicedb-endpoint", "jax://?cache=1",
+        "--decision-cache-bytes", "4096"])
+    # complete() forwards the bound whenever set; emulate its kwargs
+    # assembly (the full complete() needs a kubeconfig on disk)
+    kwargs = {}
+    if args.decision_cache:
+        kwargs["decision_cache"] = True
+    if args.decision_cache_bytes:
+        kwargs["decision_cache_bytes"] = args.decision_cache_bytes
+    ep = create_endpoint(args.spicedb_endpoint, **kwargs)
+    assert isinstance(ep, DecisionCacheEndpoint)
+    assert ep.cache.max_bytes == 4096
+
+
+def test_close_unregisters_store_listeners():
+    ep, inner = make_cached()
+    store = inner.store
+    assert ep._on_delta in store._delta_listeners
+    assert ep._on_reset in store._reset_listeners
+    asyncio.run(ep.close())
+    assert ep._on_delta not in store._delta_listeners
+    assert ep._on_reset not in store._reset_listeners

@@ -107,7 +107,10 @@ def test_concurrent_lookups_fuse_by_type_permission():
     assert res[0] == ["d0", "d2", "d4"]
     assert res[1] == ["d1", "d3", "d5"]
     assert res[2] == ["d0", "d2", "d4"]
-    assert inner.lr_batch_calls == [1, 3]
+    # the queued bob/alice/bob dedupe to two unique subjects
+    # (singleflight): one fused call with 2 members after the leader
+    assert inner.lr_batch_calls == [1, 2]
+    assert ep.stats["singleflight_hits"] == 1
 
 
 def test_batch_failure_isolated_per_request():
@@ -342,3 +345,137 @@ def test_dying_drain_fails_pending_two_phase_waiters():
             await asyncio.wait_for(b, 2)
 
     asyncio.run(run())
+
+
+# -- singleflight dedup + queue gauges (decision-cache PR satellites) --------
+
+def test_singleflight_dedupes_identical_queued_lookups():
+    """Concurrent IDENTICAL lookups queued behind an in-flight batch
+    collapse into one waiter: the fused inner call sees ONE subject and
+    every caller receives the shared result."""
+    ep, inner = make(n_docs=4, users=("alice",))
+    inner.slow = True
+
+    async def run():
+        first = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        await asyncio.sleep(0.002)  # drain now busy with the first call
+        rest = [asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+            for _ in range(5)]
+        return [sorted(await first)] + [sorted(await t) for t in rest]
+
+    res = asyncio.run(run())
+    assert all(r == ["d0", "d1", "d2", "d3"] for r in res)
+    # call 1: the lone leader; call 2: the 5 identical queued callers
+    # deduped into ONE fused member
+    assert inner.lr_batch_calls == [1, 1]
+    assert ep.stats["singleflight_hits"] == 4
+
+
+def test_singleflight_caller_cancellation_does_not_poison_others():
+    ep, inner = make(n_docs=2, users=("alice",))
+    inner.slow = True
+
+    async def run():
+        first = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        await asyncio.sleep(0.002)
+        a = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        b = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        await asyncio.sleep(0)
+        a.cancel()
+        out = sorted(await b)
+        with pytest.raises(asyncio.CancelledError):
+            await a
+        await first
+        return out
+
+    assert asyncio.run(run()) == ["d0", "d1"]
+
+
+def test_singleflight_window_closes_at_drain_pickup():
+    """An identical query arriving AFTER its twin was picked up by the
+    drain must start a fresh query (the in-flight batch drained deltas
+    before this arrival: joining it could miss a newer write)."""
+    ep, inner = make(n_docs=2, users=("alice",))
+    inner.slow = True
+
+    async def run():
+        first = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        # wait until the first call is IN FLIGHT (picked up, executing)
+        await asyncio.sleep(0.005)
+        second = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        await asyncio.gather(first, second)
+
+    asyncio.run(run())
+    assert inner.lr_batch_calls == [1, 1]
+    assert ep.stats["singleflight_hits"] == 0
+
+
+def test_stats_export_queue_depth_and_inflight_batch_gauges():
+    ep, inner = make()
+    s = ep.stats
+    assert s["check_queue_depth"] == 0
+    assert s["lr_queue_depth"] == 0
+    assert s["inflight_batch"] == 0
+    assert "singleflight_hits" in s
+
+    async def run():
+        inner.slow = True
+        first = asyncio.create_task(ep.check_permission(check("alice", "d0")))
+        await asyncio.sleep(0.002)  # first batch in flight
+        queued = [asyncio.create_task(ep.check_permission(check("bob", "d1")))
+                  for _ in range(3)]
+        lr = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        await asyncio.sleep(0)
+        depth = ep.stats
+        assert depth["inflight_batch"] == 1    # the first check executing
+        assert depth["check_queue_depth"] == 3
+        assert depth["lr_queue_depth"] == 1
+        await asyncio.gather(first, lr, *queued)
+        done = ep.stats
+        assert done["check_queue_depth"] == 0
+        assert done["lr_queue_depth"] == 0
+        assert done["inflight_batch"] == 0
+
+    asyncio.run(run())
+
+
+def test_two_phase_finish_failure_isolates_poison_member():
+    """Per-member retry under the two-phase (jax://-shaped) drain: when
+    the fused finish fails, each member retries individually — the good
+    member succeeds and only the poison member observes its own error."""
+    class PartialRetryTwoPhase(TwoPhaseInner):
+        async def lookup_resources_batch_finish(self, ctx):
+            self.finish_calls += 1
+            raise RuntimeError("injected fused finish failure")
+
+        async def lookup_resources(self, resource_type, permission, subject):
+            if subject.id == "poison":
+                raise RuntimeError("poison member")
+            return await super().lookup_resources(
+                resource_type, permission, subject)
+
+    schema = sch.parse_schema(SCHEMA)
+    inner = PartialRetryTwoPhase(schema)
+    inner.store.bulk_load(
+        [parse_relationship(f"doc:d{i}#viewer@user:alice") for i in range(3)])
+    ep = BatchingEndpoint(inner)
+
+    async def run():
+        good = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "alice")))
+        bad = asyncio.create_task(
+            ep.lookup_resources("doc", "view", SubjectRef("user", "poison")))
+        return await asyncio.gather(good, bad, return_exceptions=True)
+
+    good, bad = asyncio.run(run())
+    assert sorted(good) == ["d0", "d1", "d2"]
+    assert isinstance(bad, RuntimeError) and "poison" in str(bad)
+    assert inner.finish_calls >= 1  # the fused phase 2 actually ran+failed

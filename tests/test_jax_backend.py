@@ -950,3 +950,56 @@ class TestStageAuxFlip:
         flipped = [wants for ranges, _, wants in graph.kernel.stages
                    if any(lo <= row < hi for lo, hi in ranges)]
         assert flipped and all(flipped), "stage flag must now want aux"
+
+
+class TestRebuildIdViewEviction:
+    """Graph rebuilds must evict the outgoing graph's cached numpy id
+    views (`_ids_np_cache`): a post-rebuild lookup must never see
+    pre-rebuild ids through a stale (arr, mask) pair."""
+
+    def test_post_rebuild_lookup_never_sees_pre_rebuild_ids(self):
+        jx, oracle = make_pair(GROUPS_SCHEMA, [
+            "namespace:old1#viewer@user:alice",
+            "namespace:old2#viewer@user:alice",
+        ])
+        alice = SubjectRef("user", "alice")
+
+        async def run():
+            out = await jx.lookup_resources("namespace", "view", alice)
+            assert sorted(out) == ["old1", "old2"]
+            old_graph = jx._graph
+            # the lookup populated the old graph's cached id view
+            assert getattr(old_graph, "_ids_np_cache", None)
+            # a reset-class change (bulk_load) with a DISJOINT id universe
+            # forces a full rebuild
+            jx.store.delete_all()
+            jx.store.bulk_load([parse_relationship(
+                "namespace:new1#viewer@user:alice")])
+            out = await jx.lookup_resources("namespace", "view", alice)
+            assert sorted(out) == ["new1"], (
+                "post-rebuild lookup leaked pre-rebuild ids")
+            # the outgoing graph's id view was evicted, not carried
+            assert not old_graph._ids_np_cache
+            assert jx._graph is not old_graph
+
+        asyncio.run(run())
+        assert_agreement(jx, oracle, "namespace", "view", [alice])
+
+    def test_forced_rebuild_evicts_and_refreshes_id_view(self):
+        jx, oracle = make_pair(GROUPS_SCHEMA, [
+            "namespace:ns1#viewer@user:alice",
+        ])
+        alice = SubjectRef("user", "alice")
+
+        async def run():
+            assert sorted(await jx.lookup_resources(
+                "namespace", "view", alice)) == ["ns1"]
+            old_graph = jx._graph
+            assert old_graph._ids_np_cache
+            jx.force_rebuild()
+            assert not old_graph._ids_np_cache
+            assert not old_graph._ids_np_published
+            assert sorted(await jx.lookup_resources(
+                "namespace", "view", alice)) == ["ns1"]
+
+        asyncio.run(run())
