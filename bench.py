@@ -749,11 +749,126 @@ def bench_delta_churn(args) -> dict:
     return out
 
 
+def bench_recovery(args) -> dict:
+    """Durable-store restart cost (ISSUE 4): time-to-serve after a
+    restart at 1M tuples — checkpoint load + WAL tail replay + warm
+    graph rebuild, measured separately and summed — plus the WAL-on vs
+    WAL-off write-path overhead (the price every live write pays for
+    durability)."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.persist import PersistenceManager
+    from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+        CheckRequest,
+        RelationshipUpdate,
+        SubjectRef,
+        UpdateOp,
+        parse_relationship,
+    )
+
+    workload = wl.multitenant_1m()
+    rel_text = "\n".join(workload.relationships)
+    write_rounds, batch = 50, 50
+    tail_rounds = 25
+
+    def churn_batch(i):
+        # touch/delete EXISTING workload tuples so every write is
+        # schema-valid and the device graph replays them cleanly
+        ups = []
+        for j in range(batch):
+            line = workload.relationships[(i * batch + j)
+                                          % len(workload.relationships)]
+            op = UpdateOp.DELETE if (i + j) % 2 else UpdateOp.TOUCH
+            ups.append(RelationshipUpdate(op, parse_relationship(line)))
+        return ups
+
+    def time_writes(store, rounds, start=0):
+        t0 = time.time()
+        for i in range(start, start + rounds):
+            store.write(churn_batch(i))
+        return time.time() - t0
+
+    tmp = tempfile.mkdtemp(prefix="persist-bench-")
+    out = {"tuples": len(workload.relationships)}
+    try:
+        stage("recovery: seed + journal (WAL on)")
+        mgr = PersistenceManager(tmp, fsync="interval")
+        store = mgr.recover()
+        mgr.attach(store)
+        store.bulk_load_text(rel_text)
+        wal_on_s = time_writes(store, write_rounds)
+        stage("recovery: checkpoint + WAL tail")
+        mgr.checkpoint()
+        time_writes(store, tail_rounds, start=write_rounds)
+        seed_revision = store.revision
+        mgr.close()
+
+        stage("recovery: WAL-off write baseline")
+        bare = TupleStore()
+        bare.bulk_load_text(rel_text)
+        wal_off_s = time_writes(bare, write_rounds)
+        del bare
+
+        stage("recovery: restart (checkpoint + tail replay)")
+        mgr2 = PersistenceManager(tmp, fsync="interval")
+        recovered = mgr2.recover()
+        assert recovered.revision == seed_revision
+        info = mgr2.recovery_info
+
+        stage("recovery: warm graph rebuild")
+        schema = sch.parse_schema(workload.schema_text)
+        ep = JaxEndpoint(schema, store=recovered)
+        probe = next(parse_relationship(line)
+                     for line in workload.relationships
+                     if line.startswith(workload.resource_type + ":"))
+        t0 = time.time()
+        ep.warm_start()
+        # first kernel answer = "serving": includes jit compile
+        asyncio.run(ep.check_permission(CheckRequest(
+            probe.resource, workload.permission,
+            SubjectRef("user", workload.subjects[0]))))
+        rebuild_s = time.time() - t0
+
+        out.update({
+            "checkpoint_load_s": info["checkpoint_load_s"],
+            "wal_replay_s": info["wal_replay_s"],
+            "wal_tail_records": info["replayed_records"],
+            "graph_rebuild_s": round(rebuild_s, 3),
+            "time_to_serve_s": round(
+                info["total_s"] + rebuild_s, 3),
+            "wal_on_batch_ms": round(wal_on_s / write_rounds * 1e3, 3),
+            "wal_off_batch_ms": round(wal_off_s / write_rounds * 1e3, 3),
+            "wal_overhead_pct": round(
+                (wal_on_s - wal_off_s) / max(wal_off_s, 1e-9) * 100, 1),
+        })
+        log(f"recovery: time-to-serve {out['time_to_serve_s']}s at "
+            f"{out['tuples']} tuples (ckpt {out['checkpoint_load_s']}s + "
+            f"replay {out['wal_replay_s']}s [{out['wal_tail_records']} "
+            f"records] + rebuild {out['graph_rebuild_s']}s); WAL write "
+            f"overhead {out['wal_overhead_pct']}% "
+            f"({out['wal_on_batch_ms']} vs {out['wal_off_batch_ms']} "
+            f"ms/batch)")
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # decision-cache bench configs (ISSUE 3): run standalone via --config or
 # appended to the --all sweep artifact
 CACHE_CONFIGS = {
     "warm-repeat-list": bench_warm_repeat_list,
     "delta-churn": bench_delta_churn,
+}
+
+# durable-store bench configs (ISSUE 4): same contract as CACHE_CONFIGS
+PERSIST_CONFIGS = {
+    "recovery": bench_recovery,
 }
 
 CONFIGS = {
@@ -774,7 +889,8 @@ CONFIGS = {
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="multitenant-1m",
-                    choices=list(CONFIGS) + list(CACHE_CONFIGS))
+                    choices=(list(CONFIGS) + list(CACHE_CONFIGS)
+                             + list(PERSIST_CONFIGS)))
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--oracle-queries", type=int, default=2)
@@ -847,6 +963,19 @@ def main() -> None:
                        else "lists/s"),
               "platform": _STATE["platform"],
               "baseline": "cache-off proxy chain", **res})
+        return
+
+    if args.config in PERSIST_CONFIGS:
+        # standalone durable-store config: time-to-serve after restart
+        stage(f"persist config {args.config}")
+        res = PERSIST_CONFIGS[args.config](args)
+        _STATE["metric"] = f"durable-store {args.config}"
+        emit({"metric": _STATE["metric"],
+              "value": res.get("time_to_serve_s", 0.0), "unit": "s",
+              "platform": _STATE["platform"],
+              "baseline": "in-memory proxy (full bootstrap re-ingest "
+                          "on every restart, post-bootstrap writes lost)",
+              **res})
         return
 
     from spicedb_kubeapi_proxy_tpu.models import workloads as wl
@@ -1007,9 +1136,10 @@ def main() -> None:
                 log(f"config {name} failed: {e!r}")
                 _STATE["partial"].setdefault("configs", {})[name] = {
                     "error": repr(e)}
-        # decision-cache configs ride the sweep artifact too (hit rate,
-        # on/off speedup, and the churn referee's divergence count)
-        for name, fn in CACHE_CONFIGS.items():
+        # decision-cache + durable-store configs ride the sweep artifact
+        # too (hit rate, on/off speedup, churn divergences, and the
+        # restart time-to-serve + WAL write-overhead columns)
+        for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS}.items():
             try:
                 _STATE["partial"].setdefault("configs", {})[name] = fn(args)
             except Exception as e:

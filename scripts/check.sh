@@ -37,6 +37,12 @@ echo "== decision-cache coherence smoke (deterministic, CPU, small sizes)"
 JAX_PLATFORMS=cpu python -m pytest tests/test_decision_cache.py -q \
     -p no:cacheprovider -k "coherence or Footprint or Invalidation"
 
+echo "== crash-recovery smoke (kill -9 mid write-churn, restart, parity)"
+# the durable store must never lose an acked write: fsync=always child,
+# SIGKILL mid-churn, recover on the same data dir, compare against an
+# uninterrupted host-oracle replay (fast, deterministic, no jax import)
+python scripts/crash_smoke.py
+
 echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"
 JAX_PLATFORMS=cpu python __graft_entry__.py 8
 

@@ -42,6 +42,23 @@ from .spicedb.endpoints import Bootstrap
 DEFAULT_WORKFLOW_DATABASE_PATH = "/tmp/dtx.sqlite"  # options.go:41
 
 
+def resolve_workflow_db(data_dir: str, workflow_database_path: str) -> str:
+    """The SQLite dual-write journal defaults into the persistence data
+    dir when one is configured: the journal and the relationship store
+    must share a fate for crash recovery to replay pending dual writes
+    against the state they committed into."""
+    if data_dir and workflow_database_path == DEFAULT_WORKFLOW_DATABASE_PATH:
+        import os
+        os.makedirs(data_dir, exist_ok=True)
+        return os.path.join(data_dir, "dtx.sqlite")
+    return workflow_database_path
+
+
+def _durable_store_on() -> bool:
+    from .utils.features import GATES
+    return GATES.enabled("DurableStore")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="spicedb-kubeapi-proxy-tpu",
@@ -96,7 +113,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workflow-database-path",
                    default=DEFAULT_WORKFLOW_DATABASE_PATH,
                    help="SQLite database backing the dual-write workflow "
-                        "engine")
+                        "engine (defaults into --data-dir/dtx.sqlite when "
+                        "a data dir is configured)")
+
+    # durable relationship store (spicedb/persist, docs/durability.md)
+    p.add_argument("--data-dir", default="",
+                   help="directory for the durable relationship store "
+                        "(segmented WAL + columnar checkpoints); empty = "
+                        "in-memory only.  On restart the store is "
+                        "recovered from the newest checkpoint plus the "
+                        "WAL tail, the revision counter continues, and "
+                        "the bootstrap RELATIONSHIPS are skipped "
+                        "(bootstrap-once) — keep passing "
+                        "--spicedb-bootstrap: its schema is not "
+                        "persisted and is required every start")
+    p.add_argument("--wal-fsync", default="interval",
+                   choices=["always", "interval", "never"],
+                   help="WAL fsync policy: always (every committed write "
+                        "is durable before it is acked), interval "
+                        "(~1s loss window), never (OS cache only)")
+    p.add_argument("--checkpoint-interval", type=float, default=300.0,
+                   help="seconds between store checkpoints; each "
+                        "checkpoint lets covered WAL segments be "
+                        "reclaimed and bounds restart replay time")
     p.add_argument("--lock-mode", default=proxyrule.PESSIMISTIC_LOCK_MODE,
                    choices=[proxyrule.PESSIMISTIC_LOCK_MODE,
                             proxyrule.OPTIMISTIC_LOCK_MODE],
@@ -204,6 +243,12 @@ def validate(args: argparse.Namespace) -> list:
                     "(embedded:// or jax://)")
     if args.decision_cache_bytes < 0:
         errs.append("--decision-cache-bytes must be >= 0")
+    if (args.data_dir
+            and not args.spicedb_endpoint.startswith(("embedded", "jax"))):
+        errs.append("--data-dir persistence requires a store-backed "
+                    "endpoint (embedded:// or jax://)")
+    if args.checkpoint_interval <= 0:
+        errs.append("--checkpoint-interval must be > 0")
     from .utils.audit import parse_level
     try:
         parse_level(args.audit_level)
@@ -350,7 +395,13 @@ def complete(args: argparse.Namespace,
         rule_configs=rule_configs,
         upstream_transport=upstream_transport,
         authenticators=authenticators,
-        workflow_database_path=args.workflow_database_path,
+        # the journal relocates into the data dir only when persistence
+        # will actually engage: with the DurableStore gate off the store
+        # runs in-memory, and the journal must not imply a shared fate
+        # that does not exist
+        workflow_database_path=resolve_workflow_db(
+            args.data_dir if _durable_store_on() else "",
+            args.workflow_database_path),
         lock_mode_default=args.lock_mode,
         ssl_context=ssl_context,
         endpoint_kwargs=endpoint_kwargs,
@@ -358,6 +409,9 @@ def complete(args: argparse.Namespace,
         audit_level=args.audit_level,
         audit_sample_every=args.audit_sample_every,
         audit_explain=args.audit_explain,
+        data_dir=args.data_dir,
+        wal_fsync=args.wal_fsync,
+        checkpoint_interval=args.checkpoint_interval,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,

@@ -820,13 +820,24 @@ class JaxEndpoint(PermissionsEndpoint):
         else:
             schema_text = bootstrap.schema_text
             rel_text = bootstrap.relationships_text
-        from ..spicedb.endpoints import merge_internal_definitions
+        from ..spicedb.endpoints import (
+            apply_bootstrap_once,
+            merge_internal_definitions,
+        )
         ep = cls(merge_internal_definitions(sch.parse_schema(schema_text)),
                  **kwargs)
-        if rel_text.strip():
-            # columnar bulk path (native parser when available)
-            ep.store.bulk_load_text(rel_text)
+        # bootstrap-once: a store recovered from a data dir (revision > 0)
+        # already contains its bootstrap + all post-bootstrap writes
+        apply_bootstrap_once(ep.store, rel_text)
         return ep
+
+    def warm_start(self) -> None:
+        """Build the device graph from the current store NOW instead of
+        lazily on the first query — the warm-graph-start step of crash
+        recovery (spicedb/persist): a recovered 1M-tuple store pays its
+        compile before the server starts accepting traffic."""
+        with self._lock:
+            self._apply_pending()
 
     # -- delta intake -------------------------------------------------------
 

@@ -13,6 +13,7 @@ pkg/inmemory).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import logging
 import ssl
@@ -30,7 +31,13 @@ from ..config import proxyrule
 from ..rules.engine import MapMatcher
 from ..spicedb.endpoints import Bootstrap, PermissionsEndpoint, create_endpoint
 from ..utils import tracing
-from ..utils.audit import AuditSink, LEVEL_METADATA, normalize_outcome
+from ..utils.audit import (
+    AuditEvent,
+    AuditSink,
+    LEVEL_METADATA,
+    OUTCOME_ALLOWED,
+    normalize_outcome,
+)
 from .authn import (
     Authenticator,
     AuthenticatorChain,
@@ -125,6 +132,13 @@ class Options:
     audit_level: str = LEVEL_METADATA
     audit_sample_every: int = 1
     audit_explain: bool = False
+    # durable relationship store (spicedb/persist, docs/durability.md):
+    # "" = in-memory only.  With a data dir, the store is recovered from
+    # the newest checkpoint + WAL tail at construction, every commit is
+    # journaled, and a periodic checkpoint loop runs with the server.
+    data_dir: str = ""
+    wal_fsync: str = "interval"  # always | interval | never
+    checkpoint_interval: float = 300.0
 
 
 class ProxyServer:
@@ -134,9 +148,27 @@ class ProxyServer:
         if opts.upstream_transport is None:
             raise ValueError("upstream_transport (kube-apiserver seam) is required")
         self.opts = opts
+        # durable store: recover BEFORE endpoint construction and attach
+        # BEFORE bootstrap so the bootstrap load itself is journaled;
+        # bootstrap-once then skips re-applying it onto recovered state
+        self.persistence = None
+        endpoint_kwargs = dict(opts.endpoint_kwargs)
+        if opts.data_dir:
+            from ..utils.features import GATES
+            if GATES.enabled("DurableStore"):
+                from ..spicedb.persist import PersistenceManager
+                self.persistence = PersistenceManager(
+                    opts.data_dir, fsync=opts.wal_fsync,
+                    checkpoint_interval=opts.checkpoint_interval)
+                store = self.persistence.recover()
+                self.persistence.attach(store)
+                endpoint_kwargs["store"] = store
+            else:
+                logger.info("--data-dir %r set but the DurableStore gate is "
+                            "disabled; running in-memory", opts.data_dir)
         self.endpoint: PermissionsEndpoint = create_endpoint(
             opts.spicedb_endpoint, bootstrap=opts.bootstrap,
-            **opts.endpoint_kwargs)
+            **endpoint_kwargs)
         # label = URL scheme; a scheme-less host:port endpoint is a
         # remote gRPC dial — label it "grpc" rather than leaking the
         # hostname into metric label cardinality
@@ -150,6 +182,16 @@ class ProxyServer:
                                sample_every=opts.audit_sample_every,
                                explain=opts.audit_explain,
                                backend=backend)
+        if self.persistence is not None and self.persistence.recovered:
+            info = self.persistence.recovery_info
+            self.audit.emit(AuditEvent(
+                stage="recovery", decision=OUTCOME_ALLOWED, backend=backend,
+                message=(f"recovered store at revision {info.get('revision')}"
+                         f" (checkpoint rev {info['checkpoint_revision']},"
+                         f" {info['replayed_records']} WAL records,"
+                         f" {info['torn_records']} torn,"
+                         f" {info['idempotency_keys']} idempotency keys)"
+                         f" in {info['total_s']}s")))
         configs = list(opts.rule_configs)
         if opts.rules_yaml:
             configs.extend(proxyrule.parse(opts.rules_yaml))
@@ -356,9 +398,28 @@ class ProxyServer:
     # -- serving -------------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        # warm graph start BEFORE serving: a recovered store pays the
+        # device-graph compile now, so the first authorized request after
+        # a restart doesn't absorb a 1M-tuple rebuild (spicedb/persist)
+        if self.persistence is not None:
+            warm = getattr(self.endpoint, "warm_start", None)
+            if warm is not None:
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+                with tracing.request_trace(op="warm_start") as tr:
+                    with tracing.span("recovery.graph_rebuild", phase=True):
+                        await loop.run_in_executor(None,
+                                                   lambda: ctx.run(warm))
+                tracing.RECORDER.record(tr)
         self._http = HttpServer(self.handler, ssl_context=self.opts.ssl_context)
         bound = await self._http.start(host, port)
+        if self.persistence is not None:
+            await self.persistence.start()
         if self._worker is not None:
+            # the worker's first drain replays dual-write instances left
+            # pending by a crash — AFTER the store above was recovered,
+            # so idempotency-key tuples restored from the WAL let
+            # write_to_spicedb detect already-applied writes
             await self._worker.start()
         # audit writer + runtime self-metrics ride the serving lifecycle;
         # embedded (handler-only) use still audits through the ring
@@ -381,6 +442,10 @@ class ProxyServer:
             await self._worker.stop()
         if self._lag_probe is not None:
             await self._lag_probe.stop()
+        if self.persistence is not None:
+            # final checkpoint: a clean shutdown restarts from the
+            # checkpoint alone, with an empty WAL tail
+            await self.persistence.stop()
         await self.audit.stop()
 
     # -- embedded client (reference server.go:317-364, pkg/inmemory) ---------

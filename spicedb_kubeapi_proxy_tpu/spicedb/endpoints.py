@@ -15,6 +15,7 @@ consumes (SURVEY.md §5) — and never know which backend ran.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, Iterable, Optional
 from urllib.parse import urlsplit
@@ -36,6 +37,27 @@ from .types import (
     SubjectRef,
     parse_relationship,
 )
+
+logger = logging.getLogger("spicedb_kubeapi_proxy_tpu.spicedb")
+
+
+def apply_bootstrap_once(store: TupleStore, rel_text: str) -> bool:
+    """Bootstrap-once semantics shared by the store-backed endpoints:
+    `--spicedb-bootstrap` relationships apply only to a store with no
+    history (revision 0).  A store recovered from a data dir
+    (spicedb/persist) carries a revision > 0, so a restart never
+    double-applies bootstrap writes on top of recovered state."""
+    if not rel_text.strip():
+        return False
+    if store.revision > 0:
+        logger.info(
+            "skipping bootstrap relationships: store already carries "
+            "state at revision %d (recovered from a data dir)",
+            store.revision)
+        return False
+    # columnar bulk path (native parser when available)
+    store.bulk_load_text(rel_text)
+    return True
 
 
 class PermissionsEndpoint:
@@ -231,17 +253,17 @@ class EmbeddedEndpoint(PermissionsEndpoint):
     # -- constructors -------------------------------------------------------
 
     @classmethod
-    def from_bootstrap(cls, bootstrap: Optional[Bootstrap] = None) -> "EmbeddedEndpoint":
+    def from_bootstrap(cls, bootstrap: Optional[Bootstrap] = None,
+                       store: Optional[TupleStore] = None) -> "EmbeddedEndpoint":
         if bootstrap is None or not bootstrap.schema_text:
             schema_text = DEFAULT_BOOTSTRAP_SCHEMA
             rel_text = bootstrap.relationships_text if bootstrap else ""
         else:
             schema_text = bootstrap.schema_text
             rel_text = bootstrap.relationships_text
-        endpoint = cls(merge_internal_definitions(sch.parse_schema(schema_text)))
-        if rel_text.strip():
-            # columnar bulk path (native parser when available)
-            endpoint.store.bulk_load_text(rel_text)
+        endpoint = cls(merge_internal_definitions(sch.parse_schema(schema_text)),
+                       store=store)
+        apply_bootstrap_once(endpoint.store, rel_text)
         return endpoint
 
     # -- verbs --------------------------------------------------------------
@@ -350,6 +372,13 @@ def create_endpoint(url: str,
     params = parse_qs(split.query)
     cache_on, cache_explicit, cache_bytes = _resolve_cache_config(
         url, params, kwargs)
+    # a pre-built store (the persistence layer hands its recovered store
+    # in here) only makes sense for the store-backed backends
+    store = kwargs.pop("store", None)
+    if scheme not in ("embedded", "jax") and store is not None:
+        raise EndpointConfigError(
+            f"--data-dir persistence requires a store-backed endpoint "
+            f"(embedded:// or jax://), not {url!r}")
     if scheme not in ("embedded", "jax") and cache_on:
         if cache_explicit:
             raise EndpointConfigError(
@@ -357,7 +386,7 @@ def create_endpoint(url: str,
                 f"(embedded:// or jax://), not {url!r}")
         cache_on = False  # gate-derived default: inapplicable, not fatal
     if scheme == "embedded":
-        ep = EmbeddedEndpoint.from_bootstrap(bootstrap)
+        ep = EmbeddedEndpoint.from_bootstrap(bootstrap, store=store)
         return _wrap_decision_cache(ep, cache_bytes) if cache_on else ep
     if scheme == "jax":
         from ..ops.jax_endpoint import JaxEndpoint  # lazy: pulls in jax
@@ -402,6 +431,8 @@ def create_endpoint(url: str,
                     raise EndpointConfigError(
                         f"invalid mesh {mesh_param!r} in {url!r}: {e}"
                     ) from e
+        if store is not None:
+            kwargs["store"] = store
         ep: PermissionsEndpoint = JaxEndpoint.from_bootstrap(bootstrap,
                                                              **kwargs)
         # cross-request batched dispatch is on by default for the device

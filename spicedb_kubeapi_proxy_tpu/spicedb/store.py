@@ -175,6 +175,17 @@ class TupleStore:
         # reset listeners fire on non-delta mass changes (bulk_load,
         # delete_all) that require a full cache rebuild.
         self._reset_listeners: list[Callable[[], None]] = []
+        # commit listeners receive EVERY revision-advancing commit with
+        # its payload — (kind, revision, payload) where kind is "delta"
+        # (payload: applied RelationshipUpdate tuple, possibly empty),
+        # "snapshot" (ColumnarSnapshot), "bulk" (Relationship list), or
+        # "clear" (None).  They run synchronously under the store lock
+        # BEFORE the mutation applies: the WAL (spicedb/persist) must
+        # observe a revision before any reader can act on it, and a
+        # listener exception (durability failure) aborts the commit —
+        # the store stays untouched, the revision is not consumed, and
+        # the error propagates to the writer.
+        self._commit_listeners: list[Callable] = []
 
     # -- revision -----------------------------------------------------------
 
@@ -403,16 +414,33 @@ class TupleStore:
                     raise AlreadyExistsError(
                         f"relationship already exists: {u.rel.rel_string()}")
                 created_in_batch.add(key)
-            self._revision += 1
-            rev = self._revision
+            # compute the applied set WITHOUT mutating: commit listeners
+            # (the WAL) journal the batch before any reader-visible
+            # change, so a durability failure aborts the write with the
+            # store untouched.  `present` tracks intra-batch ordering
+            # (touch-then-delete deletes; double-delete applies once).
             applied = []
+            present: dict = {}
             for u in updates:
+                key = u.rel.key()
                 if u.op in (UpdateOp.CREATE, UpdateOp.TOUCH):
-                    self._put(u.rel, rev)
                     applied.append(RelationshipUpdate(UpdateOp.TOUCH, u.rel))
+                    present[key] = True
                 elif u.op == UpdateOp.DELETE:
-                    if self._remove(u.rel):
-                        applied.append(RelationshipUpdate(UpdateOp.DELETE, u.rel))
+                    if present.get(key, self._present(u.rel)):
+                        applied.append(
+                            RelationshipUpdate(UpdateOp.DELETE, u.rel))
+                    present[key] = False
+            # journal even effect-free commits: the revision advances,
+            # and recovery must reproduce the exact counter
+            rev = self._revision + 1
+            self._commit("delta", rev, tuple(applied))
+            self._revision = rev
+            for u in applied:
+                if u.op == UpdateOp.TOUCH:
+                    self._put(u.rel, rev)
+                else:
+                    self._remove(u.rel)
             if applied:
                 self._broadcast(WatchUpdate(updates=tuple(applied), revision=rev))
             return rev
@@ -423,8 +451,11 @@ class TupleStore:
         the datastore, not through WriteRelationships — spicedb.go:63-67).
         One revision, no watch events."""
         with self._lock:
-            self._revision += 1
-            rev = self._revision
+            if self._commit_listeners:
+                rels = list(rels)  # journaled payload; iterated twice
+            rev = self._revision + 1
+            self._commit("bulk", rev, rels if isinstance(rels, list) else ())
+            self._revision = rev
             for rel in rels:
                 self._put(rel, rev)
             for fn in list(self._reset_listeners):
@@ -440,22 +471,24 @@ class TupleStore:
             victims = self.read(flt)
             if not victims:
                 return self._revision, []
-            self._revision += 1
-            rev = self._revision
-            applied = []
+            applied = tuple(RelationshipUpdate(UpdateOp.DELETE, rel)
+                            for rel in victims)
+            rev = self._revision + 1
+            self._commit("delta", rev, applied)
+            self._revision = rev
             for rel in victims:
-                if self._remove(rel):
-                    applied.append(RelationshipUpdate(UpdateOp.DELETE, rel))
-            if applied:
-                self._broadcast(WatchUpdate(updates=tuple(applied), revision=rev))
+                self._remove(rel)
+            self._broadcast(WatchUpdate(updates=applied, revision=rev))
             return rev, victims
 
     def delete_all(self) -> None:
         """Test helper (mirrors the reference e2e DeleteAllTuples util)."""
         with self._lock:
+            rev = self._revision + 1
+            self._commit("clear", rev, None)
+            self._revision = rev
             self._by_relation.clear()
             self._base = None
-            self._revision += 1
             for fn in list(self._reset_listeners):
                 fn()
 
@@ -471,11 +504,13 @@ class TupleStore:
             if self._by_relation or self._base is not None:
                 return self.bulk_load(snap.relationship(i)
                                       for i in range(len(snap)))
-            self._revision += 1
-            self._base = BaseLayer(snap, self._revision)
+            rev = self._revision + 1
+            self._commit("snapshot", rev, snap)
+            self._revision = rev
+            self._base = BaseLayer(snap, rev)
             for fn in list(self._reset_listeners):
                 fn()
-            return self._revision
+            return rev
 
     def bulk_load_text(self, text: str) -> int:
         """Parse + adopt relationship text via the native loader.  Caveated
@@ -546,7 +581,68 @@ class TupleStore:
             if fn in self._reset_listeners:
                 self._reset_listeners.remove(fn)
 
+    def add_commit_listener(self, fn: Callable) -> None:
+        """fn(kind, revision, payload) on every revision-advancing
+        commit, synchronously under the store lock (see __init__)."""
+        with self._lock:
+            self._commit_listeners.append(fn)
+
+    def remove_commit_listener(self, fn: Callable) -> None:
+        with self._lock:
+            if fn in self._commit_listeners:
+                self._commit_listeners.remove(fn)
+
+    # -- recovery (spicedb/persist) -----------------------------------------
+
+    def adopt_recovery_state(self, snap: Optional[ColumnarSnapshot],
+                             overlay: Iterable[Relationship],
+                             revision: int) -> None:
+        """Recovery-only: adopt a checkpointed state wholesale at
+        EXACTLY `revision` — columnar base plus object overlay
+        (caveated tuples), with no intermediate revision bumps (a
+        checkpoint taken at revision 1 must not land at 2 because its
+        overlay loaded as a second step).  Requires a store with no
+        history; fires no listeners (recovery precedes attach)."""
+        if revision < 1:
+            raise ValueError(f"invalid recovery revision {revision}")
+        with self._lock:
+            if self._revision != 0 or self._by_relation or self._base is not None:
+                raise ValueError(
+                    "adopt_recovery_state requires an empty store")
+            if snap is not None and len(snap):
+                self._base = BaseLayer(snap, revision)
+            for rel in overlay:
+                self._put(rel, revision)
+            self._revision = revision
+
+    def apply_recovery_batch(self, updates: Iterable[RelationshipUpdate]) -> int:
+        """Re-apply one journaled committed batch exactly as recorded:
+        no limits, preconditions, CREATE validation, or listener
+        broadcast (recovery runs before any listener attaches) — the
+        batch already committed once, so it re-applies verbatim.  One
+        revision bump even for an effect-free batch, mirroring write()."""
+        with self._lock:
+            self._revision += 1
+            rev = self._revision
+            for u in updates:
+                if u.op == UpdateOp.DELETE:
+                    self._remove(u.rel)
+                else:
+                    self._put(u.rel, rev)
+            return rev
+
     # -- internals ----------------------------------------------------------
+
+    def _present(self, rel: Relationship) -> bool:
+        """Identity-present regardless of expiry — mirrors what
+        _remove() can reach, so a pre-commit applied-set computation
+        agrees with the mutation it precedes."""
+        by_id = self._by_relation.get((rel.resource.type, rel.relation))
+        subjects = by_id.get(rel.resource.id) if by_id else None
+        if subjects and rel.subject in subjects:
+            return True
+        base = self._base
+        return base is not None and base.find_row(rel.key()) >= 0
 
     def _live_entry(self, rel: Relationship, now: float) -> Optional[_Entry]:
         by_id = self._by_relation.get((rel.resource.type, rel.relation), {})
@@ -605,3 +701,9 @@ class TupleStore:
             fn(update)
         for w in list(self._watchers):
             w._publish(update)
+
+    def _commit(self, kind: str, revision: int, payload) -> None:
+        """Notify commit listeners (under the store lock, before any
+        watcher/delta listener — WAL-before-visibility ordering)."""
+        for fn in list(self._commit_listeners):
+            fn(kind, revision, payload)

@@ -88,3 +88,7 @@ GATES.register("CrossRequestBatching", stage=GA, default=True)
 # (spicedb/decision_cache.py); also switchable per endpoint via
 # `?cache=1` or the --decision-cache CLI flag
 GATES.register("DecisionCache", stage=ALPHA, default=False)
+# durable relationship store (spicedb/persist): WAL + checkpoints +
+# crash recovery; engages when --data-dir is set, this gate is the
+# killswitch (disable to run in-memory despite a configured data dir)
+GATES.register("DurableStore", stage=BETA, default=True)

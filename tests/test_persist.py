@@ -1,0 +1,466 @@
+"""Durable relationship store (spicedb/persist): WAL framing, segment
+rolling, torn-tail repair, checkpoint round trips, recovery parity,
+revision continuity, bootstrap-once semantics, and CLI wiring."""
+
+import glob
+import os
+import tempfile
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.cli import (
+    DEFAULT_WORKFLOW_DATABASE_PATH,
+    build_parser,
+    resolve_workflow_db,
+    validate,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+    Bootstrap,
+    EmbeddedEndpoint,
+    EndpointConfigError,
+    create_endpoint,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.persist import (
+    PersistenceManager,
+    PersistenceUnavailableError,
+    SegmentedWal,
+    WalCorruptionError,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CaveatRef,
+    ObjectRef,
+    Relationship,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.utils import failpoints
+
+BOOT = """\
+doc:d1#viewer@user:u1
+doc:d2#viewer@user:u2
+doc:d3#viewer@user:u3[expiration:99999999999]
+"""
+
+SCHEMA = """
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+
+
+@pytest.fixture(autouse=True)
+def reset_failpoints():
+    failpoints.disable_all()
+    yield
+    failpoints.disable_all()
+
+
+@pytest.fixture()
+def tmpdir():
+    with tempfile.TemporaryDirectory() as d:
+        yield d
+
+
+def touch(s):
+    return RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(s))
+
+
+def delete(s):
+    return RelationshipUpdate(UpdateOp.DELETE, parse_relationship(s))
+
+
+def rels_of(store):
+    return sorted(r.rel_string() for r in store.read(None))
+
+
+class TestWal:
+    def test_append_replay_round_trip(self, tmpdir):
+        wal = SegmentedWal(tmpdir, fsync="never")
+        payloads = [b'{"k":"c","r":%d}' % i for i in range(1, 8)]
+        for p in payloads:
+            wal.append(p)
+        wal.close()
+        got = [rec for rec in SegmentedWal(tmpdir).replay()]
+        assert [rec["r"] for rec in got] == list(range(1, 8))
+
+    def test_segment_rolling_and_cut(self, tmpdir):
+        wal = SegmentedWal(tmpdir, fsync="never", segment_bytes=64)
+        for i in range(1, 11):
+            wal.append(b'{"k":"c","r":%d}' % i)
+        assert wal.segment_count() > 1
+        watermark = wal.cut()
+        wal.append(b'{"k":"c","r":11}')
+        # records after the cut land in segments above the watermark
+        assert max(wal.segment_seqs()) > watermark
+        got = [rec["r"] for rec in SegmentedWal(tmpdir).replay()]
+        assert got == list(range(1, 12))
+
+    def test_torn_tail_truncated(self, tmpdir):
+        wal = SegmentedWal(tmpdir, fsync="never")
+        for i in range(1, 6):
+            wal.append(b'{"k":"c","r":%d}' % i)
+        wal.close()
+        seg = sorted(glob.glob(os.path.join(tmpdir, "seg-*.wal")))[-1]
+        with open(seg, "rb+") as f:
+            f.truncate(os.path.getsize(seg) - 5)
+        reader = SegmentedWal(tmpdir)
+        got = [rec["r"] for rec in reader.replay()]
+        assert got == [1, 2, 3, 4]
+        assert reader.torn_records == 1
+        # the repaired file replays cleanly a second time
+        assert [r["r"] for r in SegmentedWal(tmpdir).replay()] == got
+
+    def test_mid_segment_corruption_raises(self, tmpdir):
+        wal = SegmentedWal(tmpdir, fsync="never")
+        for i in range(1, 6):
+            wal.append(b'{"k":"c","r":%d}' % i)
+        wal.close()
+        seg = sorted(glob.glob(os.path.join(tmpdir, "seg-*.wal")))[-1]
+        with open(seg, "rb+") as f:
+            f.seek(20)
+            f.write(b"\xff")
+        with pytest.raises(WalCorruptionError):
+            list(SegmentedWal(tmpdir).replay())
+
+    def test_sealed_segment_corruption_raises_even_at_its_tail(self, tmpdir):
+        wal = SegmentedWal(tmpdir, fsync="never", segment_bytes=32)
+        for i in range(1, 6):
+            wal.append(b'{"k":"c","r":%d}' % i)
+        wal.close()
+        segs = sorted(glob.glob(os.path.join(tmpdir, "seg-*.wal")))
+        assert len(segs) > 1
+        with open(segs[0], "rb+") as f:
+            f.truncate(os.path.getsize(segs[0]) - 3)
+        with pytest.raises(WalCorruptionError):
+            list(SegmentedWal(tmpdir).replay())
+
+    def test_bad_fsync_policy_rejected(self, tmpdir):
+        with pytest.raises(ValueError):
+            SegmentedWal(tmpdir, fsync="sometimes")
+
+    def test_torn_segment_header_survives_two_restarts(self, tmpdir):
+        """A segment whose header write was torn is removed on the first
+        recovery; once newer segments exist, the remnant must not read
+        as mid-stream corruption on LATER recoveries."""
+        wal = SegmentedWal(tmpdir, fsync="never")
+        for i in range(1, 4):
+            wal.append(b'{"k":"c","r":%d}' % i)
+        wal.close()
+        # torn creation of the next segment: only 3 bytes of magic land
+        segs = sorted(glob.glob(os.path.join(tmpdir, "seg-*.wal")))
+        torn = os.path.join(tmpdir, "seg-%08d.wal" % (len(segs) + 1))
+        with open(torn, "wb") as f:
+            f.write(b"SPW")
+        # restart 1: repaired (removed), records intact
+        w2 = SegmentedWal(tmpdir)
+        assert [r["r"] for r in w2.replay()] == [1, 2, 3]
+        assert not os.path.exists(torn)
+        w2.append(b'{"k":"c","r":4}')
+        w2.close()
+        # restart 2: the full stream replays with no corruption error
+        assert [r["r"] for r in SegmentedWal(tmpdir).replay()] == [1, 2, 3, 4]
+
+    def test_empty_segment_tolerated_mid_stream(self, tmpdir):
+        wal = SegmentedWal(tmpdir, fsync="never")
+        wal.append(b'{"k":"c","r":1}')
+        wal.close()
+        # zero-byte segment between two real ones (crash before magic)
+        open(os.path.join(tmpdir, "seg-00000002.wal"), "wb").close()
+        w2 = SegmentedWal(tmpdir)
+        w2.append(b'{"k":"c","r":2}')
+        w2.close()
+        assert [r["r"] for r in SegmentedWal(tmpdir).replay()] == [1, 2]
+
+    def test_idle_fsync_hook(self, tmpdir):
+        wal = SegmentedWal(tmpdir, fsync="interval", fsync_interval=3600)
+        wal.append(b'{"k":"c","r":1}')  # interval not elapsed: no fsync
+        assert wal.fsync_if_dirty() is True
+        assert wal.fsync_if_dirty() is False  # nothing new since
+
+
+class TestRecoveryParity:
+    def drive(self, store):
+        """A deterministic mixed update stream."""
+        store.bulk_load_text(BOOT)
+        for i in range(12):
+            store.write([touch(f"doc:w{i}#viewer@user:u{i % 3}")])
+        store.write([delete("doc:w5#viewer@user:u2"),
+                     touch("doc:extra#viewer@user:u1")])
+        store.delete_by_filter(RelationshipFilter(resource_id="w7"))
+        store.write([])  # effect-free revision bump
+        # caveated + expiring tuples ride the object path
+        store.write([RelationshipUpdate(UpdateOp.TOUCH, Relationship(
+            resource=ObjectRef("doc", "cav"), relation="viewer",
+            subject=SubjectRef("user", "u9"),
+            caveat=CaveatRef.make("tod", {"x": 1}),
+            expires_at=88888888888.0))])
+
+    def test_wal_only_recovery(self, tmpdir):
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        assert not mgr.recovered and store.revision == 0
+        mgr.attach(store)
+        self.drive(store)
+        want, rev = rels_of(store), store.revision
+        # crash: abandon without close
+        mgr2 = PersistenceManager(tmpdir)
+        s2 = mgr2.recover()
+        assert mgr2.recovered
+        assert s2.revision == rev
+        assert rels_of(s2) == want
+        # caveat context survives the round trip
+        assert any("[caveat:tod:" in r for r in rels_of(s2))
+
+    def test_checkpoint_plus_tail_and_reclaim(self, tmpdir):
+        mgr = PersistenceManager(tmpdir, fsync="never", segment_bytes=256)
+        store = mgr.recover()
+        mgr.attach(store)
+        self.drive(store)
+        pre_segments = mgr.wal.segment_count()
+        manifest = mgr.checkpoint()
+        assert manifest["revision"] == store.revision
+        assert mgr.wal.segment_count() < pre_segments
+        # idempotent: no new revision -> no new checkpoint
+        assert mgr.checkpoint() is None
+        store.write([touch("doc:tail#viewer@user:u1")])
+        want, rev = rels_of(store), store.revision
+        mgr2 = PersistenceManager(tmpdir)
+        s2 = mgr2.recover()
+        info = mgr2.recovery_info
+        assert info["checkpoint_revision"] == manifest["revision"]
+        assert info["replayed_records"] == 1  # just the tail write
+        assert s2.revision == rev
+        assert rels_of(s2) == want
+
+    def test_delete_all_and_object_path_bulk_survive(self, tmpdir):
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        store.bulk_load_text(BOOT)
+        store.delete_all()
+        store.bulk_load([parse_relationship("doc:obj#viewer@user:u4")])
+        want, rev = rels_of(store), store.revision
+        s2 = PersistenceManager(tmpdir).recover()
+        assert (rels_of(s2), s2.revision) == (want, rev)
+        assert rels_of(s2) == ["doc:obj#viewer@user:u4"]
+
+    def test_object_path_checkpoint(self, tmpdir):
+        """A store with no columnar base (pure object inserts, incl.
+        caveats) checkpoints and recovers identically."""
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        store.write([touch("doc:a#viewer@user:u1"),
+                     touch("doc:b#viewer@user:u2")])
+        store.write([RelationshipUpdate(UpdateOp.TOUCH, Relationship(
+            resource=ObjectRef("doc", "c"), relation="viewer",
+            subject=SubjectRef("user", "u3"),
+            caveat=CaveatRef.make("tod")))])
+        mgr.checkpoint()
+        want, rev = rels_of(store), store.revision
+        s2 = PersistenceManager(tmpdir).recover()
+        assert (rels_of(s2), s2.revision) == (want, rev)
+
+    def test_revision_continuity_after_recovery(self, tmpdir):
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        self.drive(store)
+        rev = store.revision
+        mgr2 = PersistenceManager(tmpdir)
+        s2 = mgr2.recover()
+        mgr2.attach(s2)
+        assert s2.write([touch("doc:post#viewer@user:u1")]) == rev + 1
+
+    def test_adopt_recovery_state_guards(self):
+        store = TupleStore()
+        with pytest.raises(ValueError):
+            store.adopt_recovery_state(None, [], 0)  # revision < 1
+        store.adopt_recovery_state(
+            None, [parse_relationship("doc:a#viewer@user:u1")], 7)
+        assert store.revision == 7
+        assert rels_of(store) == ["doc:a#viewer@user:u1"]
+        with pytest.raises(ValueError):  # only ever onto an empty store
+            store.adopt_recovery_state(None, [], 9)
+
+    def test_wal_append_failure_fail_stops_untouched(self, tmpdir):
+        """An IO failure mid-append aborts the commit with the store
+        UNTOUCHED (journal-before-mutate): the failed write is never
+        visible, every later write raises PersistenceUnavailableError,
+        and the data dir stays recoverable with no revision gap."""
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        store.write([touch("doc:a#viewer@user:u1")])
+        real_append = mgr.wal.append
+
+        def flaky_append(payload, kind=""):
+            raise OSError("disk on fire")
+        mgr.wal.append = flaky_append
+        with pytest.raises(OSError):
+            store.write([touch("doc:b#viewer@user:u1")])
+        # the failed write never became visible and consumed no revision
+        assert store.revision == 1
+        assert rels_of(store) == ["doc:a#viewer@user:u1"]
+        mgr.wal.append = real_append  # the fault clears, but...
+        with pytest.raises(PersistenceUnavailableError):
+            store.write([touch("doc:c#viewer@user:u1")])
+        # a checkpoint after the failure persists only committed state
+        ck = mgr.checkpoint()
+        assert ck is not None and ck["revision"] == 1
+        # recovery sees the intact prefix, gap-free
+        s2 = PersistenceManager(tmpdir).recover()
+        assert s2.revision == 1
+        assert rels_of(s2) == ["doc:a#viewer@user:u1"]
+
+    def test_rev1_checkpoint_with_overlay_recovers(self, tmpdir):
+        """A checkpoint taken at revision 1 whose state mixes columnar
+        and overlay (caveated) tuples must recover at exactly revision
+        1 — loading base + overlay as separate revision-bumping steps
+        would brick the data dir."""
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        store.bulk_load([
+            parse_relationship("doc:plain#viewer@user:u1"),
+            Relationship(resource=ObjectRef("doc", "cav"),
+                         relation="viewer",
+                         subject=SubjectRef("user", "u2"),
+                         caveat=CaveatRef.make("tod", {"x": 1})),
+        ])
+        assert store.revision == 1
+        mgr.checkpoint()
+        mgr.close()
+        for _ in range(2):  # recovery must be repeatable
+            s2 = PersistenceManager(tmpdir).recover()
+            assert s2.revision == 1
+            assert rels_of(s2) == rels_of(store)
+
+    def test_sidecar_written_before_record(self, tmpdir):
+        """A WAL record referencing a bulk-load sidecar implies the
+        sidecar file exists (write-then-reference ordering)."""
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        store.bulk_load_text(BOOT)
+        recs = list(mgr.wal.replay())
+        snaps = [r for r in recs if r["k"] == "s"]
+        assert snaps
+        for r in snaps:
+            assert os.path.exists(os.path.join(mgr.wal.dir, r["f"]))
+
+
+class TestBootstrapOnce:
+    def test_restart_does_not_double_apply_bootstrap(self, tmpdir):
+        boot = Bootstrap(schema_text=SCHEMA, relationships_text=BOOT)
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        ep = create_endpoint("embedded://", bootstrap=boot, store=store)
+        assert store.revision > 0
+        store.write([touch("doc:post#viewer@user:u1")])
+        rev, want = store.revision, rels_of(store)
+        # restart
+        mgr2 = PersistenceManager(tmpdir)
+        s2 = mgr2.recover()
+        mgr2.attach(s2)
+        ep2 = create_endpoint("embedded://", bootstrap=boot, store=s2)
+        # the bootstrap was NOT re-applied: revision unchanged, state
+        # equals recovered (bootstrap + post-bootstrap write)
+        assert s2.revision == rev
+        assert rels_of(s2) == want
+        assert isinstance(ep2, EmbeddedEndpoint) and ep2.store is s2
+        del ep
+
+    def test_fresh_store_still_bootstraps(self):
+        ep = EmbeddedEndpoint.from_bootstrap(
+            Bootstrap(schema_text=SCHEMA, relationships_text=BOOT))
+        assert ep.store.count() == 3
+
+    def test_store_kwarg_rejected_for_grpc(self):
+        with pytest.raises(EndpointConfigError):
+            create_endpoint("grpc://localhost:50051", store=TupleStore())
+
+
+class TestCheckpointCrashWindows:
+    def test_checkpoint_rename_crash_keeps_old_state(self, tmpdir):
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        store.bulk_load_text(BOOT)
+        store.write([touch("doc:one#viewer@user:u1")])
+        want, rev = rels_of(store), store.revision
+        failpoints.enable_failpoint("checkpointBeforeRename", 1)
+        with pytest.raises(failpoints.FailPointPanic):
+            mgr.checkpoint()
+        s2 = PersistenceManager(tmpdir).recover()
+        assert (rels_of(s2), s2.revision) == (want, rev)
+
+    def test_manifest_rename_crash_keeps_old_manifest(self, tmpdir):
+        mgr = PersistenceManager(tmpdir, fsync="never")
+        store = mgr.recover()
+        mgr.attach(store)
+        store.bulk_load_text(BOOT)
+        first = mgr.checkpoint()
+        store.write([touch("doc:two#viewer@user:u2")])
+        want, rev = rels_of(store), store.revision
+        failpoints.enable_failpoint("manifestBeforeRename", 1)
+        with pytest.raises(failpoints.FailPointPanic):
+            mgr.checkpoint()
+        mgr2 = PersistenceManager(tmpdir)
+        s2 = mgr2.recover()
+        # manifest still points at the FIRST checkpoint; the tail write
+        # replays from the WAL
+        assert mgr2.recovery_info["checkpoint_revision"] == first["revision"]
+        assert (rels_of(s2), s2.revision) == (want, rev)
+
+
+class TestCliWiring:
+    def base_args(self, *extra):
+        return build_parser().parse_args([
+            "--backend-kubeconfig", "x", "--rule-config", "y", *extra])
+
+    def test_flags_parse(self):
+        args = self.base_args("--data-dir", "/tmp/dd", "--wal-fsync",
+                              "always", "--checkpoint-interval", "60")
+        assert args.data_dir == "/tmp/dd"
+        assert args.wal_fsync == "always"
+        assert args.checkpoint_interval == 60.0
+        assert validate(args) == []
+
+    def test_defaults(self):
+        args = self.base_args()
+        assert args.data_dir == ""
+        assert args.wal_fsync == "interval"
+        assert args.checkpoint_interval == 300.0
+
+    def test_data_dir_requires_store_backed_endpoint(self):
+        args = self.base_args("--data-dir", "/tmp/dd",
+                              "--spicedb-endpoint", "grpc://h:1")
+        assert any("--data-dir" in e for e in validate(args))
+
+    def test_checkpoint_interval_positive(self):
+        args = self.base_args("--checkpoint-interval", "0")
+        assert any("--checkpoint-interval" in e for e in validate(args))
+
+    def test_bad_fsync_choice_rejected(self):
+        with pytest.raises(SystemExit):
+            self.base_args("--wal-fsync", "sometimes")
+
+    def test_workflow_db_defaults_into_data_dir(self, tmpdir):
+        dd = os.path.join(tmpdir, "data")
+        assert resolve_workflow_db(dd, DEFAULT_WORKFLOW_DATABASE_PATH) == \
+            os.path.join(dd, "dtx.sqlite")
+        assert os.path.isdir(dd)
+        # an explicit path wins
+        assert resolve_workflow_db(dd, "/elsewhere.sqlite") == \
+            "/elsewhere.sqlite"
+        # no data dir: unchanged default
+        assert resolve_workflow_db("", DEFAULT_WORKFLOW_DATABASE_PATH) == \
+            DEFAULT_WORKFLOW_DATABASE_PATH
