@@ -217,6 +217,27 @@ def probe_backend(timeout_s: float, attempts: int,
     return verdict
 
 
+def devtel_snapshot():
+    """Cumulative device-telemetry counters (utils/devtel.py); None when
+    the package (or jax) is unavailable so the bench never dies on it."""
+    try:
+        from spicedb_kubeapi_proxy_tpu.utils import devtel
+        return devtel.snapshot()
+    except Exception:
+        return None
+
+
+def devtel_delta(before):
+    """End-of-run device-telemetry view for one config: HBM peak/by-kind
+    bytes, recompile + jit-hit counts, mean batch occupancy, per-bucket
+    kernel time — the numbers later kernel PRs are judged by."""
+    after = devtel_snapshot()
+    if before is None or after is None:
+        return None
+    from spicedb_kubeapi_proxy_tpu.utils import devtel
+    return devtel.diff_snapshot(before, after)
+
+
 def build_endpoint(workload, kind: str):
     from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
     from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
@@ -954,7 +975,11 @@ def main() -> None:
     if args.config in CACHE_CONFIGS:
         # standalone decision-cache config: its own headline metric
         stage(f"cache config {args.config}")
+        tel_before = devtel_snapshot()
         res = CACHE_CONFIGS[args.config](args)
+        tel = devtel_delta(tel_before)
+        if tel:
+            res["device_telemetry"] = tel
         value = (res.get("cache_on_checks_per_s")
                  or res.get("lists_per_s", 0.0))
         _STATE["metric"] = f"decision-cache {args.config}"
@@ -968,7 +993,11 @@ def main() -> None:
     if args.config in PERSIST_CONFIGS:
         # standalone durable-store config: time-to-serve after restart
         stage(f"persist config {args.config}")
+        tel_before = devtel_snapshot()
         res = PERSIST_CONFIGS[args.config](args)
+        tel = devtel_delta(tel_before)
+        if tel:
+            res["device_telemetry"] = tel
         _STATE["metric"] = f"durable-store {args.config}"
         emit({"metric": _STATE["metric"],
               "value": res.get("time_to_serve_s", 0.0), "unit": "s",
@@ -989,6 +1018,7 @@ def main() -> None:
 
     def run_one(name, with_oracle=True, rounds=None):
         workload = load_workload(name)
+        tel_before = devtel_snapshot()
         r = rounds if rounds is not None else args.rounds
         if args.direct_only:
             head = bench_jax(workload, args.batch, r)
@@ -1004,6 +1034,11 @@ def main() -> None:
         log(f"{name} direct batch: {direct['checks_per_s']:.3g} checks/s "
             f"({direct['per_batch_s'] * 1000:.1f} ms, "
             f"p99 {direct['p99_s'] * 1000:.1f} ms)")
+        # end-of-run device-telemetry snapshot rides the artifact for
+        # EVERY config (HBM peak, recompiles, occupancy, per-bucket
+        # kernel time), so BENCH_r*.json carries device numbers
+        # alongside throughput
+        tel = devtel_delta(tel_before)
         if name == args.config:
             # watchdog partials must only ever carry the headline config's
             # numbers — a sweep config's value under the headline metric
@@ -1012,6 +1047,7 @@ def main() -> None:
                 "value": round(head["checks_per_s"], 1),
                 "p99_list_filter_ms": round(head["p99_s"] * 1000, 2),
                 "direct_batch_checks_per_s": round(direct["checks_per_s"], 1),
+                **({"device_telemetry": tel} if tel else {}),
             })
         else:
             # sweep numbers land in the artifact too (VERDICT r3 item 3)
@@ -1020,6 +1056,7 @@ def main() -> None:
                 "p99_ms": round(head["p99_s"] * 1000, 2),
                 "direct_checks_per_s": round(direct["checks_per_s"], 1),
                 "objects": head["objects"],
+                **({"device_telemetry": tel} if tel else {}),
             }
         oracle_res = None
         if with_oracle:
@@ -1051,6 +1088,8 @@ def main() -> None:
         "baseline": "python-oracle",
         "baseline_note": BASELINE_NOTE,
     }
+    if _STATE["partial"].get("device_telemetry"):
+        payload["device_telemetry"] = _STATE["partial"]["device_telemetry"]
     # dispatcher overhead = headline round time minus the bare device batch
     payload["latency_breakdown_ms"] = {
         "dispatcher_round": round(head["per_batch_s"] * 1e3, 2),
@@ -1141,7 +1180,12 @@ def main() -> None:
         # restart time-to-serve + WAL write-overhead columns)
         for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS}.items():
             try:
-                _STATE["partial"].setdefault("configs", {})[name] = fn(args)
+                tel_before = devtel_snapshot()
+                res = fn(args)
+                tel = devtel_delta(tel_before)
+                if tel:
+                    res["device_telemetry"] = tel
+                _STATE["partial"].setdefault("configs", {})[name] = res
             except Exception as e:
                 log(f"config {name} failed: {e!r}")
                 _STATE["partial"].setdefault("configs", {})[name] = {

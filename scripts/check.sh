@@ -43,6 +43,12 @@ echo "== crash-recovery smoke (kill -9 mid write-churn, restart, parity)"
 # uninterrupted host-oracle replay (fast, deterministic, no jax import)
 python scripts/crash_smoke.py
 
+echo "== device-telemetry smoke (server scrape: /metrics + /debug/flight)"
+# the device-telemetry metric families (HBM ledger, jit-cache counters,
+# batch occupancy, SLO burn rates) must be present and populated after
+# real proxied traffic; fast, CPU-only, runs even with --fast
+JAX_PLATFORMS=cpu python scripts/devtel_smoke.py
+
 echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"
 JAX_PLATFORMS=cpu python __graft_entry__.py 8
 

@@ -37,11 +37,12 @@ MAX_LINE = 100
 # bounded-cardinality metric label names (M001).  Everything here has a
 # value set bounded by configuration or schema: verbs, status codes,
 # tracing phases, backend schemes, kube resource names, drop reasons,
-# audit stages/decisions, gc generations, WAL record kinds, histogram
-# `le`.
+# audit stages/decisions, gc generations, WAL record kinds, device-
+# telemetry buffer kinds / pow-2 batch buckets / SLO names / burn
+# horizons (utils/devtel.py), histogram `le`.
 ALLOWED_METRIC_LABELS = frozenset((
     "verb", "code", "phase", "backend", "resource", "reason", "stage",
-    "decision", "generation", "kind", "le",
+    "decision", "generation", "kind", "le", "bucket", "slo", "window",
 ))
 _METRIC_FACTORIES = ("counter", "gauge", "histogram")
 # the cardinality contract applies to shipping code; tests/scripts mint

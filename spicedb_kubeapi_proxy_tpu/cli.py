@@ -203,6 +203,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="attach the relation-path witness to every audited "
                         "denial (otherwise only requests with ?explain=1 "
                         "are explained)")
+    # device telemetry: flight recorder + SLO burn rates
+    # (utils/devtel.py, docs/observability.md "Device telemetry")
+    p.add_argument("--flight-window", type=float, default=10.0,
+                   help="seconds per flight-recorder window; each window "
+                        "snapshots phase quantiles, queue depths, the HBM "
+                        "ledger, batch occupancy, and SLO burn rates, "
+                        "served at /debug/flight")
+    p.add_argument("--flight-windows", type=int, default=64,
+                   help="flight-recorder ring capacity (windows retained)")
+    p.add_argument("--slo-check-p99-ms", type=float, default=0.0,
+                   help="latency SLO target in ms: requests slower than "
+                        "this consume the error budget set by "
+                        "--slo-objective; burn rates export as "
+                        "authz_slo_burn_rate{slo=latency_p99} and surface "
+                        "in /readyz when burning (0 disables)")
+    p.add_argument("--slo-objective", type=float, default=0.01,
+                   help="allowed fraction of requests slower than the "
+                        "latency SLO target (the error budget; burn rate "
+                        "1.0 = consuming it exactly at the sustainable "
+                        "rate)")
+    p.add_argument("--slo-error-rate", type=float, default=0.0,
+                   help="error SLO: allowed fraction of 5xx responses "
+                        "(0 disables)")
 
     p.add_argument("-v", "--verbosity", type=int, default=3,
                    help="log verbosity (reference defaults to 3)")
@@ -256,6 +279,17 @@ def validate(args: argparse.Namespace) -> list:
         errs.append(f"--audit-level: {e}")
     if args.audit_sample_every < 1:
         errs.append("--audit-sample-every must be >= 1")
+    if args.flight_window <= 0:
+        errs.append("--flight-window must be > 0")
+    if args.flight_windows < 2:
+        errs.append("--flight-windows must be >= 2 (burn rates need a "
+                    "short and a long horizon)")
+    if args.slo_check_p99_ms < 0:
+        errs.append("--slo-check-p99-ms must be >= 0")
+    if not (0 < args.slo_objective <= 1):
+        errs.append("--slo-objective must be in (0, 1]")
+    if not (0 <= args.slo_error_rate <= 1):
+        errs.append("--slo-error-rate must be in [0, 1]")
     return errs
 
 
@@ -412,6 +446,11 @@ def complete(args: argparse.Namespace,
         data_dir=args.data_dir,
         wal_fsync=args.wal_fsync,
         checkpoint_interval=args.checkpoint_interval,
+        flight_window_s=args.flight_window,
+        flight_windows=args.flight_windows,
+        slo_check_p99_ms=args.slo_check_p99_ms,
+        slo_objective=args.slo_objective,
+        slo_error_rate=args.slo_error_rate,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import devtel
 from .graph_compile import (
     GraphProgram,
     PExclude,
@@ -647,6 +648,9 @@ class EllKernelCache:
             self.stages = annotate_stage_refresh(self.stages, host_main,
                                                  prog.state_size)
         self._jits: dict[int, tuple] = {}
+        # jit-cache accounting: hits/misses/entries per batch bucket,
+        # plus recompile-storm detection (utils/devtel.py)
+        devtel.KERNELS.track(self)
 
     def note_main_aux_ref(self, row: int) -> bool:
         """Incremental growth (_EllGraph._grow) pointed main row `row`
@@ -673,7 +677,9 @@ class EllKernelCache:
     def _fns(self, n_words: int) -> tuple:
         fns = self._jits.get(n_words)
         if fns is not None:
+            devtel.KERNELS.note_jit_hit(n_words * 32)
             return fns
+        devtel.KERNELS.note_compile(n_words * 32)
         evaluate = make_ell_evaluate(self.prog, self.n_aux_rows, n_words,
                                      self.num_iters, planes=self.planes,
                                      aux_passes=self.aux_passes,
@@ -720,7 +726,10 @@ class EllKernelCache:
         modeled HBM traffic).  Jitted separately; same step function."""
         key = ("iters", n_words)
         fn = self._jits.get(key)
-        if fn is None:
+        if fn is not None:
+            devtel.KERNELS.note_jit_hit(n_words * 32)
+        else:
+            devtel.KERNELS.note_compile(n_words * 32)
             step = make_ell_step(self.prog, self.n_aux_rows,
                                  half=n_words if self.planes else None,
                                  aux_passes=self.aux_passes,

@@ -43,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..spicedb import schema as sch
-from ..utils import tracing
+from ..utils import devtel, tracing
 from ..spicedb.endpoints import (
     Bootstrap,
     DEFAULT_BOOTSTRAP_SCHEMA,
@@ -144,6 +144,15 @@ def _object_ids_np(graph, resource_type: str) -> tuple:
         mask = np.fromiter(("\x00" in i for i in lst), dtype=bool,
                            count=len(lst))
         entry = cache[resource_type] = (arr, mask)
+        gen = getattr(graph, "_devtel_gen", 0)
+        if gen:
+            # id-pool views ride the graph generation in the HBM ledger
+            # (host-resident, but generation-scoped exactly like the
+            # device tables — a retained one is the same leak class)
+            devtel.LEDGER.register("id_view",
+                                   int(arr.nbytes) + int(mask.nbytes),
+                                   generation=gen,
+                                   name=f"ids:{resource_type}")
     # the pair escapes the lock with the caller: renames must now
     # copy-on-write instead of patching it in place (see _rename_row)
     graph._ids_np_published.add(resource_type)
@@ -160,8 +169,43 @@ def _evict_id_views(graph) -> None:
         return
     cache = getattr(graph, "_ids_np_cache", None)
     if cache is not None:
+        gen = getattr(graph, "_devtel_gen", 0)
+        if gen:
+            for rt in list(cache):
+                devtel.LEDGER.unregister("id_view", generation=gen,
+                                         name=f"ids:{rt}")
         cache.clear()
         graph._ids_np_published.clear()
+
+
+_DEVTEL_GRAPH_BUFFERS = (
+    ("dev_main", "ell_main"), ("dev_aux", "ell_aux"),
+    ("dev_cav", "ell_cav"), ("edge_src", "segment_edges"),
+    ("edge_dst", "segment_edges"))
+
+
+def _register_graph_buffers(graph, gen: int) -> int:
+    """Register one graph generation's device buffers with the HBM
+    ledger (utils/devtel.py); returns the generation's byte total.
+    Flush swaps same-shape arrays, so sizes registered at build stay
+    exact for the generation's whole lifetime.  A finalizer retires the
+    generation when the graph itself is collected, so an endpoint
+    dropped without a rebuild (bench sweeps, tests) never leaves dead
+    generations inflating the ledger.  The finalizer DEFERS (lock-free
+    deque append): it runs inside whatever gc some allocation triggered,
+    possibly on a thread already holding the ledger lock — retiring
+    inline would self-deadlock."""
+    import weakref
+    total = 0
+    graph._devtel_gen = gen
+    for attr, kind in _DEVTEL_GRAPH_BUFFERS:
+        a = getattr(graph, attr, None)
+        nb = int(getattr(a, "nbytes", 0) or 0)
+        if nb:
+            devtel.LEDGER.register(kind, nb, generation=gen, name=attr)
+            total += nb
+    weakref.finalize(graph, devtel.LEDGER.defer_retire, gen)
+    return total
 
 
 def _word_col_indices(wcol: np.ndarray, bit: int) -> np.ndarray:
@@ -806,6 +850,9 @@ class JaxEndpoint(PermissionsEndpoint):
         # normal kubernetes pod lifecycle) never exhausts the pool
         self._assigned_refs: dict = {}
         self._spare_seq = 0
+        # HBM-ledger graph generation: bumped per rebuild; the outgoing
+        # generation's buffers are retired wholesale (utils/devtel.py)
+        self._devtel_gen = 0
         self.store.add_delta_listener(self._on_delta)
         self.store.add_reset_listener(self._on_reset)
 
@@ -962,6 +1009,20 @@ class JaxEndpoint(PermissionsEndpoint):
         self._graph = graph
         self._graph_revision = snapshot_revision
         self.stats["rebuilds"] += 1
+        # HBM ledger: the new generation registers, the outgoing one
+        # retires wholesale — a leaked old-generation buffer shows up as
+        # a non-returning total within one scrape.  The delta is logged
+        # per rebuild/warm-start so leak forensics need no scrape at all.
+        old_gen = self._devtel_gen
+        self._devtel_gen = devtel.next_generation()
+        added = _register_graph_buffers(graph, self._devtel_gen)
+        freed = devtel.LEDGER.retire_generation(old_gen) if old_gen else 0
+        _log.info("device graph rebuild: generation %d registered %d bytes"
+                  "%s; ledger total %d bytes (peak %d)",
+                  self._devtel_gen, added,
+                  f", generation {old_gen} retired {freed} bytes"
+                  if old_gen else "",
+                  devtel.LEDGER.total(), devtel.LEDGER.peak)
 
     def _reset_expiry_columnar(self, snap, rows, overlay) -> None:
         self._expiry_heap = []
@@ -1362,6 +1423,14 @@ class JaxEndpoint(PermissionsEndpoint):
             if kernel_rows:
                 snap = graph.snapshot()
                 self.stats["kernel_calls"] += 1
+                # batch occupancy, recorded only when a kernel actually
+                # dispatches (an all-oracle batch is not a device batch):
+                # distinct query columns vs the padded pow-2 bucket the
+                # jit cache keys on (utils/devtel.py)
+                used = len(set(cols.values()))
+                devtel.OCCUPANCY.record("check", used, len(q_arr) - used)
+                devtel.LEDGER.note_scratch(
+                    int(q_arr.nbytes) + 8 * len(gather_idx))
         # device execution + host-oracle fallbacks run OUTSIDE the lock:
         # the snapshot is immutable, so concurrent drains/queries proceed
         # instead of queueing behind a hundreds-of-ms kernel hold.  Oracle
@@ -1369,7 +1438,8 @@ class JaxEndpoint(PermissionsEndpoint):
         # than claiming the graph snapshot's.
         if kernel_rows:
             with tracing.kernel_span("kernel.device", kind="check",
-                                     rows=len(kernel_rows)):
+                                     rows=len(kernel_rows),
+                                     bucket=len(q_arr)):
                 out = graph.run_checks3(q_arr, gather_idx, gather_col,
                                         snap=snap)
             for j, row in enumerate(kernel_rows):
@@ -1465,7 +1535,10 @@ class JaxEndpoint(PermissionsEndpoint):
                 return
             cache = getattr(graph, "_ids_np_cache", None)
             if cache is not None:
-                cache.pop(resource_type, None)
+                if cache.pop(resource_type, None) is not None:
+                    devtel.LEDGER.unregister(
+                        "id_view", generation=getattr(graph, "_devtel_gen", 0),
+                        name=f"ids:{resource_type}")
                 graph._ids_np_published.discard(resource_type)
 
     def _lookup_once(self, resource_type: str, permission: str,
@@ -1489,6 +1562,7 @@ class JaxEndpoint(PermissionsEndpoint):
                 if subject in unknown:
                     oracle = True
                 else:
+                    devtel.OCCUPANCY.record("lookup", 1, len(q_arr) - 1)
                     col = cols[subject]
                     snap = graph.snapshot()
                     # id view + phantom index captured under the lock:
@@ -1511,7 +1585,8 @@ class JaxEndpoint(PermissionsEndpoint):
                                                   subject),
                     source="oracle"), 0
         # kernel + extraction outside the lock (immutable snapshot)
-        with tracing.kernel_span("kernel.device", kind="lookup"):
+        with tracing.kernel_span("kernel.device", kind="lookup",
+                                 bucket=len(q_arr)):
             if hasattr(graph, "run_lookup_packed"):
                 packed = graph.run_lookup_packed(rng[0], rng[1], q_arr,
                                                  snap=snap)
@@ -1581,6 +1656,8 @@ class JaxEndpoint(PermissionsEndpoint):
                 all_oracle = True
             else:
                 q_arr, cols, unknown = self._encode_subjects(graph, subjects)
+                used = len(set(cols.values()))
+                devtel.OCCUPANCY.record("lookup", used, len(q_arr) - used)
                 snap = graph.snapshot()
                 # captured under the lock — see _lookup_sync
                 ids, mask = _object_ids_np(graph, resource_type)
@@ -1589,13 +1666,16 @@ class JaxEndpoint(PermissionsEndpoint):
                              self.stats.get("spare_assignments"),
                              id(ids), threading.get_ident())
                 self.stats["kernel_calls"] += 1
+                devtel.LEDGER.note_scratch(
+                    int(q_arr.nbytes)
+                    + rng[1] * max(1, len(q_arr) // 32) * 4)
         ctx = {"rt": resource_type, "perm": permission, "subjects": subjects}
         if all_oracle:
             ctx["all_oracle"] = True
             return ctx
         # kernel dispatch outside the lock (immutable snapshot)
         with tracing.kernel_span("kernel.dispatch", kind="lookup_batch",
-                                 batch=len(subjects)):
+                                 batch=len(subjects), bucket=len(q_arr)):
             if hasattr(graph, "run_lookup_packed"):
                 # packed fast path: per-column shift/AND/nonzero over one
                 # uint32 word column — never materializes the 32x larger
@@ -1629,8 +1709,10 @@ class JaxEndpoint(PermissionsEndpoint):
         if "packed_T" in ctx:
             # the device->host sync point: this blocks until the async
             # D2H started at capture time lands
-            with tracing.kernel_span("kernel.transfer", kind="lookup_batch"):
+            with tracing.kernel_span("kernel.transfer",
+                                     kind="lookup_batch") as a:
                 packed_T = np.ascontiguousarray(ctx["packed_T"])  # [W, L]
+                a["bucket"] = int(packed_T.shape[0]) * 32
 
             def col_indices(col):
                 return _word_col_indices(packed_T[col // 32], col % 32)

@@ -67,8 +67,13 @@ _KV_TRUNCATE = 200  # keep object/body values from flooding the log line
 # health + introspection endpoints are not themselves traced (a scrape
 # of /debug/traces must not evict a real slow trace from the recorder)
 _UNTRACED_PATHS = frozenset(
-    ("/metrics", "/debug/traces", "/debug/decisions", "/readyz", "/livez",
-     "/healthz"))
+    ("/metrics", "/debug", "/readyz", "/livez", "/healthz"))
+
+
+def _untraced(path: str) -> bool:
+    """Every debug surface — including trailing-slash and unknown ones,
+    which still serve index/404 from _serve_debug — stays untraced."""
+    return path in _UNTRACED_PATHS or path.startswith("/debug/")
 
 
 def format_request_kv(req) -> str:
@@ -139,6 +144,18 @@ class Options:
     data_dir: str = ""
     wal_fsync: str = "interval"  # always | interval | never
     checkpoint_interval: float = 300.0
+    # device telemetry & flight recorder (utils/devtel.py,
+    # docs/observability.md "Device telemetry"): bounded ring of
+    # per-window snapshots served at /debug/flight, plus multi-window
+    # SLO burn rates.  slo_check_p99_ms = latency target (0 disables the
+    # latency SLO); slo_objective = allowed fraction of requests slower
+    # than it (the error budget); slo_error_rate = allowed 5xx fraction
+    # (0 disables the error SLO).
+    flight_window_s: float = 10.0
+    flight_windows: int = 64
+    slo_check_p99_ms: float = 0.0
+    slo_objective: float = 0.01
+    slo_error_rate: float = 0.0
 
 
 class ProxyServer:
@@ -204,9 +221,40 @@ class ProxyServer:
             else [HeaderAuthenticator(), ClientCertAuthenticator()])
         self.workflow_client = None  # wired by enable_dual_writes()
         self._worker = None
+        # _build_chain's closures read self.flight at request time, so
+        # the attribute must exist before the chain is built...
+        self.flight = None
         self.handler = self._build_chain()
+        # ...but the recorder is constructed AFTER the chain: building
+        # the chain registers the http/phase histograms the recorder
+        # primes its delta baseline from — constructing it first would
+        # prime against an empty registry and bill any pre-capture
+        # (embedded handler-only) traffic to window 1.  Constructed
+        # eagerly so /debug/flight serves even without start(); the
+        # window task rides start/stop.
+        if opts.enable_metrics:
+            self.flight = self._make_flight_recorder()
         self._http: Optional[HttpServer] = None
         self._lag_probe = None
+
+    def _make_flight_recorder(self):
+        from ..utils import devtel
+        slos = []
+        if self.opts.slo_check_p99_ms > 0:
+            slos.append(devtel.Slo(
+                "latency_p99", "latency",
+                objective=self.opts.slo_objective,
+                threshold_s=self.opts.slo_check_p99_ms / 1e3))
+        if self.opts.slo_error_rate > 0:
+            slos.append(devtel.Slo(
+                "error_rate", "error",
+                objective=self.opts.slo_error_rate))
+        return devtel.FlightRecorder(
+            window_s=self.opts.flight_window_s,
+            capacity=self.opts.flight_windows,
+            slos=slos,
+            stats_fn=lambda: dict(getattr(self.endpoint, "stats", None)
+                                  or {}))
 
     # -- dual-write wiring ---------------------------------------------------
 
@@ -218,6 +266,77 @@ class ProxyServer:
             default_lock_mode=self.opts.lock_mode_default,
             audit=self.audit)
         self.handler = self._build_chain()
+
+    # -- debug surfaces ------------------------------------------------------
+    # All authenticated-only (the caller gates on a resolved user), all
+    # JSON, all error-handled by the one _serve_debug helper: a new
+    # surface registers here instead of growing another per-path branch.
+
+    def _debug_surfaces(self) -> dict:
+        surfaces = {
+            "traces": ("slowest retained request traces with per-phase "
+                       "spans (docs/observability.md)",
+                       self._debug_traces),
+            "decisions": ("recent authorization decisions from the audit "
+                          "ring, newest first", self._debug_decisions),
+            "flight": ("flight recorder: per-window telemetry snapshots "
+                       "(phase quantiles, queue depths, HBM ledger, "
+                       "occupancy) + SLO burn rates", self._debug_flight),
+        }
+        return surfaces
+
+    def _serve_debug(self, req: Request) -> Response:
+        surfaces = self._debug_surfaces()
+        if req.path == "/debug" or req.path == "/debug/":
+            return json_response(200, {
+                "surfaces": {f"/debug/{name}": desc
+                             for name, (desc, _fn) in sorted(
+                                 surfaces.items())}})
+        name = req.path[len("/debug/"):]
+        entry = surfaces.get(name)
+        if entry is None:
+            return json_response(404, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure",
+                "message": f"unknown debug surface {req.path!r}; "
+                           f"GET /debug for the index",
+                "reason": "NotFound", "code": 404})
+        try:
+            return json_response(200, entry[1]())
+        except Exception as e:
+            logger.exception("debug surface %s failed", req.path)
+            return json_response(500, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure",
+                "message": f"debug surface {req.path} failed: {e}",
+                "code": 500})
+
+    def _debug_traces(self) -> dict:
+        return {"capacity": tracing.RECORDER.capacity,
+                "traces": tracing.RECORDER.snapshot()}
+
+    def _debug_decisions(self) -> dict:
+        return {"level": self.audit.level,
+                "ring_capacity": self.audit.ring_capacity,
+                "sample_every": self.audit.sample_every,
+                "decisions": self.audit.recent()}
+
+    def _debug_flight(self) -> dict:
+        from ..utils import devtel
+        if self.flight is None:
+            return {"enabled": False, "windows": []}
+        if not devtel.enabled():
+            # constructed but gated off: the window task never starts,
+            # and the payload must say WHY the ring stays empty
+            return {"enabled": False,
+                    "reason": "DeviceTelemetry feature gate disabled",
+                    "windows": self.flight.snapshots()}
+        return {"enabled": True,
+                "window_s": self.flight.window_s,
+                "capacity": self.flight.capacity,
+                "slos": self.flight.describe_slos(),
+                "burning": self.flight.burning(),
+                "windows": self.flight.snapshots()}
 
     # -- chain ---------------------------------------------------------------
 
@@ -250,26 +369,28 @@ class ProxyServer:
                 resp.headers.set("Content-Type",
                                  "text/plain; version=0.0.4; charset=utf-8")
                 return resp
-            # slow-trace introspection, same trust level as /metrics:
-            # any authenticated principal may read the retained traces
-            if req.path == "/debug/traces":
-                return json_response(200, {
-                    "capacity": tracing.RECORDER.capacity,
-                    "traces": tracing.RECORDER.snapshot()})
-            # decision-audit introspection (same trust level): the ring
-            # buffer of recent decisions, newest first, at the sink's
-            # configured level
-            if req.path == "/debug/decisions":
-                return json_response(200, {
-                    "level": self.audit.level,
-                    "ring_capacity": self.audit.ring_capacity,
-                    "sample_every": self.audit.sample_every,
-                    "decisions": self.audit.recent()})
+            # debug introspection surfaces, same trust level as /metrics:
+            # any authenticated principal may read them (one helper, so
+            # auth and error handling stay uniform across every surface)
+            if req.path == "/debug" or req.path.startswith("/debug/"):
+                return self._serve_debug(req)
             return await authorized(req)
 
         async def with_request_info(req: Request) -> Response:
             if req.path in ("/readyz", "/livez", "/healthz"):
-                return Response(status=200, body=b"ok")
+                body = b"ok"
+                if req.path == "/readyz" and self.flight is not None:
+                    burning = self.flight.burning()
+                    if burning:
+                        # burning SLOs surface in readiness output (the
+                        # status stays 200: budget burn is an alert, not
+                        # an outage — ejecting the pod would make it one)
+                        lines = ["ok"] + [
+                            f"[!] slo {b['slo']} burning: "
+                            f"short={b['short']:.2f} long={b['long']:.2f}"
+                            for b in burning]
+                        body = "\n".join(lines).encode()
+                return Response(status=200, body=body)
             req.context["request_info"] = parse_request_info(req.method,
                                                              req.target)
             return await authenticated(req)
@@ -300,7 +421,7 @@ class ProxyServer:
         async def with_logging(req: Request) -> Response:
             from ..utils.features import GATES
             tr = token = None
-            if req.path not in _UNTRACED_PATHS:
+            if not _untraced(req.path):
                 # trace-id assignment: honor a well-formed caller id so
                 # multi-hop traces correlate; anything else gets a fresh id
                 tr, token = tracing.start_trace(
@@ -331,6 +452,11 @@ class ProxyServer:
                                 **({"user": user.name} if user else {}),
                                 **({"outcome": outcome} if outcome else {}))
                 resp.headers.set(tracing.TRACE_ID_HEADER, tr.trace_id)
+                if self.flight is not None:
+                    # SLO tallies count PROXIED (traced) requests only:
+                    # health probes and introspection scrapes must not
+                    # dilute the error budget
+                    self.flight.observe_request(elapsed, resp.status)
                 if phase_latency is not None:
                     for phase, secs in tr.phase_durations().items():
                         phase_latency.observe(secs, phase=phase)
@@ -432,6 +558,10 @@ class ProxyServer:
             if self._lag_probe is None:
                 self._lag_probe = EventLoopLagProbe()
             await self._lag_probe.start()
+        if self.flight is not None:
+            from ..utils import devtel
+            if devtel.enabled():
+                await self.flight.start()
         return bound
 
     async def stop(self) -> None:
@@ -442,6 +572,8 @@ class ProxyServer:
             await self._worker.stop()
         if self._lag_probe is not None:
             await self._lag_probe.stop()
+        if self.flight is not None:
+            await self.flight.stop()
         if self.persistence is not None:
             # final checkpoint: a clean shutdown restarts from the
             # checkpoint alone, with an empty WAL tail

@@ -27,7 +27,7 @@ import contextlib
 import time
 from typing import Iterable, Optional
 
-from ..utils import tracing
+from ..utils import devtel, tracing
 from .endpoints import PermissionsEndpoint
 from .store import Watcher
 from .types import (
@@ -141,6 +141,10 @@ class BatchingEndpoint(PermissionsEndpoint):
         # committed since the executing batch drained deltas, and a later
         # arrival must observe it — full consistency).
         self._lr_pending: dict = {}
+        # per-pending-key follower counts: how many duplicate callers a
+        # queued leader collapsed, drained into the batch-occupancy
+        # histogram (utils/devtel.py) at pickup
+        self._sf_counts: dict = {}
         self._inflight: list = []      # waiters of the batch being executed
         self._drain_task: Optional[asyncio.Task] = None
         # explain_bypass pre-seeded so InstrumentedEndpoint's one-shot
@@ -234,6 +238,7 @@ class BatchingEndpoint(PermissionsEndpoint):
                 stranded.extend(ws)
             self._lr_queue.clear()
             self._lr_pending.clear()
+            self._sf_counts.clear()
             for w in stranded:
                 if not w[1].done():
                     w[1].set_exception(failure)
@@ -244,10 +249,13 @@ class BatchingEndpoint(PermissionsEndpoint):
         identical queries arriving from now on must start fresh (the
         batch's delta drain happens at pickup, not at their arrival)."""
         resource_type, permission = key
+        collapsed = 0
         for w in waiters:
             k = (resource_type, permission, w[0])
             if self._lr_pending.get(k) is w[1]:
                 del self._lr_pending[k]
+                collapsed += self._sf_counts.pop(k, 0)
+        devtel.OCCUPANCY.note_collapsed(collapsed)
 
     def _enqueue_lookup(self, resource_type: str, permission: str,
                         subject: SubjectRef, tc) -> asyncio.Future:
@@ -265,6 +273,7 @@ class BatchingEndpoint(PermissionsEndpoint):
                 (subject, leader, tc))
         else:
             self._stats["singleflight_hits"] += 1
+            self._sf_counts[k] = self._sf_counts.get(k, 0) + 1
         return _follow(leader, loop)
 
     async def _retry_individually(self, waiters: list, single_call) -> None:

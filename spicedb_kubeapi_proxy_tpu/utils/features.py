@@ -92,3 +92,7 @@ GATES.register("DecisionCache", stage=ALPHA, default=False)
 # crash recovery; engages when --data-dir is set, this gate is the
 # killswitch (disable to run in-memory despite a configured data dir)
 GATES.register("DurableStore", stage=BETA, default=True)
+# device telemetry & flight recorder (utils/devtel.py): HBM ledger,
+# kernel/compile accounting, batch occupancy, SLO burn rates; this gate
+# is the killswitch for recording + the flight-recorder window task
+GATES.register("DeviceTelemetry", stage=BETA, default=True)

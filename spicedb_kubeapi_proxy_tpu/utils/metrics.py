@@ -79,6 +79,12 @@ class Counter(Metric):
         with self._lock:
             return self._values.get(key, 0.0)
 
+    def snapshot(self) -> dict:
+        """Consistent label-key -> value copy (for window-delta readers
+        like the flight recorder, utils/devtel.py)."""
+        with self._lock:
+            return dict(self._values)
+
     def render(self) -> list:
         with self._lock:
             items = sorted(self._values.items())
@@ -144,6 +150,15 @@ class Histogram(Metric):
         with self._lock:
             return self._totals.get(key, 0)
 
+    def raw(self) -> dict:
+        """Consistent label-key -> (bucket counts, sum, total) copy —
+        the flight recorder (utils/devtel.py) diffs two of these to get
+        per-window quantiles from a cumulative histogram."""
+        with self._lock:
+            return {k: (list(v), self._sums.get(k, 0.0),
+                        self._totals.get(k, 0))
+                    for k, v in self._counts.items()}
+
     def render(self) -> list:
         out = []
         with self._lock:
@@ -182,6 +197,10 @@ class Registry:
                 return existing
             self._metrics[metric.name] = metric
             return metric
+
+    def get(self, name: str) -> Optional[Metric]:
+        with self._lock:
+            return self._metrics.get(name)
 
     def counter(self, name: str, help_text: str = "",
                 labels: Iterable[str] = ()) -> Counter:

@@ -238,14 +238,47 @@ def _profiler_annotation(name: str):
     return contextlib.nullcontext()
 
 
+_devtel_note = None  # resolved lazily; False => devtel unavailable
+
+
+def _note_kernel(name: str, attrs: dict, seconds: float) -> None:
+    """Feed per-call device time into the device-telemetry kernel
+    accounting (utils/devtel.py) — lazy-bound so this module keeps no
+    hard intra-package dependency and stays importable standalone."""
+    global _devtel_note
+    if _devtel_note is None:
+        try:
+            from .devtel import note_kernel_span
+            _devtel_note = note_kernel_span
+        except Exception:
+            _devtel_note = False
+    if _devtel_note:
+        try:
+            _devtel_note(name, attrs, seconds)
+        except Exception:
+            pass
+
+
 @contextlib.contextmanager
 def kernel_span(name: str, phase: bool = False, **attrs):
     """Span + `jax.profiler.TraceAnnotation`: when a jax profiler trace
     is active the device timeline carries the proxy's span names, so a
-    TPU profile aligns 1:1 with the request trace."""
-    with span(name, phase=phase, **attrs) as a:
-        with _profiler_annotation(name):
-            yield a
+    TPU profile aligns 1:1 with the request trace.
+
+    Also the device-time attribution point: the block is timed even with
+    no active request trace (the direct bench path) and the duration is
+    recorded into the kernel-accounting histograms
+    (`authz_kernel_time_seconds{phase=,kind=,bucket=}`) keyed by the
+    span's attrs — callers may enrich the yielded attrs dict (e.g. set
+    `bucket`) before the block closes."""
+    a = attrs
+    t0 = time.perf_counter()
+    try:
+        with span(name, phase=phase, **attrs) as a:
+            with _profiler_annotation(name):
+                yield a
+    finally:
+        _note_kernel(name, a, time.perf_counter() - t0)
 
 
 # -- slow-trace retention ----------------------------------------------------

@@ -318,3 +318,121 @@ def test_concurrent_observe_from_threads_is_consistent():
     s = float([line for line in lines
                if line.startswith("conc_h_sum")][0].rsplit(" ", 1)[1])
     assert abs(s - (4000 * 0.25 + 4000 * 0.75)) < 1e-6
+
+
+def test_label_churn_under_concurrent_render_no_torn_lines():
+    """Histogram/gauge label churn from multiple threads while render()
+    runs: every rendered line must be well-formed (never torn), every
+    rendered histogram labelset must be internally consistent
+    (`_bucket{le="+Inf"}` == `_count`), and the final exposition must
+    carry exactly the observations made."""
+    import re
+    import threading
+
+    reg = m.Registry()
+    h = reg.histogram("churn_h", "h", labels=("verb",), buckets=(0.5, 1.0))
+    g = reg.gauge("churn_g", "g", labels=("verb",))
+    c = reg.counter("churn_c", "c", labels=("verb",))
+    n_threads, n_iters = 8, 500
+    stop = threading.Event()
+    renders: list = []
+    errors: list = []
+
+    line_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+="[^"]*"'
+        r'(,[a-zA-Z0-9_]+="[^"]*")*\})? -?[0-9+.eInf]+$')
+
+    def writer(tid):
+        try:
+            for i in range(n_iters):
+                verb = f"verb{tid}_{i % 7}"  # churning label values
+                h.observe(0.25 if i % 2 else 0.75, verb=verb)
+                g.set(float(i), verb=verb)
+                c.inc(verb=verb)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    def reader():
+        while not stop.is_set():
+            renders.append(reg.render())
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    r = threading.Thread(target=reader)
+    r.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    r.join()
+    assert not errors
+    renders.append(reg.render())  # final, quiescent exposition
+
+    for text in renders:
+        counts: dict = {}
+        infs: dict = {}
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert line_re.match(line), f"torn exposition line: {line!r}"
+            name, _, value = line.rpartition(" ")
+            if name.startswith("churn_h_count"):
+                counts[name] = int(value)
+            elif name.startswith("churn_h_bucket") and 'le="+Inf"' in name:
+                infs[name.replace(',le="+Inf"', "").replace(
+                    "churn_h_bucket", "churn_h_count")] = int(value)
+        # +Inf cumulative == _count for every labelset in every render
+        # (each metric renders under its own lock — no torn labelsets)
+        assert infs == counts
+
+    # final totals carry exactly the observations made
+    final = renders[-1]
+    total = sum(int(line.rsplit(" ", 1)[1])
+                for line in final.splitlines()
+                if line.startswith("churn_h_count"))
+    assert total == n_threads * n_iters
+    c_total = sum(int(float(line.rsplit(" ", 1)[1]))
+                  for line in final.splitlines()
+                  if line.startswith("churn_c{"))
+    assert c_total == n_threads * n_iters
+    s_total = sum(float(line.rsplit(" ", 1)[1])
+                  for line in final.splitlines()
+                  if line.startswith("churn_h_sum"))
+    want = n_threads * (n_iters // 2) * (0.25 + 0.75)
+    assert abs(s_total - want) < 1e-6
+
+
+def test_counter_snapshot_and_histogram_raw_consistent_under_threads():
+    """The window-delta reader APIs (Counter.snapshot, Histogram.raw)
+    must return internally consistent copies while writers run: in every
+    raw() result, sum(bucket counts) == total per labelset."""
+    import threading
+
+    h = m.Histogram("raw_h", labels=("verb",), buckets=(0.5,))
+    c = m.Counter("raw_c", labels=("verb",))
+    stop = threading.Event()
+    errors: list = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            h.observe(0.25 if i % 2 else 0.75, verb=f"v{i % 5}")
+            c.inc(verb=f"v{i % 5}")
+            i += 1
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            for key, (counts, _s, total) in h.raw().items():
+                if sum(counts) != total:
+                    errors.append((key, counts, total))
+            snap = c.snapshot()
+            assert all(v >= 0 for v in snap.values())
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, f"torn raw() snapshots: {errors[:3]}"
