@@ -238,6 +238,30 @@ def devtel_delta(before):
     return devtel.diff_snapshot(before, after)
 
 
+def timeline_mark():
+    """Monotonic mark delimiting one config's dispatch-timeline window;
+    None when the package (or jax) is unavailable."""
+    try:
+        from spicedb_kubeapi_proxy_tpu.utils import timeline
+        return timeline.now()
+    except Exception:
+        return None
+
+
+def timeline_summary(mark):
+    """End-of-run dispatch-timeline condensate for one config (overlap
+    ratio, roofline fraction, stall-cause breakdown, worst-dispatch
+    exemplar — utils/timeline.py): the numbers ROADMAP item 1's
+    double-buffering work is judged by, riding every BENCH artifact."""
+    try:
+        from spicedb_kubeapi_proxy_tpu.utils import timeline
+        if not timeline.enabled():
+            return None
+        return timeline.summary(since=mark)
+    except Exception:
+        return None
+
+
 def build_endpoint(workload, kind: str):
     from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
     from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
@@ -976,10 +1000,14 @@ def main() -> None:
         # standalone decision-cache config: its own headline metric
         stage(f"cache config {args.config}")
         tel_before = devtel_snapshot()
+        tl_mark = timeline_mark()
         res = CACHE_CONFIGS[args.config](args)
         tel = devtel_delta(tel_before)
         if tel:
             res["device_telemetry"] = tel
+        tl_sum = timeline_summary(tl_mark)
+        if tl_sum:
+            res["timeline_summary"] = tl_sum
         value = (res.get("cache_on_checks_per_s")
                  or res.get("lists_per_s", 0.0))
         _STATE["metric"] = f"decision-cache {args.config}"
@@ -994,10 +1022,14 @@ def main() -> None:
         # standalone durable-store config: time-to-serve after restart
         stage(f"persist config {args.config}")
         tel_before = devtel_snapshot()
+        tl_mark = timeline_mark()
         res = PERSIST_CONFIGS[args.config](args)
         tel = devtel_delta(tel_before)
         if tel:
             res["device_telemetry"] = tel
+        tl_sum = timeline_summary(tl_mark)
+        if tl_sum:
+            res["timeline_summary"] = tl_sum
         _STATE["metric"] = f"durable-store {args.config}"
         emit({"metric": _STATE["metric"],
               "value": res.get("time_to_serve_s", 0.0), "unit": "s",
@@ -1019,6 +1051,7 @@ def main() -> None:
     def run_one(name, with_oracle=True, rounds=None):
         workload = load_workload(name)
         tel_before = devtel_snapshot()
+        tl_mark = timeline_mark()
         r = rounds if rounds is not None else args.rounds
         if args.direct_only:
             head = bench_jax(workload, args.batch, r)
@@ -1039,6 +1072,11 @@ def main() -> None:
         # kernel time), so BENCH_r*.json carries device numbers
         # alongside throughput
         tel = devtel_delta(tel_before)
+        tl_sum = timeline_summary(tl_mark)
+        if tl_sum:
+            log(f"{name} timeline: overlap={tl_sum.get('overlap_ratio')} "
+                f"roofline={tl_sum.get('roofline_fraction')} "
+                f"stalls_s={tl_sum.get('stall_s')}")
         if name == args.config:
             # watchdog partials must only ever carry the headline config's
             # numbers — a sweep config's value under the headline metric
@@ -1048,6 +1086,7 @@ def main() -> None:
                 "p99_list_filter_ms": round(head["p99_s"] * 1000, 2),
                 "direct_batch_checks_per_s": round(direct["checks_per_s"], 1),
                 **({"device_telemetry": tel} if tel else {}),
+                **({"timeline_summary": tl_sum} if tl_sum else {}),
             })
         else:
             # sweep numbers land in the artifact too (VERDICT r3 item 3)
@@ -1057,6 +1096,7 @@ def main() -> None:
                 "direct_checks_per_s": round(direct["checks_per_s"], 1),
                 "objects": head["objects"],
                 **({"device_telemetry": tel} if tel else {}),
+                **({"timeline_summary": tl_sum} if tl_sum else {}),
             }
         oracle_res = None
         if with_oracle:
@@ -1090,6 +1130,10 @@ def main() -> None:
     }
     if _STATE["partial"].get("device_telemetry"):
         payload["device_telemetry"] = _STATE["partial"]["device_telemetry"]
+    if _STATE["partial"].get("timeline_summary"):
+        # headline dispatch-timeline condensate: overlap fraction,
+        # modeled roofline fraction, stall breakdown, worst dispatch
+        payload["timeline_summary"] = _STATE["partial"]["timeline_summary"]
     # dispatcher overhead = headline round time minus the bare device batch
     payload["latency_breakdown_ms"] = {
         "dispatcher_round": round(head["per_batch_s"] * 1e3, 2),
@@ -1181,10 +1225,14 @@ def main() -> None:
         for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS}.items():
             try:
                 tel_before = devtel_snapshot()
+                tl_mark = timeline_mark()
                 res = fn(args)
                 tel = devtel_delta(tel_before)
                 if tel:
                     res["device_telemetry"] = tel
+                tl_sum = timeline_summary(tl_mark)
+                if tl_sum:
+                    res["timeline_summary"] = tl_sum
                 _STATE["partial"].setdefault("configs", {})[name] = res
             except Exception as e:
                 log(f"config {name} failed: {e!r}")

@@ -17,7 +17,9 @@ echo "== lint gate (scripts/lint.py; CI additionally runs ruff)"
 # the default paths cover the whole package tree — including the tracing
 # module (spicedb_kubeapi_proxy_tpu/utils/tracing.py) — and enforce the
 # metrics-cardinality allowlist (M001: identities live in audit events,
-# never in metric labels)
+# never in metric labels) plus the docs-vs-registry metric drift gate
+# (M002: every authz_* family in code is documented in
+# docs/observability.md and vice versa)
 python scripts/lint.py
 
 if [[ "${1:-}" != "--fast" ]]; then
@@ -43,10 +45,12 @@ echo "== crash-recovery smoke (kill -9 mid write-churn, restart, parity)"
 # uninterrupted host-oracle replay (fast, deterministic, no jax import)
 python scripts/crash_smoke.py
 
-echo "== device-telemetry smoke (server scrape: /metrics + /debug/flight)"
+echo "== device-telemetry smoke (/metrics + /debug/flight + /debug/timeline)"
 # the device-telemetry metric families (HBM ledger, jit-cache counters,
-# batch occupancy, SLO burn rates) must be present and populated after
-# real proxied traffic; fast, CPU-only, runs even with --fast
+# batch occupancy, SLO burn rates, dispatch-timeline stall/roofline/
+# overlap) must be present and populated after real proxied traffic,
+# and /debug/timeline must serve valid chrome-trace JSON with >= 1
+# dispatch slice; fast, CPU-only, runs even with --fast
 JAX_PLATFORMS=cpu python scripts/devtel_smoke.py
 
 echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"

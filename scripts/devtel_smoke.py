@@ -7,6 +7,8 @@ What it proves end to end:
 - `/metrics` carries the device-telemetry families (`authz_device_bytes`,
   `authz_batch_occupancy`, `authz_jit_cache_*`, `authz_slo_burn_rate`);
 - `/debug/flight` returns >= 2 windows of snapshots after a warm-up;
+- `/debug/timeline` serves valid chrome-trace JSON (every event has
+  ph/ts/pid/tid, B/E pairing balanced) with >= 1 dispatch slice;
 - the `/debug` index enumerates every debug surface uniformly.
 """
 
@@ -74,7 +76,50 @@ REQUIRED_FAMILIES = (
     "authz_jit_cache_entries",
     "authz_slo_burn_rate",
     "authz_kernel_time_seconds",
+    # dispatch timeline (utils/timeline.py)
+    "authz_dispatch_stall_seconds",
+    "authz_dispatch_bandwidth_bytes_per_sec",
+    "authz_roofline_fraction",
+    "authz_dispatch_overlap_ratio",
 )
+
+# stages that prove a real device dispatch landed on the timeline
+DISPATCH_SLICES = ("kernel", "transfer", "transpose", "pack")
+
+
+def validate_chrome_trace(trace: dict) -> list:
+    """Chrome trace-event schema check: every event needs ph/ts/pid/tid
+    (X additionally dur), and B/E pairs must balance per (pid, tid).
+    Returns the dispatch-stage slices.  tests/test_timeline.py keeps an
+    independent copy BY HAND (this script's module level sets env vars
+    and imports jax — importing it from the test suite would drag those
+    side effects in); schema changes must land in both."""
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        fail(f"/debug/timeline has no traceEvents list: {list(trace)}")
+    depth: dict = {}
+    slices = []
+    for ev in events:
+        for field in ("ph", "ts", "pid", "tid"):
+            if field not in ev:
+                fail(f"timeline event missing {field!r}: {ev}")
+        if ev["ph"] == "X":
+            if "dur" not in ev:
+                fail(f"X event missing dur: {ev}")
+            if ev["name"] in DISPATCH_SLICES:
+                slices.append(ev)
+        elif ev["ph"] == "B":
+            depth[(ev["pid"], ev["tid"])] = (
+                depth.get((ev["pid"], ev["tid"]), 0) + 1)
+        elif ev["ph"] == "E":
+            key = (ev["pid"], ev["tid"])
+            depth[key] = depth.get(key, 0) - 1
+            if depth[key] < 0:
+                fail(f"unbalanced E event (no open B) on {key}")
+    open_tracks = {k: v for k, v in depth.items() if v}
+    if open_tracks:
+        fail(f"unbalanced B/E pairs at end of trace: {open_tracks}")
+    return slices
 
 
 def fail(msg: str) -> None:
@@ -142,11 +187,29 @@ async def main() -> None:
             fail("flight window reports an empty HBM ledger after "
                  "kernel traffic")
 
+        resp = await alice.get("/debug/timeline")
+        if resp.status != 200:
+            fail(f"/debug/timeline -> {resp.status}")
+        trace = json.loads(resp.body)
+        slices = validate_chrome_trace(trace)
+        if not slices:
+            fail(f"/debug/timeline has no dispatch slices "
+                 f"({len(trace.get('traceEvents', []))} events, none named "
+                 f"{DISPATCH_SLICES})")
+        summary = trace.get("otherData", {}).get("summary", {})
+        if not summary.get("events"):
+            fail(f"/debug/timeline summary is empty: {summary}")
+        win = flight["windows"][0]
+        if "timeline" not in win or "slow_traces" not in win:
+            fail(f"flight window missing timeline/slow_traces evidence "
+                 f"links: {sorted(win)}")
+
         resp = await alice.get("/debug")
         if resp.status != 200:
             fail(f"/debug -> {resp.status}")
         surfaces = json.loads(resp.body).get("surfaces", {})
-        for path in ("/debug/traces", "/debug/decisions", "/debug/flight"):
+        for path in ("/debug/traces", "/debug/decisions", "/debug/flight",
+                     "/debug/timeline"):
             if path not in surfaces:
                 fail(f"/debug index missing {path}: {surfaces}")
         resp = await alice.get("/debug/nonesuch")
@@ -158,7 +221,8 @@ async def main() -> None:
     finally:
         await server.stop()
     print("devtel_smoke: OK (device-telemetry families present, "
-          f"{len(flight['windows'])} flight windows)")
+          f"{len(flight['windows'])} flight windows, "
+          f"{len(slices)} timeline dispatch slices)")
 
 
 if __name__ == "__main__":

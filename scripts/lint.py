@@ -19,6 +19,14 @@ Checks:
         unbounded time-series explosion and an identity leak in every
         scrape.  Extend ALLOWED_METRIC_LABELS only with label names
         whose value set is bounded by config/schema, not by traffic.
+  M002  docs-vs-registry metric drift (default-path runs only): every
+        `authz_*` metric family registered in package code must appear
+        in docs/observability.md, and every `authz_*` family the doc
+        names must exist in code — a metric that ships undocumented is
+        invisible to operators, and a documented one that was renamed
+        away is a dashboard silently reading zeros.  Dynamically named
+        families (`authz_backend_<stat>_total`, scrape-time stats
+        gauges) are exempt by prefix.
 
 (E712 `== True` is deliberately NOT enforced: the codebase compares
 numpy bools where `is True` would silently change semantics.)
@@ -43,11 +51,19 @@ MAX_LINE = 100
 ALLOWED_METRIC_LABELS = frozenset((
     "verb", "code", "phase", "backend", "resource", "reason", "stage",
     "decision", "generation", "kind", "le", "bucket", "slo", "window",
+    "cause",
 ))
 _METRIC_FACTORIES = ("counter", "gauge", "histogram")
 # the cardinality contract applies to shipping code; tests/scripts mint
 # throwaway registries with synthetic labels
 _M001_PREFIX = "spicedb_kubeapi_proxy_tpu"
+
+# M002 docs-vs-registry drift: the one place the metric catalog lives
+_METRICS_DOC = Path("docs/observability.md")
+# families whose NAMES are minted at runtime (scrape-time stats gauges)
+# — the AST scan cannot see them and the doc documents them as a
+# pattern, so both directions exempt anything under these prefixes
+_DYNAMIC_METRIC_PREFIXES = ("authz_backend",)
 
 
 def iter_py(paths):
@@ -60,12 +76,15 @@ def iter_py(paths):
 
 
 class Visitor(ast.NodeVisitor):
-    def __init__(self, findings, path):
+    def __init__(self, findings, path, metric_families=None):
         self.findings = findings
         self.path = path
         self.imports: dict = {}   # name -> (lineno, import stmt text)
         self.used: set = set()
         self.toplevel_defs: dict = {}
+        # authz_* family names registered by package code (M002 input);
+        # None when the caller is not collecting
+        self.metric_families = metric_families
 
     def visit_Import(self, node):
         for a in node.names:
@@ -136,6 +155,13 @@ class Visitor(ast.NodeVisitor):
         if not (isinstance(fn, ast.Attribute)
                 and fn.attr in _METRIC_FACTORIES):
             return
+        # M002 side channel: record the family name (literal first arg)
+        if (self.metric_families is not None and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value.startswith("authz_")):
+            self.metric_families[node.args[0].value] = (
+                self.path, node.lineno)
         label_values = [kw.value for kw in node.keywords
                         if kw.arg == "labels"]
         # labels is also the third positional parameter of
@@ -166,14 +192,14 @@ class Visitor(ast.NodeVisitor):
                          f"metric labels)"))
 
 
-def lint_file(path, findings):
+def lint_file(path, findings, metric_families=None):
     text = path.read_text()
     try:
         tree = ast.parse(text, filename=str(path))
     except SyntaxError as e:
         findings.append((path, e.lineno or 0, "E999", f"syntax error: {e}"))
         return
-    v = Visitor(findings, path)
+    v = Visitor(findings, path, metric_families=metric_families)
     v.visit(tree)
 
     # unused imports: names imported at module scope and never loaded
@@ -210,13 +236,61 @@ def lint_file(path, findings):
             findings.append((path, i, "TAB", "hard tab in indentation"))
 
 
+def _is_dynamic_family(name):
+    return any(name == p or name.startswith(p + "_")
+               for p in _DYNAMIC_METRIC_PREFIXES)
+
+
+def check_metric_drift(metric_families, findings):
+    """M002: the docs/observability.md metric catalog and the families
+    package code actually registers must agree, both directions."""
+    if not _METRICS_DOC.exists():
+        findings.append((_METRICS_DOC, 0, "M002",
+                         "metrics doc missing (docs/observability.md)"))
+        return
+    import re
+    text = _METRICS_DOC.read_text()
+    doc_names: dict = {}  # name -> first line number
+    for i, line in enumerate(text.splitlines(), 1):
+        for match in re.finditer(r"authz_[a-z0-9][a-z0-9_]*", line):
+            doc_names.setdefault(match.group(0).rstrip("_"), i)
+    for name, (path, lineno) in sorted(metric_families.items()):
+        if _is_dynamic_family(name):
+            continue
+        if name not in doc_names:
+            findings.append((path, lineno, "M002",
+                             f"metric family {name!r} is registered here "
+                             f"but absent from {_METRICS_DOC} — document "
+                             f"it (operators cannot use what the catalog "
+                             f"does not name)"))
+    code_names = set(metric_families)
+    for name, lineno in sorted(doc_names.items()):
+        if _is_dynamic_family(name):
+            continue
+        # histogram exposition suffixes in doc prose refer to a real
+        # family (authz_foo_seconds_bucket -> authz_foo_seconds)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if name not in code_names and base not in code_names:
+            findings.append((_METRICS_DOC, lineno, "M002",
+                             f"doc names metric family {name!r} but no "
+                             f"package code registers it — a renamed or "
+                             f"removed metric leaves dashboards reading "
+                             f"zeros"))
+
+
 def main():
     paths = sys.argv[1:] or DEFAULT_PATHS
+    default_run = not sys.argv[1:]
     findings: list = []
+    metric_families: dict = {}
     n = 0
     for f in iter_py(paths):
         n += 1
-        lint_file(f, findings)
+        lint_file(f, findings, metric_families=metric_families)
+    # M002 needs the FULL package scan to know every registered family;
+    # partial-path invocations (pre-commit on one file) skip it
+    if default_run:
+        check_metric_drift(metric_families, findings)
     for path, lineno, code, msg in sorted(findings,
                                           key=lambda x: (str(x[0]), x[1])):
         print(f"{path}:{lineno}: {code} {msg}")

@@ -24,7 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from spicedb_kubeapi_proxy_tpu.models import workloads as wl
 from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap, create_endpoint
-from spicedb_kubeapi_proxy_tpu.utils import tracing
+from spicedb_kubeapi_proxy_tpu.utils import timeline, tracing
 from spicedb_kubeapi_proxy_tpu.spicedb.types import (
     CheckRequest,
     ObjectRef,
@@ -132,6 +132,7 @@ def main():
     async def reporter():
         start = time.time()
         last = start
+        window_mark = timeline.now()
         while not stop.is_set():
             await asyncio.sleep(5)
             now = time.time()
@@ -139,6 +140,12 @@ def main():
                 lat = sorted(lookup_lat)
                 lookup_lat.clear()
                 last = now
+                # per-window dispatch-timeline condensate: overlap
+                # fraction, roofline fraction, stall-cause breakdown,
+                # worst dispatch — a p99 spike window names its stall
+                # (rebuild vs transfer vs compile) from the soak output
+                tl_sum = timeline.summary(since=window_mark)
+                window_mark = timeline.now()
                 st = dict(inner.stats)
                 windows.append({
                     "t_s": round(now - start, 1),
@@ -157,6 +164,7 @@ def main():
                     # a p99 spike names its own phase (queue vs kernel
                     # vs extraction) instead of needing a re-run
                     "slow_traces": tracing.RECORDER.drain()[:3],
+                    "timeline": tl_sum,
                 })
                 print(f"window {len(windows)}: {windows[-1]}", flush=True)
 
@@ -181,6 +189,9 @@ def main():
         "min_spare_pool_free": min_pool,
         "counters": counters,
         "rss_mb_final": round(rss_mb(), 1),
+        # whole-run dispatch-timeline condensate (ring-bounded: covers
+        # the most recent events; per-window views live in windows[])
+        "timeline_summary": timeline.summary(),
         "verdict": {
             "rebuilds_after_warmup": (st.get("rebuilds", 0)
                                       - (warmup_rebuilds or 0)),

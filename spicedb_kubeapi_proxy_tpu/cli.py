@@ -226,6 +226,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slo-error-rate", type=float, default=0.0,
                    help="error SLO: allowed fraction of 5xx responses "
                         "(0 disables)")
+    # dispatch timeline profiler (utils/timeline.py,
+    # docs/observability.md "Dispatch timeline")
+    p.add_argument("--device-hbm-peak-gbps", type=float, default=0.0,
+                   help="device HBM peak bandwidth in GB/s for the "
+                        "authz_roofline_fraction export and the "
+                        "/debug/timeline summary; 0 (default) "
+                        "auto-detects from the jax platform "
+                        "(tpu/v5e -> 819)")
 
     p.add_argument("-v", "--verbosity", type=int, default=3,
                    help="log verbosity (reference defaults to 3)")
@@ -290,6 +298,8 @@ def validate(args: argparse.Namespace) -> list:
         errs.append("--slo-objective must be in (0, 1]")
     if not (0 <= args.slo_error_rate <= 1):
         errs.append("--slo-error-rate must be in [0, 1]")
+    if args.device_hbm_peak_gbps < 0:
+        errs.append("--device-hbm-peak-gbps must be >= 0 (0 = auto)")
     return errs
 
 
@@ -451,6 +461,7 @@ def complete(args: argparse.Namespace,
         slo_check_p99_ms=args.slo_check_p99_ms,
         slo_objective=args.slo_objective,
         slo_error_rate=args.slo_error_rate,
+        device_hbm_peak_gbps=args.device_hbm_peak_gbps,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import devtel
+from ..utils import devtel, timeline
 from .graph_compile import (
     GraphProgram,
     PExclude,
@@ -714,8 +714,18 @@ class EllKernelCache:
                 return jax.lax.dynamic_slice_in_dim(
                     x, slot_offset, slot_length, axis=0)       # [L, W] uint32
 
-        fns = (jax.jit(run_checks),
-               jax.jit(run_lookup, static_argnums=(0, 1)))
+        # XLA compiles lazily inside the first execution; the
+        # first-call-per-compile-key wrapper records each such window
+        # as a `compile` slice on the dispatch timeline (stall cause
+        # the flight recorder links p99 spikes to).  run_lookup's
+        # static (slot_offset, slot_length) pair IS part of the jit
+        # cache key — every new (type, permission) slot range
+        # recompiles, so static_args=2 attributes those too.
+        fns = (timeline.time_first_call(jax.jit(run_checks),
+                                        bucket=n_words * 32),
+               timeline.time_first_call(
+                   jax.jit(run_lookup, static_argnums=(0, 1)),
+                   bucket=n_words * 32, static_args=2))
         self._jits[n_words] = fns
         return fns
 
@@ -753,7 +763,8 @@ class EllKernelCache:
                     cond, body, (x0, jnp.bool_(True), jnp.int32(0)))
                 return i
 
-            fn = jax.jit(run)
+            fn = timeline.time_first_call(jax.jit(run),
+                                          bucket=n_words * 32)
             self._jits[key] = fn
         if self.planes:
             return int(fn(jnp.asarray(q_idx), idx_main, idx_aux, idx_cav))

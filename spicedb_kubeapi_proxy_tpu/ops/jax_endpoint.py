@@ -43,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..spicedb import schema as sch
-from ..utils import devtel, tracing
+from ..utils import devtel, timeline, tracing
 from ..spicedb.endpoints import (
     Bootstrap,
     DEFAULT_BOOTSTRAP_SCHEMA,
@@ -206,6 +206,38 @@ def _register_graph_buffers(graph, gen: int) -> int:
             total += nb
     weakref.finalize(graph, devtel.LEDGER.defer_retire, gen)
     return total
+
+
+def _sweep_bytes(graph, lanes: int) -> int:
+    """Modeled HBM bytes for ONE fixpoint sweep of `graph` at `lanes`
+    query lanes — the dispatch timeline's kernel-stage byte tag (feeds
+    `authz_roofline_fraction`).  Counts each gather slot's packed-state
+    read plus one state write per row, scaled by the batch width; the
+    same accounting as bench.py's roofline model but WITHOUT the
+    iteration count (not host-visible per call), so the resulting
+    bandwidth is a strict lower bound on true traffic.  The static row
+    factor is cached on the graph (shapes are fixed per generation)."""
+    cached = getattr(graph, "_timeline_sweep", None)
+    if cached is None:
+        if hasattr(graph, "dev_main"):
+            n, km = graph.dev_main.shape
+            a_rows, ka = graph.dev_aux.shape
+            ap = getattr(graph.kernel, "aux_passes", 1)
+            rows = n * (km + 1) + ap * a_rows * (ka + 1)
+            if getattr(graph, "dev_cav", None) is not None:
+                rows += (n + a_rows) * (graph.dev_cav.shape[1] + 1)
+            cached = (rows, True)   # packed: 4 bytes per 32 lanes
+        elif hasattr(graph, "edge_src"):
+            # segment kernel: one gather read + segment write per edge
+            cached = (int(graph.edge_src.shape[0]) * 2, False)
+        else:
+            cached = (0, True)      # sharded path: no host-side model
+        graph._timeline_sweep = cached
+    rows, packed = cached
+    width = max(1, lanes // 32) * 4 if packed else lanes * 4
+    if packed and getattr(graph, "has_cav", False):
+        width *= 2  # definite + maybe bitplanes
+    return rows * width
 
 
 def _word_col_indices(wcol: np.ndarray, bit: int) -> np.ndarray:
@@ -883,7 +915,7 @@ class JaxEndpoint(PermissionsEndpoint):
         lazily on the first query — the warm-graph-start step of crash
         recovery (spicedb/persist): a recovered 1M-tuple store pays its
         compile before the server starts accepting traffic."""
-        with self._lock:
+        with timeline.span("warm_start", "rebuild"), self._lock:
             self._apply_pending()
 
     # -- delta intake -------------------------------------------------------
@@ -947,6 +979,7 @@ class JaxEndpoint(PermissionsEndpoint):
         # capture hold the STORE lock together so checked_at can never
         # name a revision other than the one the graph reflects (checks
         # run off-loop now, so writes race the rebuild).
+        t_rebuild = timeline.now()
         self._drain_pending()
         self._graph_invalid = False
         _evict_id_views(self._graph)
@@ -1017,6 +1050,11 @@ class JaxEndpoint(PermissionsEndpoint):
         self._devtel_gen = devtel.next_generation()
         added = _register_graph_buffers(graph, self._devtel_gen)
         freed = devtel.LEDGER.retire_generation(old_gen) if old_gen else 0
+        # timeline: the rebuild span is the stall window the flight
+        # recorder's p99 spikes point at (ROADMAP item 4); bytes = the
+        # new generation's registered device footprint
+        timeline.record("rebuild", "rebuild", t_rebuild, nbytes=added,
+                        generation=self._devtel_gen)
         _log.info("device graph rebuild: generation %d registered %d bytes"
                   "%s; ledger total %d bytes (peak %d)",
                   self._devtel_gen, added,
@@ -1195,6 +1233,10 @@ class JaxEndpoint(PermissionsEndpoint):
                                 <= self.store.now()):
             return
 
+        # timeline "compact": incremental delta application + device
+        # row flush under the endpoint lock (the rebuild-free churn
+        # absorption path); a rebuild taken below records its own span
+        t_compact = timeline.now()
         needs_rebuild = False
         applied_revision = self._graph_revision
         cav_deltas = getattr(graph, "supports_cav_deltas", False)
@@ -1318,6 +1360,8 @@ class JaxEndpoint(PermissionsEndpoint):
             graph.stage_aux_flips = 0
         if graph.flush():
             self.stats["delta_batches"] += 1
+        timeline.record("compact", "rebuild", t_compact,
+                        batches=len(batches))
 
     def _current_graph(self):
         self._apply_pending()
@@ -1367,6 +1411,7 @@ class JaxEndpoint(PermissionsEndpoint):
                  2: Permissionship.HAS_PERMISSION}
 
     def _check_batch_sync(self, reqs: list) -> list:
+        bid = timeline.next_batch()
         with tracing.span("kernel.prepare", kind="check", batch=len(reqs)), \
                 self._lock:
             # checked_at = the revision the drained graph actually
@@ -1376,6 +1421,10 @@ class JaxEndpoint(PermissionsEndpoint):
             # results to a revision the kernel never evaluated
             graph = self._current_graph()
             rev = self._graph_revision
+            # timeline "pack": host query encoding + gather-list build
+            # (starts AFTER the delta drain so rebuild/compact time is
+            # never misattributed to packing)
+            t_pack = timeline.now()
             q_arr, cols, unknown = self._encode_subjects(
                 graph, [r.subject for r in reqs])
             gather_idx: list[int] = []
@@ -1420,6 +1469,8 @@ class JaxEndpoint(PermissionsEndpoint):
                 gather_idx.append(state_idx)
                 gather_col.append(cols[r.subject])
                 kernel_rows.append(i)
+            timeline.record("pack", "host", t_pack, batch=bid,
+                            bucket=len(q_arr), nbytes=int(q_arr.nbytes))
             if kernel_rows:
                 snap = graph.snapshot()
                 self.stats["kernel_calls"] += 1
@@ -1439,7 +1490,12 @@ class JaxEndpoint(PermissionsEndpoint):
         if kernel_rows:
             with tracing.kernel_span("kernel.device", kind="check",
                                      rows=len(kernel_rows),
-                                     bucket=len(q_arr)):
+                                     bucket=len(q_arr)) as a:
+                # timeline tags: fused-batch id + modeled one-sweep
+                # bytes (the roofline lower bound) ride the span attrs
+                # into the device track
+                a["batch_id"] = bid
+                a["nbytes"] = _sweep_bytes(graph, len(q_arr))
                 out = graph.run_checks3(q_arr, gather_idx, gather_col,
                                         snap=snap)
             for j, row in enumerate(kernel_rows):
@@ -1545,6 +1601,7 @@ class JaxEndpoint(PermissionsEndpoint):
                      subject: SubjectRef, retry: bool = False) -> tuple:
         self.schema.definition(resource_type)  # raises like the oracle
         oracle = False
+        bid = timeline.next_batch()
         with self._lock:
             graph = self._current_graph()
             if ((resource_type, permission) in self._caveat_affected
@@ -1558,7 +1615,11 @@ class JaxEndpoint(PermissionsEndpoint):
                                                permission)) is None:
                 oracle = True
             else:
+                t_pack = timeline.now()
                 q_arr, cols, unknown = self._encode_subjects(graph, [subject])
+                timeline.record("pack", "host", t_pack, batch=bid,
+                                bucket=len(q_arr),
+                                nbytes=int(q_arr.nbytes))
                 if subject in unknown:
                     oracle = True
                 else:
@@ -1586,7 +1647,9 @@ class JaxEndpoint(PermissionsEndpoint):
                     source="oracle"), 0
         # kernel + extraction outside the lock (immutable snapshot)
         with tracing.kernel_span("kernel.device", kind="lookup",
-                                 bucket=len(q_arr)):
+                                 bucket=len(q_arr)) as a:
+            a["batch_id"] = bid
+            a["nbytes"] = _sweep_bytes(graph, len(q_arr))
             if hasattr(graph, "run_lookup_packed"):
                 packed = graph.run_lookup_packed(rng[0], rng[1], q_arr,
                                                  snap=snap)
@@ -1595,7 +1658,9 @@ class JaxEndpoint(PermissionsEndpoint):
             else:
                 bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
                 idx = np.nonzero(bitmap[:, col])[0]
+        t_ext = timeline.now()
         out, bad_n, bad_sample = _ids_for(ids, idx, ph, mask)
+        timeline.record("extract", "host", t_ext, batch=bid)
         if bad_n:
             self._report_suppressed(bad_n, bad_sample, _forensic, retry=retry)
         return AnnotatedIds(out, source="kernel"), bad_n
@@ -1646,6 +1711,7 @@ class JaxEndpoint(PermissionsEndpoint):
         double-buffer drain, spicedb/dispatch.py)."""
         self.schema.definition(resource_type)
         all_oracle = False
+        bid = timeline.next_batch()
         with self._lock:
             graph = self._current_graph()
             if ((resource_type, permission) in self._caveat_affected
@@ -1655,7 +1721,11 @@ class JaxEndpoint(PermissionsEndpoint):
                                                permission)) is None:
                 all_oracle = True
             else:
+                t_pack = timeline.now()
                 q_arr, cols, unknown = self._encode_subjects(graph, subjects)
+                timeline.record("pack", "host", t_pack, batch=bid,
+                                bucket=len(q_arr),
+                                nbytes=int(q_arr.nbytes))
                 used = len(set(cols.values()))
                 devtel.OCCUPANCY.record("lookup", used, len(q_arr) - used)
                 snap = graph.snapshot()
@@ -1669,13 +1739,16 @@ class JaxEndpoint(PermissionsEndpoint):
                 devtel.LEDGER.note_scratch(
                     int(q_arr.nbytes)
                     + rng[1] * max(1, len(q_arr) // 32) * 4)
-        ctx = {"rt": resource_type, "perm": permission, "subjects": subjects}
+        ctx = {"rt": resource_type, "perm": permission, "subjects": subjects,
+               "batch_id": bid}
         if all_oracle:
             ctx["all_oracle"] = True
             return ctx
         # kernel dispatch outside the lock (immutable snapshot)
         with tracing.kernel_span("kernel.dispatch", kind="lookup_batch",
-                                 batch=len(subjects), bucket=len(q_arr)):
+                                 batch=len(subjects), bucket=len(q_arr)) as a:
+            a["batch_id"] = bid
+            a["nbytes"] = _sweep_bytes(graph, len(q_arr))
             if hasattr(graph, "run_lookup_packed"):
                 # packed fast path: per-column shift/AND/nonzero over one
                 # uint32 word column — never materializes the 32x larger
@@ -1711,8 +1784,16 @@ class JaxEndpoint(PermissionsEndpoint):
             # D2H started at capture time lands
             with tracing.kernel_span("kernel.transfer",
                                      kind="lookup_batch") as a:
+                a["batch_id"] = ctx.get("batch_id")
+                if not hasattr(ctx["packed_T"], "copy_to_host_async"):
+                    # the pending result is already a host array (the
+                    # packed kernels sync at capture): the block here is
+                    # the word-transpose copy, not a device transfer —
+                    # tell the timeline so stall attribution stays honest
+                    a["timeline_stage"] = "transpose"
                 packed_T = np.ascontiguousarray(ctx["packed_T"])  # [W, L]
                 a["bucket"] = int(packed_T.shape[0]) * 32
+                a["nbytes"] = int(packed_T.nbytes)
 
             def col_indices(col):
                 return _word_col_indices(packed_T[col // 32], col % 32)
@@ -1727,6 +1808,7 @@ class JaxEndpoint(PermissionsEndpoint):
         per_col_ids: dict = {}  # column -> id list (columns are shared)
         out = []
         total_bad = 0
+        t_ext = timeline.now()
         with tracing.span("kernel.extract", kind="lookup_batch",
                           batch=len(ctx["subjects"])):
             for s in ctx["subjects"]:
@@ -1746,6 +1828,7 @@ class JaxEndpoint(PermissionsEndpoint):
                     per_col_ids[col] = lst = AnnotatedIds(lst,
                                                           source="kernel")
                 out.append(lst)
+        timeline.record("extract", "host", t_ext, batch=ctx.get("batch_id"))
         return out, total_bad
 
     def _lookup_batch_finish_sync(self, ctx: dict) -> list:

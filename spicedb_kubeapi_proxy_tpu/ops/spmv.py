@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import timeline
 from .graph_compile import (
     GraphProgram,
     PExclude,
@@ -230,9 +231,14 @@ class KernelCache:
             return jax.lax.dynamic_slice_in_dim(
                 x, slot_offset, slot_length, axis=0) > 0
 
-        self._checks = jax.jit(run_checks)
-        # slot offset/length are static: one compile per (type, permission)
-        self._lookup = jax.jit(run_lookup, static_argnums=(0, 1))
+        # first-call-per-compile-key wrappers record each lazy XLA
+        # compile as a `compile` slice on the dispatch timeline
+        # (utils/timeline.py)
+        self._checks = timeline.time_first_call(jax.jit(run_checks))
+        # slot offset/length are static: one compile per (type,
+        # permission) — static_args=2 attributes each of them
+        self._lookup = timeline.time_first_call(
+            jax.jit(run_lookup, static_argnums=(0, 1)), static_args=2)
 
     # -- host-facing --------------------------------------------------------
 

@@ -156,6 +156,10 @@ class Options:
     slo_check_p99_ms: float = 0.0
     slo_objective: float = 0.01
     slo_error_rate: float = 0.0
+    # dispatch timeline profiler (utils/timeline.py, docs/observability.md
+    # "Dispatch timeline"): device HBM peak in GB/s for the roofline
+    # fraction; 0 = auto-detect from the jax platform (v5e -> 819)
+    device_hbm_peak_gbps: float = 0.0
 
 
 class ProxyServer:
@@ -234,6 +238,11 @@ class ProxyServer:
         # window task rides start/stop.
         if opts.enable_metrics:
             self.flight = self._make_flight_recorder()
+        # unconditional: set_hbm_peak(0) restores auto-detection, so a
+        # server built with the default never inherits a previous
+        # server's configured peak through the module singleton
+        from ..utils import timeline
+        timeline.set_hbm_peak(opts.device_hbm_peak_gbps)
         self._http: Optional[HttpServer] = None
         self._lag_probe = None
 
@@ -282,6 +291,10 @@ class ProxyServer:
             "flight": ("flight recorder: per-window telemetry snapshots "
                        "(phase quantiles, queue depths, HBM ledger, "
                        "occupancy) + SLO burn rates", self._debug_flight),
+            "timeline": ("dispatch timeline as chrome trace-event JSON "
+                         "(load in Perfetto): pack/transpose/transfer/"
+                         "kernel/extract/rebuild slices + overlap/"
+                         "roofline/stall summary", self._debug_timeline),
         }
         return surfaces
 
@@ -320,6 +333,16 @@ class ProxyServer:
                 "ring_capacity": self.audit.ring_capacity,
                 "sample_every": self.audit.sample_every,
                 "decisions": self.audit.recent()}
+
+    def _debug_timeline(self) -> dict:
+        from ..utils import timeline
+        if not timeline.enabled():
+            # the chrome-trace envelope stays valid (Perfetto loads an
+            # empty traceEvents list); otherData says WHY it is empty
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "otherData": {
+                        "reason": "Timeline feature gate disabled"}}
+        return timeline.chrome_trace()
 
     def _debug_flight(self) -> dict:
         from ..utils import devtel

@@ -27,7 +27,7 @@ import contextlib
 import time
 from typing import Iterable, Optional
 
-from ..utils import devtel, tracing
+from ..utils import devtel, timeline, tracing
 from .endpoints import PermissionsEndpoint
 from .store import Watcher
 from .types import (
@@ -317,6 +317,7 @@ class BatchingEndpoint(PermissionsEndpoint):
         self._stats["max_fused_batch"] = max(self._stats["max_fused_batch"],
                                             len(items))
         _mark_exec_start(waiters)
+        t0 = timeline.now()
         try:
             with _activate_batch_trace(waiters):
                 try:
@@ -327,6 +328,11 @@ class BatchingEndpoint(PermissionsEndpoint):
             self._resolve(waiters, results)
         finally:
             _mark_exec_end(waiters)
+            # dispatcher-track slice: how long this fused call occupied
+            # the drain loop (overlaps the device track's kernel slices
+            # in the /debug/timeline view)
+            timeline.record("fused", "dispatcher", t0, bucket=len(items),
+                            kind=stat)
 
     async def _run_checks(self, batch: list) -> None:
         await self._run_fused(
@@ -353,6 +359,7 @@ class BatchingEndpoint(PermissionsEndpoint):
         self._stats["max_fused_batch"] = max(self._stats["max_fused_batch"],
                                             len(waiters))
         _mark_exec_start(waiters)
+        t0 = timeline.now()
         try:
             with _activate_batch_trace(waiters):
                 ctx = await self.inner.lookup_resources_batch_start(
@@ -361,6 +368,9 @@ class BatchingEndpoint(PermissionsEndpoint):
             self._stats["fused_lookups"] -= 1  # _run_fused recounts
             await self._run_lookups(key, waiters)
             return None
+        timeline.record("fused_start", "dispatcher", t0,
+                        batch=ctx.get("batch_id") if isinstance(ctx, dict)
+                        else None, bucket=len(waiters))
         return (waiters, (key, ctx))
 
     async def _finish_lookups(self, waiters: list, started) -> None:
@@ -368,6 +378,7 @@ class BatchingEndpoint(PermissionsEndpoint):
         failure (same isolation contract as _run_fused)."""
         key, ctx = started
         resource_type, permission = key
+        t0 = timeline.now()
         try:
             with _activate_batch_trace(waiters):
                 try:
@@ -380,6 +391,10 @@ class BatchingEndpoint(PermissionsEndpoint):
             self._resolve(waiters, results)
         finally:
             _mark_exec_end(waiters)
+            timeline.record("fused_finish", "dispatcher", t0,
+                            batch=ctx.get("batch_id")
+                            if isinstance(ctx, dict) else None,
+                            bucket=len(waiters))
 
     # -- batched verbs -------------------------------------------------------
 

@@ -535,6 +535,11 @@ class FlightRecorder:
         # and diffing the first window against an empty baseline would
         # attribute the whole process history to window 1
         self._prev = self._read_raw()
+        # previous capture instants: windows restrict their slow-trace
+        # exemplars (wall clock) and dispatch-timeline summaries
+        # (perf_counter, the timeline's clock) to the window they cover
+        self._prev_wall = time.time()
+        self._prev_mono = time.perf_counter()
         self._burn_gauge = self._registry.gauge(
             "authz_slo_burn_rate",
             "Error-budget burn rate per SLO and evaluation window "
@@ -617,6 +622,9 @@ class FlightRecorder:
         phase_buckets, _ = self._raw_histogram("authz_request_phase_seconds")
         raw = self._read_raw()
         prev, self._prev = self._prev, raw
+        window_start_wall, self._prev_wall = self._prev_wall, time.time()
+        window_start_mono, self._prev_mono = (self._prev_mono,
+                                              time.perf_counter())
 
         # per-window deltas (phase histograms only record traced
         # requests, so they carry no probe/scrape dilution)
@@ -661,6 +669,13 @@ class FlightRecorder:
             "occupancy": OCCUPANCY.snapshot(),
             "jit": {k: v for k, v in KERNELS.snapshot().items()
                     if k != "time_by_bucket_s"},
+            # window evidence links: the slowest traces that STARTED in
+            # this window (ids resolve at /debug/traces) and the
+            # dispatch-timeline condensate for the same interval
+            # (slices at /debug/timeline) — a burning window names its
+            # own stall without correlating three surfaces by hand
+            "slow_traces": self._slow_trace_exemplars(window_start_wall),
+            "timeline": self._timeline_summary(window_start_mono),
             # per-window (bad, total) tallies per SLO from
             # observe_request: the long-horizon burn aggregates these
             # over the ring
@@ -670,6 +685,26 @@ class FlightRecorder:
         with self._lock:
             self._ring.append(snap)
         return snap
+
+    def _slow_trace_exemplars(self, since_unix: float) -> list:
+        """Top-K slow-trace exemplar refs for the closing window (lazy
+        import: the recorder must stay usable with a stripped tree)."""
+        try:
+            from .tracing import RECORDER
+            return RECORDER.exemplars(k=3, since_unix=since_unix)
+        except Exception:
+            return []
+
+    def _timeline_summary(self, since_mono: float):
+        """Dispatch-timeline condensate for the closing window (None
+        when the Timeline gate is off or the module is unavailable)."""
+        try:
+            from . import timeline
+            if not timeline.enabled():
+                return None
+            return timeline.summary(since=since_mono)
+        except Exception:
+            return None
 
     def _queue_stats(self) -> dict:
         if self._stats_fn is None:
@@ -738,6 +773,8 @@ class FlightRecorder:
             # handler-only use, warm-up requests) must not be billed to
             # the first timed window as a spurious one-window spike
             self._prev = self._read_raw()
+            self._prev_wall = time.time()
+            self._prev_mono = time.perf_counter()
             self._drain_intake()
             self._task = asyncio.get_running_loop().create_task(self._run())
 

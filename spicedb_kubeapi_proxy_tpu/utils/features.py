@@ -96,3 +96,8 @@ GATES.register("DurableStore", stage=BETA, default=True)
 # kernel/compile accounting, batch occupancy, SLO burn rates; this gate
 # is the killswitch for recording + the flight-recorder window task
 GATES.register("DeviceTelemetry", stage=BETA, default=True)
+# dispatch timeline profiler (utils/timeline.py): bounded event ring,
+# chrome-trace export at /debug/timeline, transfer/compute overlap +
+# roofline + stall attribution; this gate is the killswitch for
+# recording (span() degrades to a shared no-op context)
+GATES.register("Timeline", stage=BETA, default=True)

@@ -259,6 +259,28 @@ def _note_kernel(name: str, attrs: dict, seconds: float) -> None:
             pass
 
 
+_timeline_note = None  # resolved lazily; False => timeline unavailable
+
+
+def _note_timeline(name: str, attrs: dict, start: float, end: float) -> None:
+    """Feed kernel spans (with their start/end instants, not just the
+    duration) into the dispatch timeline (utils/timeline.py) so device
+    slices land on the timeline's device track — same lazy-binding
+    discipline as the devtel hook above."""
+    global _timeline_note
+    if _timeline_note is None:
+        try:
+            from .timeline import note_kernel_span
+            _timeline_note = note_kernel_span
+        except Exception:
+            _timeline_note = False
+    if _timeline_note:
+        try:
+            _timeline_note(name, attrs, start, end)
+        except Exception:
+            pass
+
+
 @contextlib.contextmanager
 def kernel_span(name: str, phase: bool = False, **attrs):
     """Span + `jax.profiler.TraceAnnotation`: when a jax profiler trace
@@ -278,7 +300,9 @@ def kernel_span(name: str, phase: bool = False, **attrs):
             with _profiler_annotation(name):
                 yield a
     finally:
-        _note_kernel(name, a, time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        _note_kernel(name, a, t1 - t0)
+        _note_timeline(name, a, t0, t1)
 
 
 # -- slow-trace retention ----------------------------------------------------
@@ -319,6 +343,27 @@ class SlowTraceRecorder:
             out = self._sorted()
             self._heap = []
             return out
+
+    def exemplars(self, k: int = 3,
+                  since_unix: Optional[float] = None) -> list:
+        """Top-k slowest retained traces as lightweight exemplar refs
+        (trace id + duration + wall start), optionally restricted to
+        traces that STARTED at/after `since_unix` — the flight recorder
+        embeds these per window so a burning SLO window at /debug/flight
+        links straight to /debug/traces + /debug/timeline evidence."""
+        with self._lock:
+            dicts = self._sorted()
+        out = []
+        for d in dicts:
+            if (since_unix is not None
+                    and d.get("start_unix", 0.0) < since_unix):
+                continue
+            out.append({"trace_id": d["trace_id"],
+                        "duration_ms": d["duration_ms"],
+                        "start_unix": d["start_unix"]})
+            if len(out) >= k:
+                break
+        return out
 
 
 RECORDER = SlowTraceRecorder()

@@ -157,7 +157,16 @@ def test_checked_at_tracks_evaluated_snapshot(endpoint_url):
 def test_device_batches_do_not_block_event_loop(monkeypatch):
     """A fused device batch (kernel + transfer + unpack) can take hundreds
     of ms on big graphs; it must run OFF the event loop so concurrent
-    requests, watch frames, and health probes keep flowing."""
+    requests, watch frames, and health probes keep flowing.
+
+    The stall bound is CALIBRATED, not a wall-clock constant: the old
+    fixed 0.3s tripped marginally (0.35-0.46s) in ~half of full-suite
+    runs purely from gc/scheduler pauses unrelated to the device batch
+    (PR 5 known flake).  Now an ambient phase measures this box's tick
+    jitter with NO batch in flight, the bound scales from it, and a
+    single bad-luck gc burst gets one retry before the test fails —
+    a genuinely blocked loop (the 0.5s sleep landing ON the loop) still
+    fails both attempts deterministically."""
     import time as _time
 
     ep = create_endpoint("jax://", Bootstrap(schema_text=SCHEMA))
@@ -174,24 +183,47 @@ def test_device_batches_do_not_block_event_loop(monkeypatch):
 
     monkeypatch.setattr(ep, "_check_batch_sync", slow_batch)
 
-    async def go():
-        ticks = []
+    def max_gap(ticks):
+        return max((b - a for a, b in zip(ticks, ticks[1:])), default=1.0)
 
-        async def ticker():
+    async def go():
+        async def ticker(out):
             while True:
-                ticks.append(asyncio.get_running_loop().time())
+                out.append(asyncio.get_running_loop().time())
                 await asyncio.sleep(0.02)
 
-        t = asyncio.ensure_future(ticker())
+        # phase 1: ambient tick jitter, no device batch in flight —
+        # whatever stalls show here (gc, a loaded CI box) are the
+        # environment's fault, not the off-loop dispatch's
+        ambient_ticks: list = []
+        t = asyncio.ensure_future(ticker(ambient_ticks))
+        await asyncio.sleep(0.3)
+        t.cancel()
+        ambient = max_gap(ambient_ticks) if len(ambient_ticks) > 1 else 0.02
+
+        # phase 2: the same ticker through the 0.5s device window
+        ticks: list = []
+        t = asyncio.ensure_future(ticker(ticks))
         await ep.check_bulk_permissions([CheckRequest(
             ObjectRef("doc", "d0"), "view", SubjectRef("user", "u0"))])
         t.cancel()
-        # the loop must have kept ticking through the 0.5s device window
         assert len(ticks) >= 10, (
             f"event loop starved: only {len(ticks)} ticks during the batch")
-        gaps = [b - a for a, b in zip(ticks, ticks[1:])]
-        assert max(gaps, default=1) < 0.3, f"loop stalled {max(gaps):.3f}s"
-    asyncio.run(go())
+        # a blocked loop gaps ~0.5s regardless of calibration; ambient
+        # noise scales the bound instead of tripping it — but the bound
+        # is CAPPED below the 0.5s device window, so a gc burst landing
+        # in the calibration phase can never inflate it past the very
+        # signal this test exists to detect
+        return max_gap(ticks), min(max(0.3, 4 * ambient), 0.45)
+
+    stall, bound = asyncio.run(go())
+    if stall >= bound:
+        # one retry: a single gen-2 gc burst inside the measured window
+        # is indistinguishable from a stall in one sample but cannot
+        # recur deterministically; a genuinely blocked loop can
+        stall, bound = asyncio.run(go())
+    assert stall < bound, (
+        f"loop stalled {stall:.3f}s (calibrated bound {bound:.3f}s)")
 
 
 @pytest.mark.parametrize("endpoint_url", ["jax://"])

@@ -479,7 +479,7 @@ class TestDebugSurfaces:
             assert resp.status == 200
             surfaces = json.loads(resp.body)["surfaces"]
             assert set(surfaces) == {"/debug/traces", "/debug/decisions",
-                                     "/debug/flight"}
+                                     "/debug/flight", "/debug/timeline"}
             for desc in surfaces.values():
                 assert isinstance(desc, str) and desc
         run(go())
@@ -501,7 +501,7 @@ class TestDebugSurfaces:
 
         async def go():
             for path in ("/debug", "/debug/traces", "/debug/decisions",
-                         "/debug/flight"):
+                         "/debug/flight", "/debug/timeline"):
                 resp = await anon.get(path)
                 assert resp.status == 401, path
         run(go())

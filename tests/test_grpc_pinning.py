@@ -9,12 +9,20 @@ import asyncio
 import datetime
 import threading
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+import pytest
 
-from spicedb_kubeapi_proxy_tpu.spicedb.grpc_remote import RemoteEndpoint
+# collection must degrade gracefully where cryptography is absent (the
+# module is a dev requirement, requirements-dev.txt): skip, don't error
+pytest.importorskip(
+    "cryptography",
+    reason="cryptography not installed (see requirements-dev.txt)")
+from cryptography import x509  # noqa: E402
+from cryptography.hazmat.primitives import hashes  # noqa: E402
+from cryptography.hazmat.primitives.asymmetric import ec  # noqa: E402
+from cryptography.x509.oid import NameOID  # noqa: E402
+
+from spicedb_kubeapi_proxy_tpu.spicedb.grpc_remote import (  # noqa: E402
+    RemoteEndpoint)
 
 
 def self_signed_pem(cn="myserver", san_dns="alt.example"):
