@@ -262,6 +262,25 @@ def timeline_summary(mark):
         return None
 
 
+def timeline_headline(tl_sum) -> dict:
+    """Promote the three device-resident-pipeline judgment numbers to
+    headline columns (ISSUE 7): overlap ratio (0 = serialized), modeled
+    roofline fraction, and the host transfer+transpose wall time the
+    pipeline exists to hide.  Riding every config entry of every sweep
+    artifact, so the BENCH trajectory shows the before/after directly
+    instead of burying it inside timeline_summary."""
+    if not tl_sum:
+        return {}
+    stage_ms = tl_sum.get("stage_ms") or {}
+    return {
+        "overlap_ratio": tl_sum.get("overlap_ratio"),
+        "roofline_fraction": tl_sum.get("roofline_fraction"),
+        "transfer_transpose_ms": round(
+            stage_ms.get("transfer", 0.0) + stage_ms.get("transpose", 0.0),
+            3),
+    }
+
+
 def build_endpoint(workload, kind: str):
     from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
     from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
@@ -904,6 +923,126 @@ def bench_recovery(args) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_pipeline_depth(args) -> dict:
+    """Device-resident pipeline A/B (ISSUE 7): the headline 1M-tuple
+    10k-pod concurrent-list shape, run with the DevicePipeline gate OFF
+    (the exact pre-PR host-pack serial path) and then gate ON at
+    dispatch depths 1, 2, and 4.  Each mode records checks/s, the
+    overlap ratio, and the stall{pack|transpose|transfer} attribution,
+    so the BENCH artifact carries the before/after for ROADMAP item 1
+    directly: `stall_reduction_x` = (host-pack pack+transpose+transfer
+    stall) / (depth-2 same), `checks_per_s_gain` = depth-2 / host-pack
+    throughput."""
+    import asyncio
+
+    from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+    from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+    from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+
+    stage("pipeline-depth sweep build + load (multitenant-1m)")
+    workload = wl.multitenant_1m()
+    inner = build_endpoint(workload, "jax")
+    batch = args.batch
+    rounds = max(3, args.rounds // 2)
+    subjects = workload.subjects
+    # max_batch splits each round into ~4 fused batches: with one
+    # monolithic batch per round the drain has nothing to keep in
+    # flight and every depth degenerates to serial
+    max_batch = max(1, batch // 4)
+    modes = (
+        ("host-pack", False, 1),   # gate off: pre-PR serial baseline
+        ("depth-1", True, 1),      # device pack, serial dispatch
+        ("depth-2", True, 2),      # the --pipeline-depth default
+        ("depth-4", True, 4),
+    )
+    out: dict = {"modes": {}, "batch": batch, "rounds": rounds,
+                 "max_batch": max_batch}
+    eps = {name: BatchingEndpoint(inner, max_batch=max_batch,
+                                  pipeline_depth=depth)
+           for name, _gate, depth in modes}
+    acc = {name: {"times": [], "stall": {}, "transfer_s": 0.0,
+                  "overlap_s": 0.0, "tt_ms": 0.0}
+           for name, _gate, _depth in modes}
+
+    async def one_round(ep, r):
+        async def caller(i):
+            s = SubjectRef(
+                "user", subjects[(r * batch + i) % len(subjects)])
+            return await ep.lookup_resources(
+                workload.resource_type, workload.permission, s)
+        t0 = time.time()
+        await asyncio.gather(*[caller(i) for i in range(batch)])
+        return time.time() - t0
+
+    try:
+        # interleaved A/B (the same methodology the gate-off parity
+        # claim uses): mode order rotates inside every round, so
+        # allocator drift / process aging lands on all modes equally
+        # instead of flattering whichever ran first
+        stage("pipeline-depth interleaved rounds")
+        for name, gate, _depth in modes:
+            GATES.set("DevicePipeline", gate)
+            asyncio.run(one_round(eps[name], 0))  # warm: compiles+arenas
+        for r in range(rounds):
+            for name, gate, _depth in modes:
+                GATES.set("DevicePipeline", gate)
+                mark = timeline_mark()
+                dt = asyncio.run(one_round(eps[name], r + 1))
+                tl = timeline_summary(mark) or {}
+                a = acc[name]
+                a["times"].append(dt)
+                for cause, v in (tl.get("stall_s") or {}).items():
+                    a["stall"][cause] = a["stall"].get(cause, 0.0) + v
+                ov = tl.get("overlap") or {}
+                a["transfer_s"] += ov.get("transfer_s", 0.0)
+                a["overlap_s"] += ov.get("overlap_s", 0.0)
+                a["tt_ms"] += timeline_headline(tl).get(
+                    "transfer_transpose_ms", 0.0)
+    finally:
+        GATES.set("DevicePipeline", True)
+
+    n_obj = len(inner.store.object_ids_of_type(workload.resource_type))
+    for name, _gate, _depth in modes:
+        a = acc[name]
+        per_round = statistics.median(a["times"])
+        host_stall = (a["stall"].get("pack", 0.0)
+                      + a["stall"].get("transpose", 0.0)
+                      + a["stall"].get("transfer", 0.0))
+        mode = {
+            "checks_per_s": round(batch * n_obj / per_round, 1),
+            "per_round_ms": round(per_round * 1e3, 2),
+            "p99_ms": round(p99(a["times"]) * 1e3, 2),
+            "stall_s": {c: round(v, 6) for c, v in sorted(
+                a["stall"].items())},
+            "stall_pack_transpose_transfer_s": round(host_stall, 6),
+            "overlap_ratio": (round(a["overlap_s"] / a["transfer_s"], 4)
+                              if a["transfer_s"] > 0 else None),
+            "transfer_transpose_ms": round(a["tt_ms"], 3),
+        }
+        out["modes"][name] = mode
+        log(f"pipeline {name}: {mode['checks_per_s']:.3g} checks/s, "
+            f"overlap={mode.get('overlap_ratio')}, "
+            f"host stalls={mode['stall_pack_transpose_transfer_s']}s")
+    base = out["modes"].get("host-pack", {})
+    d2 = out["modes"].get("depth-2", {})
+    if base and d2:
+        denom = max(d2.get("stall_pack_transpose_transfer_s") or 0.0, 1e-9)
+        out["stall_reduction_x"] = round(
+            (base.get("stall_pack_transpose_transfer_s") or 0.0) / denom, 2)
+        out["checks_per_s_gain"] = round(
+            d2["checks_per_s"] / max(base["checks_per_s"], 1e-9), 3)
+        log(f"pipeline-depth: stall reduction "
+            f"{out['stall_reduction_x']}x, checks/s gain "
+            f"{out['checks_per_s_gain']}x (depth-2 vs host-pack)")
+    return out
+
+
+# device-resident pipeline A/B (ISSUE 7): same contract as CACHE_CONFIGS
+PIPELINE_CONFIGS = {
+    "pipeline-depth": bench_pipeline_depth,
+}
+
 # decision-cache bench configs (ISSUE 3): run standalone via --config or
 # appended to the --all sweep artifact
 CACHE_CONFIGS = {
@@ -935,7 +1074,8 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="multitenant-1m",
                     choices=(list(CONFIGS) + list(CACHE_CONFIGS)
-                             + list(PERSIST_CONFIGS)))
+                             + list(PERSIST_CONFIGS)
+                             + list(PIPELINE_CONFIGS)))
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--oracle-queries", type=int, default=2)
@@ -1008,6 +1148,7 @@ def main() -> None:
         tl_sum = timeline_summary(tl_mark)
         if tl_sum:
             res["timeline_summary"] = tl_sum
+        res.update(timeline_headline(tl_sum))
         value = (res.get("cache_on_checks_per_s")
                  or res.get("lists_per_s", 0.0))
         _STATE["metric"] = f"decision-cache {args.config}"
@@ -1016,6 +1157,25 @@ def main() -> None:
                        else "lists/s"),
               "platform": _STATE["platform"],
               "baseline": "cache-off proxy chain", **res})
+        return
+
+    if args.config in PIPELINE_CONFIGS:
+        # standalone pipeline A/B: depth-2 checks/s is the headline
+        # value, the gate-off host-pack serial path is the baseline
+        stage(f"pipeline config {args.config}")
+        tel_before = devtel_snapshot()
+        res = PIPELINE_CONFIGS[args.config](args)
+        tel = devtel_delta(tel_before)
+        if tel:
+            res["device_telemetry"] = tel
+        _STATE["metric"] = f"device-pipeline {args.config}"
+        d2 = res.get("modes", {}).get("depth-2", {})
+        emit({"metric": _STATE["metric"],
+              "value": d2.get("checks_per_s", 0.0), "unit": "checks/s",
+              "platform": _STATE["platform"],
+              "baseline": "DevicePipeline gate off (host-pack serial "
+                          "dispatch, the pre-PR path)",
+              **res})
         return
 
     if args.config in PERSIST_CONFIGS:
@@ -1030,6 +1190,7 @@ def main() -> None:
         tl_sum = timeline_summary(tl_mark)
         if tl_sum:
             res["timeline_summary"] = tl_sum
+        res.update(timeline_headline(tl_sum))
         _STATE["metric"] = f"durable-store {args.config}"
         emit({"metric": _STATE["metric"],
               "value": res.get("time_to_serve_s", 0.0), "unit": "s",
@@ -1087,6 +1248,7 @@ def main() -> None:
                 "direct_batch_checks_per_s": round(direct["checks_per_s"], 1),
                 **({"device_telemetry": tel} if tel else {}),
                 **({"timeline_summary": tl_sum} if tl_sum else {}),
+                **timeline_headline(tl_sum),
             })
         else:
             # sweep numbers land in the artifact too (VERDICT r3 item 3)
@@ -1097,6 +1259,7 @@ def main() -> None:
                 "objects": head["objects"],
                 **({"device_telemetry": tel} if tel else {}),
                 **({"timeline_summary": tl_sum} if tl_sum else {}),
+                **timeline_headline(tl_sum),
             }
         oracle_res = None
         if with_oracle:
@@ -1134,6 +1297,7 @@ def main() -> None:
         # headline dispatch-timeline condensate: overlap fraction,
         # modeled roofline fraction, stall breakdown, worst dispatch
         payload["timeline_summary"] = _STATE["partial"]["timeline_summary"]
+        payload.update(timeline_headline(payload["timeline_summary"]))
     # dispatcher overhead = headline round time minus the bare device batch
     payload["latency_breakdown_ms"] = {
         "dispatcher_round": round(head["per_batch_s"] * 1e3, 2),
@@ -1222,7 +1386,8 @@ def main() -> None:
         # decision-cache + durable-store configs ride the sweep artifact
         # too (hit rate, on/off speedup, churn divergences, and the
         # restart time-to-serve + WAL write-overhead columns)
-        for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS}.items():
+        for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS,
+                         **PIPELINE_CONFIGS}.items():
             try:
                 tel_before = devtel_snapshot()
                 tl_mark = timeline_mark()
@@ -1233,6 +1398,7 @@ def main() -> None:
                 tl_sum = timeline_summary(tl_mark)
                 if tl_sum:
                     res["timeline_summary"] = tl_sum
+                res.update(timeline_headline(tl_sum))
                 _STATE["partial"].setdefault("configs", {})[name] = res
             except Exception as e:
                 log(f"config {name} failed: {e!r}")

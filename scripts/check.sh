@@ -19,7 +19,10 @@ echo "== lint gate (scripts/lint.py; CI additionally runs ruff)"
 # metrics-cardinality allowlist (M001: identities live in audit events,
 # never in metric labels) plus the docs-vs-registry metric drift gate
 # (M002: every authz_* family in code is documented in
-# docs/observability.md and vice versa)
+# docs/observability.md and vice versa) and the device hot-path fence
+# gate (M003: no host numpy / per-item loops inside the marked
+# per-batch dispatch regions of ops/*.py — the device-resident
+# pipeline's win must not silently reserialize)
 python scripts/lint.py
 
 if [[ "${1:-}" != "--fast" ]]; then
@@ -49,8 +52,11 @@ echo "== device-telemetry smoke (/metrics + /debug/flight + /debug/timeline)"
 # the device-telemetry metric families (HBM ledger, jit-cache counters,
 # batch occupancy, SLO burn rates, dispatch-timeline stall/roofline/
 # overlap) must be present and populated after real proxied traffic,
-# and /debug/timeline must serve valid chrome-trace JSON with >= 1
-# dispatch slice; fast, CPU-only, runs even with --fast
+# /debug/timeline must serve valid chrome-trace JSON with >= 1
+# dispatch slice, and with the device-resident pipeline enabled the
+# concurrent-wave section must drive authz_dispatch_overlap_ratio > 0
+# with stall{cause=pack|transpose} ~ 0; fast, CPU-only, runs even
+# with --fast
 JAX_PLATFORMS=cpu python scripts/devtel_smoke.py
 
 echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"

@@ -9,7 +9,12 @@ What it proves end to end:
 - `/debug/flight` returns >= 2 windows of snapshots after a warm-up;
 - `/debug/timeline` serves valid chrome-trace JSON (every event has
   ph/ts/pid/tid, B/E pairing balanced) with >= 1 dispatch slice;
-- the `/debug` index enumerates every debug surface uniformly.
+- the `/debug` index enumerates every debug surface uniformly;
+- with the device-resident pipeline enabled (DevicePipeline gate on,
+  `jax://?pipeline_depth=3`), concurrent per-user list requests fan
+  into multiple fused batches and `authz_dispatch_overlap_ratio` goes
+  positive, while `stall{cause=pack|transpose}` stays ~0 relative to
+  kernel time (the host encode/word-transpose moved on-device).
 """
 
 import asyncio
@@ -132,8 +137,12 @@ async def main() -> None:
     for i in range(8):
         kube.seed("", "v1", "pods",
                   {"metadata": {"name": f"p{i}", "namespace": "team-a"}})
+    # max_batch=4 + pipeline_depth=3: the concurrent per-user wave below
+    # must split into several fused batches so the drain keeps started
+    # batches in flight (the overlap assertion needs >= 2 batches whose
+    # kernel/readback windows can interleave)
     server = ProxyServer(Options(
-        spicedb_endpoint="jax://",
+        spicedb_endpoint="jax://?max_batch=4&pipeline_depth=3",
         bootstrap=Bootstrap(schema_text=SCHEMA),
         rules_yaml=RULES,
         upstream_transport=HandlerTransport(kube),
@@ -142,8 +151,18 @@ async def main() -> None:
         slo_check_p99_ms=250.0,
         slo_objective=0.01,
     ))
+    users = [f"u{j}" for j in range(12)]
     rels = ["namespace:team-a#creator@user:alice"] + [
-        f"pod:team-a/p{i}#creator@user:alice" for i in range(0, 8, 2)]
+        f"pod:team-a/p{i}#creator@user:alice" for i in range(0, 8, 2)] + [
+        f"pod:team-a/p{i}#creator@user:{u}"
+        for i in range(8) for u in users[i % 3::3]] + [
+        # graph ballast (not in the fake kube, filtered from responses):
+        # widens the lookup slot so each fused kernel's window is long
+        # enough for the overlap assertion below to be deterministic on
+        # the CPU backend — without it the sub-ms kernels finish before
+        # the drain can dispatch the next batch
+        f"pod:team-a/ballast{i}#creator@user:{users[i % len(users)]}"
+        for i in range(30_000)]
     server.endpoint.store.bulk_load([parse_relationship(r) for r in rels])
 
     await server.start("127.0.0.1", 0)
@@ -204,6 +223,46 @@ async def main() -> None:
             fail(f"flight window missing timeline/slow_traces evidence "
                  f"links: {sorted(win)}")
 
+        # -- device-resident pipeline: overlap > 0, pack/transpose ~ 0 --
+        # waves of concurrent per-user lists (distinct subjects, so the
+        # singleflight dedup can't collapse them) fan into >= 3 fused
+        # batches at max_batch=4; the pipelined drain keeps started
+        # batches in flight, so some batch's readback must land inside
+        # another batch's kernel window.  A couple of retry waves absorb
+        # scheduler noise on the tiny CPU smoke graph.
+        clients = [server.get_embedded_client(user=u) for u in users]
+        overlap = 0.0
+        for _ in range(6):
+            waved = await asyncio.gather(
+                *[c.get("/api/v1/pods") for c in clients])
+            for r in waved:
+                assert r.status == 200, r.body
+            resp = await alice.get("/metrics")
+            text = resp.body.decode()
+            for line in text.splitlines():
+                if line.startswith("authz_dispatch_overlap_ratio "):
+                    overlap = float(line.split()[1])
+            if overlap > 0:
+                break
+        if overlap <= 0:
+            fail("authz_dispatch_overlap_ratio stayed 0 after 6 "
+                 "concurrent waves with the pipeline enabled — the "
+                 "pipelined drain is not overlapping readback with the "
+                 "next batch's kernel")
+        resp = await alice.get("/debug/timeline")
+        summary = json.loads(resp.body).get("otherData", {}).get(
+            "summary", {})
+        stalls = summary.get("stall_s", {})
+        kernel_ms = summary.get("stage_ms", {}).get("kernel", 0.0)
+        if kernel_ms <= 0:
+            fail(f"timeline summary has no kernel stage time: {summary}")
+        host_prep = stalls.get("pack", 0.0) + stalls.get("transpose", 0.0)
+        if host_prep > 0.2 * kernel_ms / 1e3:
+            fail(f"stall{{cause=pack|transpose}} = {host_prep:.4f}s vs "
+                 f"kernel {kernel_ms:.1f}ms — host query prep crept back "
+                 f"onto the hot path (device-resident pipeline regression; "
+                 f"see lint M003)")
+
         resp = await alice.get("/debug")
         if resp.status != 200:
             fail(f"/debug -> {resp.status}")
@@ -222,7 +281,8 @@ async def main() -> None:
         await server.stop()
     print("devtel_smoke: OK (device-telemetry families present, "
           f"{len(flight['windows'])} flight windows, "
-          f"{len(slices)} timeline dispatch slices)")
+          f"{len(slices)} timeline dispatch slices, "
+          f"pipeline overlap {overlap:.3f})")
 
 
 if __name__ == "__main__":

@@ -19,6 +19,14 @@ Checks:
         unbounded time-series explosion and an identity leak in every
         scrape.  Extend ALLOWED_METRIC_LABELS only with label names
         whose value set is bounded by config/schema, not by traffic.
+  M003  host work inside a marked device hot path (ops/*.py only):
+        regions fenced by `# hotpath: begin` / `# hotpath: end` are the
+        per-batch dispatch paths the device-resident pipeline moved off
+        the host (docs/performance.md "Device-resident pipeline") —
+        reintroducing host numpy (`np.`) or a per-item Python loop
+        there silently reverts the PR 7 win while every test still
+        passes.  Device work (`jnp.`) is fine; if host staging is
+        genuinely needed, move it out of the fenced region.
   M002  docs-vs-registry metric drift (default-path runs only): every
         `authz_*` metric family registered in package code must appear
         in docs/observability.md, and every `authz_*` family the doc
@@ -35,6 +43,7 @@ Exit 1 on any finding.  Usage: python scripts/lint.py [paths...]
 """
 
 import ast
+import re
 import sys
 from pathlib import Path
 
@@ -57,6 +66,20 @@ _METRIC_FACTORIES = ("counter", "gauge", "histogram")
 # the cardinality contract applies to shipping code; tests/scripts mint
 # throwaway registries with synthetic labels
 _M001_PREFIX = "spicedb_kubeapi_proxy_tpu"
+
+# M003 hot-path fences: per-batch device-dispatch regions in ops/*.py
+# (and the endpoint's dispatch sites) marked by these comments
+_HOTPATH_BEGIN = "hotpath: begin"
+_HOTPATH_END = "hotpath: end"
+# host numpy as its own token (`np.`), NOT `jnp.`; plus per-item Python
+# loops — the two regressions that quietly reserialize the pipeline.
+# Type/dtype descriptors (`np.ndarray` annotations, bare dtype names)
+# do no host work and stay legal; anything that MAKES an array
+# (np.zeros / np.asarray / np.nonzero / ...) is the regression.
+_M003_NP = re.compile(
+    r"(?<![A-Za-z_0-9])np\."
+    r"(?!(ndarray|dtype|int32|int64|uint32|uint8|float32|bool_)\b)")
+_M003_LOOP = re.compile(r"^\s*(async\s+)?(for|while)\b")
 
 # M002 docs-vs-registry drift: the one place the metric catalog lives
 _METRICS_DOC = Path("docs/observability.md")
@@ -225,6 +248,13 @@ def lint_file(path, findings, metric_families=None):
                                  f"(first at line {seen[node.name]})"))
             seen[node.name] = node.lineno
 
+    # M003 applies to the kernel/dispatch layer (ops/ inside the
+    # package) — the only files that carry hotpath fences today; the
+    # parts-based test keeps absolute-path invocations honest
+    m003 = ("ops" in Path(path).parts
+            and _M001_PREFIX in Path(path).parts)
+    in_hotpath = False
+    hotpath_open_line = 0
     for i, line in enumerate(text.splitlines(), 1):
         if line != line.rstrip():
             findings.append((path, i, "W291", "trailing whitespace"))
@@ -234,6 +264,38 @@ def lint_file(path, findings, metric_families=None):
         stripped = line.lstrip(" ")
         if stripped.startswith("\t"):
             findings.append((path, i, "TAB", "hard tab in indentation"))
+        if not m003:
+            continue
+        if _HOTPATH_BEGIN in line:
+            if in_hotpath:
+                findings.append((path, i, "M003",
+                                 f"nested hotpath fence (previous begin "
+                                 f"at line {hotpath_open_line} never "
+                                 f"ended)"))
+            in_hotpath, hotpath_open_line = True, i
+            continue
+        if _HOTPATH_END in line:
+            in_hotpath = False
+            continue
+        if not in_hotpath:
+            continue
+        code_part = line.split("#", 1)[0]
+        if _M003_NP.search(code_part):
+            findings.append((path, i, "M003",
+                             "host numpy (`np.`) inside a device hot-path "
+                             "fence — per-batch staging belongs on device "
+                             "(jnp) or outside the fence; this is the "
+                             "host-pack regression the device-resident "
+                             "pipeline removed"))
+        if _M003_LOOP.match(code_part):
+            findings.append((path, i, "M003",
+                             "per-item Python loop inside a device "
+                             "hot-path fence — batch it on device or move "
+                             "it outside the fence"))
+    if m003 and in_hotpath:
+        findings.append((path, hotpath_open_line, "M003",
+                         "hotpath fence never closed "
+                         "(`# hotpath: end` missing)"))
 
 
 def _is_dynamic_family(name):
@@ -248,7 +310,6 @@ def check_metric_drift(metric_families, findings):
         findings.append((_METRICS_DOC, 0, "M002",
                          "metrics doc missing (docs/observability.md)"))
         return
-    import re
     text = _METRICS_DOC.read_text()
     doc_names: dict = {}  # name -> first line number
     for i, line in enumerate(text.splitlines(), 1):

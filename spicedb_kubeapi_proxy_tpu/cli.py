@@ -94,6 +94,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--decision-cache-bytes", type=int, default=0,
                    help="decision-cache LRU bound in bytes "
                         "(0 = default 128MiB)")
+    # device-resident query pipeline (ops/jax_endpoint.py,
+    # spicedb/dispatch.py; docs/performance.md "Device-resident
+    # pipeline"; killswitch: --feature-gates DevicePipeline=false)
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="fused dispatch pipeline depth for jax://: "
+                        "N-1 started batches stay in flight so batch "
+                        "N+1's host encode + upload + kernel dispatch "
+                        "overlap batch N's device execution and async "
+                        "D2H readback (1 = fully serial)")
+    p.add_argument("--prewarm-compiles", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="compile the common pow-2 batch-bucket ladder of "
+                        "kernel entry points during warm start, so "
+                        "first-request-per-bucket jit stalls move to "
+                        "startup (jax:// only; on by default)")
 
     # upstream cluster (options.go:203-206)
     p.add_argument("--backend-kubeconfig", default="",
@@ -300,6 +315,8 @@ def validate(args: argparse.Namespace) -> list:
         errs.append("--slo-error-rate must be in [0, 1]")
     if args.device_hbm_peak_gbps < 0:
         errs.append("--device-hbm-peak-gbps must be >= 0 (0 = auto)")
+    if args.pipeline_depth < 1:
+        errs.append("--pipeline-depth must be >= 1 (1 = fully serial)")
     return errs
 
 
@@ -415,6 +432,9 @@ def complete(args: argparse.Namespace,
         authenticators.append(ClientCertAuthenticator())
 
     endpoint_kwargs = {}
+    # fused-dispatch pipeline depth; a `jax://?pipeline_depth=N` URL
+    # parameter still overrides the flag inside create_endpoint
+    endpoint_kwargs["pipeline_depth"] = args.pipeline_depth
     if args.decision_cache:
         endpoint_kwargs["decision_cache"] = True
     if args.decision_cache_bytes:
@@ -462,6 +482,7 @@ def complete(args: argparse.Namespace,
         slo_objective=args.slo_objective,
         slo_error_rate=args.slo_error_rate,
         device_hbm_peak_gbps=args.device_hbm_peak_gbps,
+        prewarm_compiles=args.prewarm_compiles,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,

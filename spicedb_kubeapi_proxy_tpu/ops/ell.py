@@ -36,6 +36,7 @@ its position; padding slots in both tables point at it.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -546,7 +547,8 @@ def make_ell_step(prog: GraphProgram, n_aux_rows: int,
 
 
 def init_packed_state(prog: GraphProgram, n_aux_rows: int, q_idx,
-                      n_words: int, planes: bool = False) -> jnp.ndarray:
+                      n_words: int, planes: bool = False,
+                      like=None) -> jnp.ndarray:
     """Packed one-hot [NT, W] from per-query state indices ([NT, 2W] with
     both planes seeded when the tri-state path is active: the query
     subject itself is definite, hence also maybe).
@@ -554,6 +556,12 @@ def init_packed_state(prog: GraphProgram, n_aux_rows: int, q_idx,
     Column c of the batch is bit (c % 32) of word (c // 32); columns are
     distinct, so the scatter-add below never carries (each target bit is
     added at most once per (row, word)) — add is exactly OR here.
+
+    `like` (the donated state arena, shape [NT, width]) makes the arena
+    an operand of the zero-init: the bitplane PACK — int columns to
+    one-hot uint32 bit words — happens on device, seeded into the
+    buffer XLA aliases to the previous call's donated output, so the
+    sweep state updates in place instead of allocating per call.
     """
     nt = prog.state_size + n_aux_rows
     b = q_idx.shape[0]
@@ -561,7 +569,8 @@ def init_packed_state(prog: GraphProgram, n_aux_rows: int, q_idx,
     word = cols // 32
     bit = (cols % 32).astype(jnp.uint32)
     width = 2 * n_words if planes else n_words
-    x0 = jnp.zeros((nt, width), jnp.uint32)
+    x0 = (jnp.zeros((nt, width), jnp.uint32) if like is None
+          else jnp.zeros_like(like))
     x0 = x0.at[q_idx, word].add(jnp.uint32(1) << bit)
     if planes:
         x0 = x0.at[q_idx, n_words + word].add(jnp.uint32(1) << bit)
@@ -571,18 +580,22 @@ def init_packed_state(prog: GraphProgram, n_aux_rows: int, q_idx,
 def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
                       num_iters: int, use_while: bool = True,
                       planes: bool = False, aux_passes: int = 1,
-                      stages: Optional[tuple] = None):
+                      stages: Optional[tuple] = None, arena: bool = False):
     """fn(q_idx, idx_main, idx_aux[, idx_cav]) -> packed x_final
-    [NT, W] uint32 ([NT, 2W] on the tri-state plane path)."""
+    [NT, W] uint32 ([NT, 2W] on the tri-state plane path).
+
+    With `arena=True` the signature becomes
+    fn(state, q_idx, idx_main, idx_aux[, idx_cav]): `state` is the
+    previous call's x_final, donated (jax.jit donate_argnums) so XLA
+    aliases its buffer to this call's state output — the persistent
+    sweep state updates in place instead of allocating per call."""
     step = make_ell_step(prog, n_aux_rows,
                          half=n_words if planes else None,
                          aux_passes=aux_passes,
                          stages=None if planes else stages)
 
-    if use_while:
-        def evaluate(q_idx, idx_main, idx_aux, idx_cav=None):
-            x0 = init_packed_state(prog, n_aux_rows, q_idx, n_words, planes)
-
+    def fixpoint(x0, idx_main, idx_aux, idx_cav):
+        if use_while:
             def cond(state):
                 x, prev_changed, i = state
                 return jnp.logical_and(prev_changed, i < num_iters)
@@ -595,15 +608,22 @@ def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
             x_final, _, _ = jax.lax.while_loop(
                 cond, body, (x0, jnp.bool_(True), jnp.int32(0)))
             return x_final
+
+        def body(x, _):
+            return step(x, x0, idx_main, idx_aux, idx_cav), None
+
+        x_final, _ = jax.lax.scan(body, x0, None, length=num_iters)
+        return x_final
+
+    if arena:
+        def evaluate(state, q_idx, idx_main, idx_aux, idx_cav=None):
+            x0 = init_packed_state(prog, n_aux_rows, q_idx, n_words, planes,
+                                   like=state)
+            return fixpoint(x0, idx_main, idx_aux, idx_cav)
     else:
         def evaluate(q_idx, idx_main, idx_aux, idx_cav=None):
             x0 = init_packed_state(prog, n_aux_rows, q_idx, n_words, planes)
-
-            def body(x, _):
-                return step(x, x0, idx_main, idx_aux, idx_cav), None
-
-            x_final, _ = jax.lax.scan(body, x0, None, length=num_iters)
-            return x_final
+            return fixpoint(x0, idx_main, idx_aux, idx_cav)
 
     return evaluate
 
@@ -648,6 +668,16 @@ class EllKernelCache:
             self.stages = annotate_stage_refresh(self.stages, host_main,
                                                  prog.state_size)
         self._jits: dict[int, tuple] = {}
+        # donated per-bucket state arenas (device-resident pipeline):
+        # the pipelined entry points return their final sweep state, and
+        # the next call of the same bucket donates it back so XLA
+        # aliases the buffer in place (one persistent [NT, W] allocation
+        # per bucket instead of one per call).  Ledger-registered under
+        # the owning graph's generation (set by the endpoint's HBM
+        # registration) so a rebuild retires them wholesale.
+        self._arenas: dict = {}
+        self._arena_lock = threading.Lock()
+        self.devtel_generation = 0
         # jit-cache accounting: hits/misses/entries per batch bucket,
         # plus recompile-storm detection (utils/devtel.py)
         devtel.KERNELS.track(self)
@@ -728,6 +758,156 @@ class EllKernelCache:
                    bucket=n_words * 32, static_args=2))
         self._jits[n_words] = fns
         return fns
+
+    # -- pipelined (device-resident) entry points ----------------------------
+    # The serial entries above sync at the numpy conversion and hand the
+    # host a [L, W] result it must word-transpose; these variants keep
+    # the whole per-batch pipeline on device: the bitplane pack seeds a
+    # DONATED state arena (in-place iteration state), the word transpose
+    # is folded into the jit where XLA fuses it with the final slice, and
+    # the un-materialized device array is returned so the caller overlaps
+    # the D2H readback with the next batch's dispatch.
+
+    def _pipe_fns(self, n_words: int) -> tuple:
+        fns = self._jits.get(("pipe", n_words))
+        if fns is not None:
+            devtel.KERNELS.note_jit_hit(n_words * 32)
+            return fns
+        devtel.KERNELS.note_compile(n_words * 32)
+        evaluate = make_ell_evaluate(self.prog, self.n_aux_rows, n_words,
+                                     self.num_iters, planes=self.planes,
+                                     aux_passes=self.aux_passes,
+                                     stages=self.stages, arena=True)
+        if self.planes:
+            def run_checks(q_idx, gather_idx, gather_col, state,
+                           idx_main, idx_aux, idx_cav):
+                # word/bit split of the raw query columns happens HERE:
+                # the host uploads plain int32 column ids
+                gw = gather_col // 32
+                gb = (gather_col % 32).astype(jnp.uint32)
+                x = evaluate(state, q_idx, idx_main, idx_aux, idx_cav)
+                d = (x[gather_idx, gw] >> gb) & jnp.uint32(1)
+                m = (x[gather_idx, n_words + gw] >> gb) & jnp.uint32(1)
+                # 2=HAS, 1=CONDITIONAL (maybe without definite), 0=NO
+                return d * 2 + (m & (d ^ jnp.uint32(1))), x
+
+            def run_lookup(slot_offset, slot_length, q_idx, state,
+                           idx_main, idx_aux, idx_cav):
+                x = evaluate(state, q_idx, idx_main, idx_aux, idx_cav)
+                sl = jax.lax.dynamic_slice(
+                    x, (slot_offset, 0), (slot_length, n_words))
+                # transpose ON DEVICE: the D2H lands [W, L] contiguous
+                # per word row, so host extraction is row indexing with
+                # no 51MB host transpose copy (DEFINITE plane only)
+                return sl.T, x
+        else:
+            def run_checks(q_idx, gather_idx, gather_col, state,
+                           idx_main, idx_aux):
+                gw = gather_col // 32
+                gb = (gather_col % 32).astype(jnp.uint32)
+                x = evaluate(state, q_idx, idx_main, idx_aux)
+                # tri-state encoding ({0, 2}) so every kernel variant
+                # hands the endpoint the same value space
+                return ((x[gather_idx, gw] >> gb) & jnp.uint32(1)) * 2, x
+
+            def run_lookup(slot_offset, slot_length, q_idx, state,
+                           idx_main, idx_aux):
+                x = evaluate(state, q_idx, idx_main, idx_aux)
+                sl = jax.lax.dynamic_slice_in_dim(
+                    x, slot_offset, slot_length, axis=0)
+                return sl.T, x
+
+        # donate_argnums=3 = the state arena (positions count the full
+        # signature, statics included); donation is a no-op on backends
+        # without aliasing support (CPU) and an in-place update on TPU
+        fns = (timeline.time_first_call(
+                   jax.jit(run_checks, donate_argnums=(3,)),
+                   bucket=n_words * 32),
+               timeline.time_first_call(
+                   jax.jit(run_lookup, static_argnums=(0, 1),
+                           donate_argnums=(3,)),
+                   bucket=n_words * 32, static_args=2))
+        self._jits[("pipe", n_words)] = fns
+        return fns
+
+    def arena_key(self, lanes: int) -> int:
+        """Pool key for a batch of `lanes` padded query columns."""
+        return max(1, lanes // 32)
+
+    def take_arena(self, n_words: int):
+        """Pop the bucket's state arena (exclusive: a donated buffer must
+        never be shared between two in-flight calls); lazily allocated
+        and HBM-ledger-registered on first use.  Donation accounting:
+        the registered bytes are constant for the arena's lifetime —
+        in-place aliasing neither allocates nor frees."""
+        with self._arena_lock:
+            a = self._arenas.pop(n_words, None)
+        if a is not None:
+            return a
+        nt = self.prog.state_size + self.n_aux_rows
+        width = 2 * n_words if self.planes else n_words
+        a = jnp.zeros((nt, width), jnp.uint32)
+        devtel.LEDGER.register("state_arena", int(a.nbytes),
+                               generation=self.devtel_generation,
+                               name=f"arena:{n_words}")
+        return a
+
+    def put_arena(self, n_words: int, state) -> None:
+        """Return a call's final state as the bucket's next donated
+        arena.  If a concurrent call repooled first, this one is simply
+        dropped (registration is keyed by bucket name, so the ledger
+        keeps counting exactly one arena per bucket)."""
+        with self._arena_lock:
+            self._arenas.setdefault(n_words, state)
+
+    def discard_arena(self, n_words: int) -> None:
+        """Drop a bucket's pooled arena — a failed async computation
+        poisons its output array, and donating a poisoned arena would
+        fail every later call of the bucket."""
+        with self._arena_lock:
+            a = self._arenas.pop(n_words, None)
+        if a is not None:
+            devtel.LEDGER.unregister("state_arena",
+                                     generation=self.devtel_generation,
+                                     name=f"arena:{n_words}")
+
+    # hotpath: begin device dispatch (per-batch work stays on device —
+    # lint M003 flags host numpy materialization / per-item loops here)
+    def checks_device(self, q_idx: np.ndarray, n_words: int,
+                      gather_idx: np.ndarray, gather_col: np.ndarray,
+                      idx_main, idx_aux, idx_cav=None):
+        """Dispatch-only tri-state checks ({0,2}, or {0,1,2} with
+        planes): returns the un-materialized device array; the caller
+        owns the blocking readback."""
+        run_checks, _ = self._pipe_fns(n_words)
+        state = self.take_arena(n_words)
+        args = [jnp.asarray(q_idx), jnp.asarray(gather_idx),
+                jnp.asarray(gather_col), state, idx_main, idx_aux]
+        if self.planes:
+            out, x = run_checks(*args, idx_cav)
+        else:
+            out, x = run_checks(*args)
+        self.put_arena(n_words, x)
+        return out
+
+    def lookup_packed_T_device(self, slot_offset: int, slot_length: int,
+                               q_idx: np.ndarray, n_words: int,
+                               idx_main, idx_aux, idx_cav=None):
+        """Dispatch-only packed lookup, word-transposed on device:
+        returns the un-materialized [n_words, slot_length] uint32 device
+        array (bit b of word row w = query column w*32+b; DEFINITE plane
+        when planes are active)."""
+        _, run_lookup = self._pipe_fns(n_words)
+        state = self.take_arena(n_words)
+        if self.planes:
+            out, x = run_lookup(slot_offset, slot_length, jnp.asarray(q_idx),
+                                state, idx_main, idx_aux, idx_cav)
+        else:
+            out, x = run_lookup(slot_offset, slot_length, jnp.asarray(q_idx),
+                                state, idx_main, idx_aux)
+        self.put_arena(n_words, x)
+        return out
+    # hotpath: end
 
     def iterations(self, q_idx: np.ndarray, n_words: int, idx_main, idx_aux,
                    idx_cav=None) -> int:

@@ -26,6 +26,16 @@ The device graph is a cache over the host store:
 Reads are fully consistent w.r.t. the store (reference check.go:41-45 uses
 FullyConsistent): every query first drains pending deltas under the graph
 lock, so the device graph always reflects the committed store revision.
+
+Device-resident pipeline (DevicePipeline gate, docs/performance.md):
+the per-batch query preparation that used to run on the host — bitplane
+packing, the word transpose of the lookup result, and the blocking D2H
+sync — is folded into the jitted sweep (ops/ell.py `_pipe_fns`), the
+iteration state rides donated per-bucket arenas so it updates in place,
+and results read back asynchronously on a waiter pool so the dispatcher
+(spicedb/dispatch.py, --pipeline-depth) can overlap batch N+1's encode +
+upload + kernel with batch N's readback.  Gate off reproduces the
+serial host-pack path exactly.
 """
 
 from __future__ import annotations
@@ -41,6 +51,8 @@ from typing import Iterable, Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+from ..utils.features import pipeline_enabled as _pipeline_on
 
 from ..spicedb import schema as sch
 from ..utils import devtel, timeline, tracing
@@ -204,6 +216,21 @@ def _register_graph_buffers(graph, gen: int) -> int:
         if nb:
             devtel.LEDGER.register(kind, nb, generation=gen, name=attr)
             total += nb
+    # donated state arenas (device-resident pipeline) allocate lazily on
+    # the kernel cache and register under the SAME generation, so the
+    # wholesale retirement below covers them; donation itself never
+    # changes the registered bytes (in-place aliasing neither allocates
+    # nor frees)
+    kern = getattr(graph, "kernel", None)
+    if kern is not None and hasattr(kern, "devtel_generation"):
+        kern.devtel_generation = gen
+    # the segment graph creates its kernel caches lazily (sorted vs
+    # unsorted edge variants): stamp the graph so _kernel() propagates
+    # the generation onto caches created after this registration too
+    if hasattr(graph, "devtel_generation"):
+        graph.devtel_generation = gen
+        for k in getattr(graph, "_kernels", {}).values():
+            k.devtel_generation = gen
     weakref.finalize(graph, devtel.LEDGER.defer_retire, gen)
     return total
 
@@ -249,6 +276,69 @@ def _word_col_indices(wcol: np.ndarray, bit: int) -> np.ndarray:
 _log = logging.getLogger(__name__)
 
 
+# -- async D2H readback (device-resident pipeline) ----------------------------
+# The pipelined entry points return un-materialized device arrays; a
+# small waiter pool parks one thread per in-flight batch on the
+# completed future (block_until_ready), which is the only host-visible
+# instant the device window closes — that gives the timeline an honest
+# `kernel` slice under async dispatch (the dispatching call itself is
+# launch-only) — then drains the D2H as the `transfer` slice.  Sized
+# above any sane --pipeline-depth; excess submissions just queue.
+
+_READBACK_POOL = None
+_READBACK_POOL_LOCK = threading.Lock()
+
+
+def _readback_pool():
+    global _READBACK_POOL
+    if _READBACK_POOL is None:
+        with _READBACK_POOL_LOCK:
+            if _READBACK_POOL is None:
+                import concurrent.futures
+                _READBACK_POOL = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=8, thread_name_prefix="authz-readback")
+    return _READBACK_POOL
+
+
+def _start_readback(dev, batch_id, bucket: int, sweep_bytes: int,
+                    kind: str, on_error=None):
+    """Submit the async readback of a dispatched device result; returns
+    a concurrent.futures.Future resolving to the host numpy array.
+    `on_error` (e.g. discarding the donated arena chain) runs before the
+    exception propagates to the waiter."""
+    t0 = timeline.now()
+
+    def wait_and_fetch():
+        try:
+            dev.block_until_ready()
+            t_ready = timeline.now()
+            # the true device window: dispatch -> results ready (includes
+            # queueing behind earlier batches on the device stream, same
+            # contract as the serial path's host window)
+            timeline.record("kernel", "device", t0, t_ready,
+                            batch=batch_id, bucket=bucket,
+                            nbytes=sweep_bytes)
+            tracing.note_device_window(
+                "kernel.device", {"kind": kind, "bucket": bucket},
+                t_ready - t0)
+            if hasattr(dev, "copy_to_host_async"):
+                dev.copy_to_host_async()
+            host = np.asarray(dev)
+            timeline.record("transfer", "device", t_ready,
+                            batch=batch_id, bucket=bucket,
+                            nbytes=int(host.nbytes))
+            return host
+        except Exception:
+            if on_error is not None:
+                try:
+                    on_error()
+                except Exception:
+                    _log.exception("readback error cleanup failed")
+            raise
+
+    return _readback_pool().submit(wait_and_fetch)
+
+
 def _ids_for(ids: np.ndarray, idx: np.ndarray, ph, mask) -> tuple:
     """Materialize an allowed-id list, dropping the phantom column's
     reserved id (part of every type's universe, never emitted).
@@ -282,7 +372,60 @@ def _rel_from_key(key: tuple) -> Relationship:
                         subject=SubjectRef(key[3], key[4], key[5]))
 
 
-class _SegmentGraph:
+class _PrewarmMixin:
+    """Compile-prewarm of the common pow-2 bucket ladder, shared by the
+    segment and ELL graphs (warm_start(prewarm=True))."""
+
+    def prewarm(self, lanes: Iterable[int] = (32, 64, 128, 256),
+                slot_ranges: Iterable[tuple] = (),
+                pipelined: bool = True) -> int:
+        """Compile the common pow-2 bucket ladder NOW: XLA compiles
+        lazily inside the first execution of each (entry point, bucket,
+        static slot range) key, so without prewarm every first request
+        of a new bucket absorbs a multi-second stall.  The dummy batches
+        carry only dead-index columns — every evaluate converges in one
+        sweep, so the cost here is compile, not execution.  Each warmed
+        call is recorded as a `compile` event on the rebuild track
+        (near-zero slices for keys that were already compiled)."""
+        pipelined = (pipelined
+                     and getattr(self, "run_checks3_device", None) is not None)
+        if pipelined:
+            lookup = (getattr(self, "run_lookup_packed_T_device", None)
+                      or self.run_lookup_T_device)
+        else:
+            lookup = (getattr(self, "run_lookup_packed", None)
+                      or self.run_lookup)
+        dead = self.prog.dead_index
+        snap = self.snapshot()
+        warmed = 0
+        for b in lanes:
+            b = self.batch_bucket(b)
+            q = np.full(b, dead, np.int32)
+            gi = np.zeros(b, np.int32)
+            gc = np.zeros(b, np.int32)
+            t0 = timeline.now()
+            if pipelined:
+                dev, _ = self.run_checks3_device(q, gi, gc, snap=snap)
+                np.asarray(dev)
+            else:
+                self.run_checks3(q, gi, gc, snap=snap)
+            timeline.record("compile", "rebuild", t0, bucket=b,
+                            prewarm="checks")
+            warmed += 1
+            for (off, length) in slot_ranges:
+                t0 = timeline.now()
+                if pipelined:
+                    dev, _ = lookup(off, length, q, snap=snap)
+                    np.asarray(dev)
+                else:
+                    lookup(off, length, q, snap=snap)
+                timeline.record("compile", "rebuild", t0, bucket=b,
+                                prewarm=f"lookup:{off}")
+                warmed += 1
+        return warmed
+
+
+class _SegmentGraph(_PrewarmMixin):
     """Flat padded edge arrays + gather/segment_sum kernel (ops/spmv.py)."""
 
     def __init__(self, prog: GraphProgram, edge_endpoints,
@@ -312,6 +455,10 @@ class _SegmentGraph:
         # tuple key -> positions occupied by that tuple's edges
         self.positions: dict[tuple, list] = {}
         self._kernels: dict[bool, KernelCache] = {}
+        # HBM-ledger generation for lazily created kernel caches (their
+        # donated state arenas register under it; _register_graph_buffers
+        # restamps on rebuild)
+        self.devtel_generation = 0
         self._updates: dict[int, tuple] = {}  # pos -> (src, dst), batched
         # index tuple keys -> edge positions (edges were emitted in tuple
         # order then sorted; recover positions by pair matching)
@@ -338,6 +485,7 @@ class _SegmentGraph:
         if k is None:
             k = KernelCache(self.prog, num_iters=self.num_iters,
                             indices_sorted=key)
+            k.devtel_generation = self.devtel_generation
             self._kernels[key] = k
         return k
 
@@ -414,6 +562,22 @@ class _SegmentGraph:
         kern, src, dst = snap if snap is not None else self.snapshot()
         return kern.lookup(offset, length, q_arr, src, dst)
 
+    # -- device-resident pipeline (dispatch-only; caller owns readback) ------
+
+    def run_checks3_device(self, q_arr, gather_idx, gather_col, snap=None):
+        kern, src, dst = snap if snap is not None else self.snapshot()
+        g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
+        gi = np.zeros(g, np.int32)
+        gc = np.zeros(g, np.int32)
+        gi[: len(gather_idx)] = gather_idx
+        gc[: len(gather_col)] = gather_col
+        return kern.checks3_device(q_arr, gi, gc, src, dst), kern
+
+    def run_lookup_T_device(self, offset: int, length: int, q_arr,
+                            snap=None):
+        kern, src, dst = snap if snap is not None else self.snapshot()
+        return kern.lookup_T_device(offset, length, q_arr, src, dst), kern
+
     # no MAYBE plane: removals are vacuous, insertions force a rebuild
     def remove_cav_key(self, key: tuple) -> bool:
         return True
@@ -422,7 +586,7 @@ class _SegmentGraph:
         return False
 
 
-class _EllGraph:
+class _EllGraph(_PrewarmMixin):
     """Bit-packed fixed-fanin tables + gather-only kernel (ops/ell.py).
 
     Delta edits are positionless: an edge (src -> dst) lives somewhere in
@@ -725,6 +889,25 @@ class _EllGraph:
         return self.kernel.lookup_packed(offset, length, q_arr, n_words,
                                          main, aux, cav)
 
+    # -- device-resident pipeline (dispatch-only; caller owns readback) ------
+
+    def run_checks3_device(self, q_arr, gather_idx, gather_col, snap=None):
+        main, aux, cav = snap if snap is not None else self.snapshot()
+        g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
+        gi = np.zeros(g, np.int32)
+        gc = np.zeros(g, np.int32)
+        gi[: len(gather_idx)] = gather_idx
+        gc[: len(gather_col)] = gather_col
+        n_words = max(1, len(q_arr) // 32)
+        return self.kernel.checks_device(q_arr, n_words, gi, gc,
+                                         main, aux, cav), self.kernel
+
+    def run_lookup_packed_T_device(self, offset: int, length: int, q_arr,
+                                   snap=None):
+        main, aux, cav = snap if snap is not None else self.snapshot()
+        n_words = max(1, len(q_arr) // 32)
+        return self.kernel.lookup_packed_T_device(
+            offset, length, q_arr, n_words, main, aux, cav), self.kernel
 
 class _ShardedEllGraph(_EllGraph):
     """Multi-chip ELL graph: same positionless host tables and tree-walk
@@ -736,6 +919,13 @@ class _ShardedEllGraph(_EllGraph):
     the single-chip path (SURVEY.md §7 step 7); the reference counterpart
     is SpiceDB's internal dispatch distribution
     (reference pkg/spicedb/spicedb.go:31-47)."""
+
+    # the sharded kernel manages its own sharded buffers and has no
+    # donated-arena/device-transpose entry points: shadow the inherited
+    # pipeline methods so the endpoint (and prewarm) fall back to the
+    # serial path cleanly
+    run_checks3_device = None
+    run_lookup_packed_T_device = None
 
     def __init__(self, prog: GraphProgram, edge_endpoints, mesh,
                  num_iters: Optional[int] = None):
@@ -910,13 +1100,51 @@ class JaxEndpoint(PermissionsEndpoint):
         apply_bootstrap_once(ep.store, rel_text)
         return ep
 
-    def warm_start(self) -> None:
+    # compile-prewarm ladder: the pow-2 lane buckets the dispatcher's
+    # fused batches actually land in — from the _MIN_BATCH_BUCKET floor
+    # (a single-query batch pads to 8 lanes) through the default
+    # 256-concurrent headline shape (the segment kernel jit-keys per
+    # lane bucket, so the small buckets are real first-request stalls)
+    _PREWARM_LANES = (8, 16, 32, 64, 128, 256)
+    _PREWARM_SLOT_CAP = 16
+
+    def warm_start(self, prewarm: bool = False) -> None:
         """Build the device graph from the current store NOW instead of
         lazily on the first query — the warm-graph-start step of crash
         recovery (spicedb/persist): a recovered 1M-tuple store pays its
-        compile before the server starts accepting traffic."""
+        compile before the server starts accepting traffic.
+
+        `prewarm=True` additionally compiles the common pow-2 bucket
+        ladder of kernel entry points (checks + every compiled
+        (type, permission) lookup slot range, capped) so
+        first-request-per-bucket jit stalls move to startup; each warmed
+        compile records a `compile` timeline event on the rebuild
+        track."""
         with timeline.span("warm_start", "rebuild"), self._lock:
             self._apply_pending()
+            graph = self._graph
+        if not prewarm or graph is None:
+            return
+        fn = getattr(graph, "prewarm", None)
+        if fn is None:
+            return
+        slot_ranges = []
+        for t, d in self.schema.definitions.items():
+            for p in d.permissions:
+                rng = graph.prog.slot_range(t, p)
+                if rng is not None:
+                    slot_ranges.append(rng)
+            if len(slot_ranges) >= self._PREWARM_SLOT_CAP:
+                break
+        t0 = timeline.now()
+        warmed = fn(lanes=self._PREWARM_LANES,
+                    slot_ranges=slot_ranges[: self._PREWARM_SLOT_CAP],
+                    pipelined=_pipeline_on())
+        _log.info("prewarmed %d kernel entry points (%d buckets x %d "
+                  "lookup slots + checks) in %.1fs",
+                  warmed, len(self._PREWARM_LANES),
+                  min(len(slot_ranges), self._PREWARM_SLOT_CAP),
+                  timeline.now() - t0)
 
     # -- delta intake -------------------------------------------------------
 
@@ -1411,6 +1639,12 @@ class JaxEndpoint(PermissionsEndpoint):
                  2: Permissionship.HAS_PERMISSION}
 
     def _check_batch_sync(self, reqs: list) -> list:
+        """One-shot fused check: capture (drain + encode + dispatch)
+        immediately followed by finish (readback + assembly).  The
+        two-phase pair below is the dispatcher's pipelining surface."""
+        return self._check_batch_finish(self._check_batch_capture(reqs))
+
+    def _check_batch_capture(self, reqs: list) -> dict:
         bid = timeline.next_batch()
         with tracing.span("kernel.prepare", kind="check", batch=len(reqs)), \
                 self._lock:
@@ -1482,29 +1716,68 @@ class JaxEndpoint(PermissionsEndpoint):
                 devtel.OCCUPANCY.record("check", used, len(q_arr) - used)
                 devtel.LEDGER.note_scratch(
                     int(q_arr.nbytes) + 8 * len(gather_idx))
-        # device execution + host-oracle fallbacks run OUTSIDE the lock:
-        # the snapshot is immutable, so concurrent drains/queries proceed
-        # instead of queueing behind a hundreds-of-ms kernel hold.  Oracle
-        # fallbacks evaluate the LIVE store and carry its revision rather
-        # than claiming the graph snapshot's.
+        # device dispatch runs OUTSIDE the lock: the snapshot is
+        # immutable, so concurrent drains/queries proceed instead of
+        # queueing behind a hundreds-of-ms kernel hold.
+        ctx = {"reqs": reqs, "results": results, "kernel_rows": kernel_rows,
+               "oracle_rows": oracle_rows, "rev": rev, "batch_id": bid}
         if kernel_rows:
-            with tracing.kernel_span("kernel.device", kind="check",
-                                     rows=len(kernel_rows),
-                                     bucket=len(q_arr)) as a:
-                # timeline tags: fused-batch id + modeled one-sweep
-                # bytes (the roofline lower bound) ride the span attrs
-                # into the device track
-                a["batch_id"] = bid
-                a["nbytes"] = _sweep_bytes(graph, len(q_arr))
-                out = graph.run_checks3(q_arr, gather_idx, gather_col,
-                                        snap=snap)
-            for j, row in enumerate(kernel_rows):
+            pipe = (getattr(graph, "run_checks3_device", None)
+                    if _pipeline_on() else None)
+            if pipe is not None:
+                # hotpath: begin pipelined check dispatch (device does the
+                # word/bit split and the readback is async — reintroducing
+                # host numpy staging here is the regression M003 guards)
+                with tracing.kernel_span("kernel.launch", kind="check",
+                                         rows=len(kernel_rows),
+                                         bucket=len(q_arr)) as a:
+                    a["batch_id"] = bid
+                    dev, kern = pipe(q_arr, gather_idx, gather_col,
+                                     snap=snap)
+                key = kern.arena_key(len(q_arr))
+                ctx["readback"] = _start_readback(
+                    dev, bid, bucket=len(q_arr),
+                    sweep_bytes=_sweep_bytes(graph, len(q_arr)),
+                    kind="check",
+                    on_error=lambda: kern.discard_arena(key))
+                # hotpath: end
+            else:
+                with tracing.kernel_span("kernel.device", kind="check",
+                                         rows=len(kernel_rows),
+                                         bucket=len(q_arr)) as a:
+                    # timeline tags: fused-batch id + modeled one-sweep
+                    # bytes (the roofline lower bound) ride the span
+                    # attrs into the device track
+                    a["batch_id"] = bid
+                    a["nbytes"] = _sweep_bytes(graph, len(q_arr))
+                    ctx["out"] = graph.run_checks3(q_arr, gather_idx,
+                                                   gather_col, snap=snap)
+        return ctx
+
+    def _check_batch_finish(self, ctx: dict) -> list:
+        """Phase 2 of a fused check batch: block on the async readback
+        (pipelined) or consume the already-host result (serial), then
+        assemble CheckResults.  Oracle fallbacks evaluate the LIVE store
+        here, outside the endpoint lock, and carry its revision rather
+        than claiming the graph snapshot's."""
+        results = ctx["results"]
+        fut = ctx.get("readback")
+        if fut is not None:
+            with tracing.kernel_span("kernel.wait", kind="check") as a:
+                a["batch_id"] = ctx["batch_id"]
+                out = fut.result()
+        else:
+            out = ctx.get("out")
+        if out is not None:
+            rev = ctx["rev"]
+            for j, row in enumerate(ctx["kernel_rows"]):
                 results[row] = (int(out[j]), rev)
+        oracle_rows = ctx["oracle_rows"]
         if oracle_rows:
             with tracing.span("kernel.oracle", kind="check",
                               rows=len(oracle_rows)):
                 for i in oracle_rows:
-                    r = reqs[i]
+                    r = ctx["reqs"][i]
                     results[i] = (self._oracle.check3(r.resource, r.permission,
                                                       r.subject),
                                   self.store.revision)
@@ -1555,6 +1828,17 @@ class JaxEndpoint(PermissionsEndpoint):
         if not reqs:
             return []
         return await self._off_loop(self._check_batch_sync, reqs)
+
+    async def check_bulk_permissions_start(self, reqs: list) -> dict:
+        """Two-phase fused check, phase 1 (encode + kernel dispatch +
+        async readback).  Pair with check_bulk_permissions_finish; the
+        dispatcher uses the pair to pipeline fused check batches."""
+        return await self._off_loop(self._check_batch_capture, reqs)
+
+    async def check_bulk_permissions_finish(self, ctx: dict) -> list:
+        """Two-phase fused check, phase 2 (blocking readback + oracle
+        fallbacks + result assembly)."""
+        return await self._off_loop(self._check_batch_finish, ctx)
 
     def _lookup_sync(self, resource_type: str, permission: str,
                      subject: SubjectRef) -> list:
@@ -1745,23 +2029,48 @@ class JaxEndpoint(PermissionsEndpoint):
             ctx["all_oracle"] = True
             return ctx
         # kernel dispatch outside the lock (immutable snapshot)
-        with tracing.kernel_span("kernel.dispatch", kind="lookup_batch",
-                                 batch=len(subjects), bucket=len(q_arr)) as a:
-            a["batch_id"] = bid
-            a["nbytes"] = _sweep_bytes(graph, len(q_arr))
-            if hasattr(graph, "run_lookup_packed"):
-                # packed fast path: per-column shift/AND/nonzero over one
-                # uint32 word column — never materializes the 32x larger
-                # bool bitmap or its [B, L] transpose.  Transposed on device
-                # so the transfer lands contiguous per word column.
-                packed_T = graph.run_lookup_packed(rng[0], rng[1], q_arr,
-                                                   snap=snap).T
-                if hasattr(packed_T, "copy_to_host_async"):
-                    packed_T.copy_to_host_async()
-                ctx["packed_T"] = packed_T
-            else:
-                ctx["bitmap"] = graph.run_lookup(rng[0], rng[1], q_arr,
-                                                 snap=snap)
+        pipe = None
+        if _pipeline_on():
+            pipe = (getattr(graph, "run_lookup_packed_T_device", None)
+                    or getattr(graph, "run_lookup_T_device", None))
+        if pipe is not None:
+            # hotpath: begin pipelined lookup dispatch — bitplane pack,
+            # word transpose, and final-slice all fused in-jit; the
+            # device array reads back asynchronously (reintroducing the
+            # host `.T`/ascontiguousarray copy here is the regression
+            # M003 guards)
+            with tracing.kernel_span("kernel.launch", kind="lookup_batch",
+                                     batch=len(subjects),
+                                     bucket=len(q_arr)) as a:
+                a["batch_id"] = bid
+                dev, kern = pipe(rng[0], rng[1], q_arr, snap=snap)
+            key = kern.arena_key(len(q_arr))
+            ctx["readback"] = _start_readback(
+                dev, bid, bucket=len(q_arr),
+                sweep_bytes=_sweep_bytes(graph, len(q_arr)),
+                kind="lookup_batch",
+                on_error=lambda: kern.discard_arena(key))
+            # hotpath: end
+        else:
+            with tracing.kernel_span("kernel.dispatch", kind="lookup_batch",
+                                     batch=len(subjects),
+                                     bucket=len(q_arr)) as a:
+                a["batch_id"] = bid
+                a["nbytes"] = _sweep_bytes(graph, len(q_arr))
+                if hasattr(graph, "run_lookup_packed"):
+                    # packed fast path: per-column shift/AND/nonzero over
+                    # one uint32 word column — never materializes the 32x
+                    # larger bool bitmap or its [B, L] transpose.
+                    # Transposed on device so the transfer lands
+                    # contiguous per word column.
+                    packed_T = graph.run_lookup_packed(rng[0], rng[1], q_arr,
+                                                       snap=snap).T
+                    if hasattr(packed_T, "copy_to_host_async"):
+                        packed_T.copy_to_host_async()
+                    ctx["packed_T"] = packed_T
+                else:
+                    ctx["bitmap"] = graph.run_lookup(rng[0], rng[1], q_arr,
+                                                     snap=snap)
         ctx.update(cols=cols, unknown=unknown, ids=ids, mask=mask, ph=ph,
                    forensic=_forensic)
         return ctx
@@ -1779,7 +2088,26 @@ class JaxEndpoint(PermissionsEndpoint):
                                 ctx["rt"], ctx["perm"], s),
                             source="oracle")
                         for s in ctx["subjects"]], 0
-        if "packed_T" in ctx:
+        if "readback" in ctx:
+            # pipelined path: the device already transposed; block on the
+            # waiter future (kernel + transfer timeline slices were
+            # recorded by the waiter thread — this span only attributes
+            # the residual wait to the request trace)
+            with tracing.kernel_span("kernel.wait", kind="lookup_batch") as a:
+                a["batch_id"] = ctx.get("batch_id")
+                arr = ctx["readback"].result()
+                a["nbytes"] = int(arr.nbytes)
+            if arr.dtype == np.uint32:
+                packed_T = arr          # [W, L]: word rows, bit-packed
+
+                def col_indices(col):
+                    return _word_col_indices(packed_T[col // 32], col % 32)
+            else:
+                bitmap_T = arr          # [B, L] bool: row per query column
+
+                def col_indices(col):
+                    return np.nonzero(bitmap_T[col])[0]
+        elif "packed_T" in ctx:
             # the device->host sync point: this blocks until the async
             # D2H started at capture time lands
             with tracing.kernel_span("kernel.transfer",

@@ -160,6 +160,11 @@ class Options:
     # "Dispatch timeline"): device HBM peak in GB/s for the roofline
     # fraction; 0 = auto-detect from the jax platform (v5e -> 819)
     device_hbm_peak_gbps: float = 0.0
+    # compile the common pow-2 batch-bucket ladder of kernel entry
+    # points during warm start (jax:// only), so first-request-per-
+    # bucket jit stalls move to startup (docs/performance.md
+    # "Device-resident pipeline")
+    prewarm_compiles: bool = False
 
 
 class ProxyServer:
@@ -549,16 +554,21 @@ class ProxyServer:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         # warm graph start BEFORE serving: a recovered store pays the
         # device-graph compile now, so the first authorized request after
-        # a restart doesn't absorb a 1M-tuple rebuild (spicedb/persist)
-        if self.persistence is not None:
+        # a restart doesn't absorb a 1M-tuple rebuild (spicedb/persist).
+        # --prewarm-compiles additionally walks the pow-2 bucket ladder
+        # of kernel entry points so first-request-per-bucket jit stalls
+        # move here too (recorded as `compile` events on the rebuild
+        # timeline track).
+        if self.persistence is not None or self.opts.prewarm_compiles:
             warm = getattr(self.endpoint, "warm_start", None)
             if warm is not None:
+                prewarm = self.opts.prewarm_compiles
                 loop = asyncio.get_running_loop()
                 ctx = contextvars.copy_context()
                 with tracing.request_trace(op="warm_start") as tr:
                     with tracing.span("recovery.graph_rebuild", phase=True):
-                        await loop.run_in_executor(None,
-                                                   lambda: ctx.run(warm))
+                        await loop.run_in_executor(
+                            None, lambda: ctx.run(warm, prewarm=prewarm))
                 tracing.RECORDER.record(tr)
         self._http = HttpServer(self.handler, ssl_context=self.opts.ssl_context)
         bound = await self._http.start(host, port)

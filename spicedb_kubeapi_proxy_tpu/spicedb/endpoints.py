@@ -372,6 +372,10 @@ def create_endpoint(url: str,
     params = parse_qs(split.query)
     cache_on, cache_explicit, cache_bytes = _resolve_cache_config(
         url, params, kwargs)
+    # fused-dispatch pipeline depth (spicedb/dispatch.py): CLI flag via
+    # kwargs, `jax://?pipeline_depth=N` overrides; popped here so the
+    # non-batched schemes never see an unexpected kwarg
+    pipeline_depth = kwargs.pop("pipeline_depth", None)
     # a pre-built store (the persistence layer hands its recovered store
     # in here) only makes sense for the store-backed backends
     store = kwargs.pop("store", None)
@@ -447,10 +451,15 @@ def create_endpoint(url: str,
             from .dispatch import BatchingEndpoint
             try:
                 max_batch = int((params.get("max_batch") or ["4096"])[0])
-                ep = BatchingEndpoint(ep, max_batch=max_batch)
+                if "pipeline_depth" in params:
+                    pipeline_depth = int(params["pipeline_depth"][0])
+                ep = BatchingEndpoint(
+                    ep, max_batch=max_batch,
+                    pipeline_depth=(pipeline_depth
+                                    if pipeline_depth is not None else 2))
             except ValueError as e:
                 raise EndpointConfigError(
-                    f"invalid max_batch in {url!r}: {e}") from e
+                    f"invalid max_batch/pipeline_depth in {url!r}: {e}") from e
         elif dispatch != "direct":
             raise EndpointConfigError(
                 f"unknown dispatch mode {dispatch!r}; use batched|direct")

@@ -179,11 +179,16 @@ class HbmLedger:
             self._reap_locked()
             return sum(self._by_kind.values())
 
-    def generation_bytes(self, generation: int) -> int:
+    def generation_bytes(self, generation: int,
+                         kind: Optional[str] = None) -> int:
+        """Registered bytes under one generation, optionally narrowed to
+        one kind — the generation-scoped view tests use so concurrent
+        endpoints (the ledger is process-global) can't skew totals."""
         with self._lock:
             self._reap_locked()
             return sum(v for k, v in self._buffers.items()
-                       if k[0] == generation)
+                       if k[0] == generation
+                       and (kind is None or k[1] == kind))
 
     def totals(self) -> dict:
         with self._lock:

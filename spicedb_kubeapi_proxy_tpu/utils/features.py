@@ -101,3 +101,20 @@ GATES.register("DeviceTelemetry", stage=BETA, default=True)
 # roofline + stall attribution; this gate is the killswitch for
 # recording (span() degrades to a shared no-op context)
 GATES.register("Timeline", stage=BETA, default=True)
+# device-resident query pipeline (ops/ell.py, ops/spmv.py,
+# ops/jax_endpoint.py, spicedb/dispatch.py): on-device bitplane
+# word-transpose, donated per-bucket state arenas, async D2H readback,
+# and depth-N double-buffered fused dispatch (--pipeline-depth).  This
+# gate is the killswitch: off reproduces the pre-pipeline serial path
+# (host word-transpose, blocking device sync, single-slot lookup window)
+GATES.register("DevicePipeline", stage=BETA, default=True)
+
+
+def pipeline_enabled() -> bool:
+    """DevicePipeline gate accessor; unknown-gate errors fail open so
+    embedded users with a stripped gate registry still get the fast
+    path (mirrors utils/timeline.enabled)."""
+    try:
+        return GATES.enabled("DevicePipeline")
+    except Exception:
+        return True

@@ -259,6 +259,16 @@ def _note_kernel(name: str, attrs: dict, seconds: float) -> None:
             pass
 
 
+def note_device_window(name: str, attrs: dict, seconds: float) -> None:
+    """Public entry for async-readback waiters (ops/jax_endpoint.py):
+    under the pipelined dispatch path the dispatching call is
+    launch-only, so the true device window is only measurable by the
+    thread parked on the completed future — it feeds the measured
+    window into the kernel accounting here (the waiter records its own
+    timeline events; this covers only the devtel histograms)."""
+    _note_kernel(name, attrs, seconds)
+
+
 _timeline_note = None  # resolved lazily; False => timeline unavailable
 
 

@@ -206,6 +206,9 @@ def test_complete_embedded_mode_uses_header_auth(tmp_path):
 
 
 def test_complete_serving_mode_generates_self_signed_certs(tmp_path):
+    # self-signed pair generation needs the optional cryptography
+    # package (requirements-dev.txt); degrade to a skip like test_authn
+    pytest.importorskip("cryptography")
     rules = tmp_path / "rules.yaml"
     rules.write_text(RULES)
     args = parse(["--rule-config", str(rules),
@@ -263,6 +266,7 @@ def test_complete_token_auth_file(tmp_path):
 def test_serve_tls_end_to_end(tmp_path):
     """complete() -> ProxyServer over real TLS -> authenticated request is
     authorized and proxied (upstream faked)."""
+    pytest.importorskip("cryptography")  # self-signed serving pair
     from spicedb_kubeapi_proxy_tpu.proxy.server import ProxyServer
 
     rules = tmp_path / "rules.yaml"
