@@ -59,6 +59,21 @@ echo "== device-telemetry smoke (/metrics + /debug/flight + /debug/timeline)"
 # with --fast
 JAX_PLATFORMS=cpu python scripts/devtel_smoke.py
 
+echo "== churn soak gate (deterministic CPU, small graph, SLO-asserted)"
+# tail-latency hardening acceptance (docs/performance.md "Overload &
+# rebuild behavior"): sustained create/delete churn + list-heavy reads
+# for 4 windows; per-window p99 must hold max(2 x p50, 250ms) and never
+# exceed 1s — a rebuild- or compile-coincident spike fails HERE, in
+# under a minute, instead of in the 30-min soak.  The 250ms floor is
+# noise headroom for a small shared CI box (measured: ambient
+# contention on a 2-core host inflates clean-run p99 from ~30ms to
+# ~200ms); the failure classes this gate exists for — flush-scatter
+# compiles (~400ms), off-diagonal check compiles (~3.5s), sync rebuild
+# stalls (multi-second) — sit cleanly above it.
+JAX_PLATFORMS=cpu python scripts/soak.py 24 --churn --graph small \
+    --window 6 --assert-slo --p99-floor-ms 250 \
+    --out /tmp/soak_churn_gate.json
+
 echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"
 JAX_PLATFORMS=cpu python __graft_entry__.py 8
 

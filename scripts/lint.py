@@ -60,7 +60,7 @@ MAX_LINE = 100
 ALLOWED_METRIC_LABELS = frozenset((
     "verb", "code", "phase", "backend", "resource", "reason", "stage",
     "decision", "generation", "kind", "le", "bucket", "slo", "window",
-    "cause",
+    "cause", "mode",
 ))
 _METRIC_FACTORIES = ("counter", "gauge", "histogram")
 # the cardinality contract applies to shipping code; tests/scripts mint

@@ -1,18 +1,36 @@
-"""Write-path soak (VERDICT r4 item 8): sustained mixed workload on the
-multitenant-1m graph — unique-name pod create/delete cycles (the normal
-kubernetes lifecycle), fused lookups, bulk checks, and a live watch —
-tracking spare-pool occupancy, rebuilds, suppressions, RSS, and p99
-drift per window.  Writes SOAK_r05.json.
+"""Write-path soak: sustained mixed workload on the jax:// endpoint —
+unique-name pod create/delete cycles (the normal kubernetes lifecycle),
+fused lookups, bulk checks, and a live watch — tracking spare-pool
+occupancy, rebuilds (now off-loop: sync vs background vs preemptive),
+quarantined stale pairs, suppressions, RSS, and p99 drift per window.
+Writes SOAK_r06.json by default.
+
+Profiles:
+  default        the r05 mix (2 writers, 3 lookers, 1 bulk checker)
+  --churn        tail-latency hardening gate (ROADMAP item 4): heavier
+                 sustained write churn (4 writers, no inter-op sleeps on
+                 the write side) + list-heavy read traffic, sized to
+                 drive the spare pool through preemptive background
+                 rebuilds.
+
+Pass/fail mode (--assert-slo): per-window p99 must stay within
+max(2 x p50, --p99-floor-ms) and NO window may exceed --p99-cap-ms
+(default 1000) — the "no rebuild-coincident multi-second spike"
+acceptance gate.  The floor exists because at sub-ms p50 a 2x ratio is
+noise, not a tail; the cap is absolute.
+
+Run (real TPU):  PYTHONPATH=/root/repo python scripts/soak.py 1800
+30-min churn:    python scripts/soak.py 1800 --churn --assert-slo
+Quick CPU gate:  JAX_PLATFORMS=cpu python scripts/soak.py 24 --churn \
+                     --graph small --window 6 --assert-slo --out /tmp/s.json
 
 Every lookup/check runs inside a request trace (utils/tracing.py) and
 each window dumps its slowest traces with per-phase span breakdowns
 (queue_wait vs. kernel vs. extraction), so a p99 spike in a window is
 attributable from the soak output alone.
-
-Run (real TPU):  PYTHONPATH=/root/repo python scripts/soak.py [seconds]
-Quick CPU smoke: JAX_PLATFORMS=cpu python scripts/soak.py 60
 """
 
+import argparse
 import asyncio
 import json
 import os
@@ -34,26 +52,65 @@ from spicedb_kubeapi_proxy_tpu.spicedb.types import (
     parse_relationship,
 )
 
-WINDOW_S = 300.0
-
 
 def rss_mb() -> float:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
 
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("duration", nargs="?", type=float, default=1800.0,
+                   help="soak duration in seconds (default 1800)")
+    p.add_argument("--churn", action="store_true",
+                   help="sustained write churn + list-heavy profile "
+                        "(tail-latency hardening gate)")
+    p.add_argument("--graph", choices=["1m", "small"], default="1m",
+                   help="workload: multitenant-1m (default) or the "
+                        "small pods-depth1 graph for the fast CPU gate")
+    p.add_argument("--window", type=float,
+                   default=float(os.environ.get("SOAK_WINDOW_S", 300.0)),
+                   help="reporting window seconds (default 300)")
+    p.add_argument("--assert-slo", action="store_true",
+                   help="exit 1 unless every window holds p99 <= "
+                        "max(2 x p50, --p99-floor-ms) and "
+                        "p99 <= --p99-cap-ms, with zero worker errors")
+    p.add_argument("--p99-floor-ms", type=float, default=50.0,
+                   help="absolute floor under which the 2x-p50 ratio "
+                        "check is waived (sub-ms p50s make the ratio "
+                        "noise, not a tail)")
+    p.add_argument("--p99-cap-ms", type=float, default=1000.0,
+                   help="no window may exceed this p99 (ms)")
+    p.add_argument("--out", default=os.environ.get("SOAK_OUT",
+                                                   "SOAK_r06.json"),
+                   help="output artifact path (default SOAK_r06.json)")
+    return p.parse_args()
+
+
 def main():
-    duration = float(sys.argv[1]) if len(sys.argv) > 1 else 1800.0
-    out_path = os.environ.get("SOAK_OUT", "SOAK_r05.json")
-    w = wl.multitenant_1m()
+    args = parse_args()
+    w = wl.multitenant_1m() if args.graph == "1m" else wl.pods_depth1()
     t0 = time.time()
     ep = create_endpoint("jax://", Bootstrap(schema_text=w.schema_text))
     ep.store.bulk_load([parse_relationship(r) for r in w.relationships])
     inner = getattr(ep, "inner", ep)
-    print(f"loaded {len(w.relationships)} tuples in {time.time()-t0:.1f}s",
-          flush=True)
+    # warm start BEFORE the workload: the initial graph compile and the
+    # pow-2 bucket-ladder jit compiles are startup cost in production
+    # (server warm start does exactly this) — without it window 1 just
+    # measures compile latency instead of steady-state tails
+    t_warm = time.time()
+    inner.warm_start(prewarm=True)
+    print(f"loaded {len(w.relationships)} tuples in {time.time()-t0:.1f}s "
+          f"(warm start {time.time()-t_warm:.1f}s, "
+          f"profile={'churn' if args.churn else 'default'} "
+          f"graph={args.graph})", flush=True)
+
+    n_writers = 4 if args.churn else 2
+    n_lookers = 6 if args.churn else 3
+    write_pause = 0.0 if args.churn else 0.05
+    look_pause = 0.05 if args.churn else 0.2
 
     stop = asyncio.Event()
-    lookup_lat: list = []      # (t, seconds) within current window
+    lookup_lat: list = []      # seconds within current window
     windows: list = []
     counters = {"creates": 0, "deletes": 0, "lookups": 0, "checks": 0,
                 "watch_events": 0, "errors": 0}
@@ -85,7 +142,7 @@ def main():
                 print(f"writer error: {e!r}", flush=True)
             pool_snapshot()
             k += 1
-            await asyncio.sleep(0.05)
+            await asyncio.sleep(write_pause)
 
     async def looker(i: int):
         while not stop.is_set():
@@ -101,7 +158,7 @@ def main():
             except Exception as e:
                 counters["errors"] += 1
                 print(f"looker error: {e!r}", flush=True)
-            await asyncio.sleep(0.2)
+            await asyncio.sleep(look_pause)
 
     async def checker():
         while not stop.is_set():
@@ -134,9 +191,9 @@ def main():
         last = start
         window_mark = timeline.now()
         while not stop.is_set():
-            await asyncio.sleep(5)
+            await asyncio.sleep(min(5, args.window / 3))
             now = time.time()
-            if now - last >= WINDOW_S or (stop.is_set() and lookup_lat):
+            if now - last >= args.window or (stop.is_set() and lookup_lat):
                 lat = sorted(lookup_lat)
                 lookup_lat.clear()
                 last = now
@@ -154,6 +211,11 @@ def main():
                     "p99_ms": round(lat[int(len(lat) * 0.99)] * 1e3, 1) if lat else None,
                     "rss_mb": round(rss_mb(), 1),
                     "rebuilds": st.get("rebuilds"),
+                    "bg_rebuilds": st.get("bg_rebuilds"),
+                    "preemptive_rebuilds": st.get("preemptive_rebuilds"),
+                    "rebuild_failures": st.get("rebuild_failures"),
+                    "stale_pair_marks": st.get("stale_pair_marks"),
+                    "stale_routed": st.get("stale_routed"),
                     "spare_assignments": st.get("spare_assignments"),
                     "spare_reclaims": st.get("spare_reclaims"),
                     "placeholder_suppressed": st.get("placeholder_suppressed", 0),
@@ -170,19 +232,48 @@ def main():
 
     async def run():
         tasks = [asyncio.ensure_future(x) for x in (
-            writer(0), writer(1), looker(0), looker(1), looker(2),
+            *[writer(i) for i in range(n_writers)],
+            *[looker(i) for i in range(n_lookers)],
             checker(), watcher(), reporter())]
-        await asyncio.sleep(duration)
+        await asyncio.sleep(args.duration)
         stop.set()
         await asyncio.gather(*tasks, return_exceptions=True)
 
     t_run = time.time()
     asyncio.run(run())
+    # quiesce in-flight background rebuilds before the final stats read
+    wait = getattr(inner, "wait_rebuilds", None)
+    if wait is not None:
+        wait(timeout=60)
     st = dict(inner.stats)
     warmup_rebuilds = windows[0]["rebuilds"] if windows else st.get("rebuilds")
+
+    slo_failures = []
+    if args.assert_slo:
+        for i, win in enumerate(windows):
+            p50, p99 = win["p50_ms"], win["p99_ms"]
+            if p99 is None:
+                slo_failures.append(f"window {i + 1}: no lookups completed")
+                continue
+            if p99 > args.p99_cap_ms:
+                slo_failures.append(
+                    f"window {i + 1}: p99 {p99}ms > cap {args.p99_cap_ms}ms")
+            if p99 > max(2 * (p50 or 0.0), args.p99_floor_ms):
+                slo_failures.append(
+                    f"window {i + 1}: p99 {p99}ms > "
+                    f"max(2 x p50 {p50}ms, floor {args.p99_floor_ms}ms)")
+        if not windows:
+            slo_failures.append("no windows recorded (duration too short "
+                                "for --window?)")
+        if counters["errors"]:
+            slo_failures.append(f"{counters['errors']} worker errors")
+
     final = {
         "duration_s": round(time.time() - t_run, 1),
         "platform": os.environ.get("JAX_PLATFORMS", "tpu(axon)"),
+        "profile": "churn" if args.churn else "default",
+        "graph": args.graph,
+        "window_s": args.window,
         "windows": windows,
         "final_stats": {k: v for k, v in st.items()
                         if isinstance(v, (int, float))},
@@ -195,6 +286,10 @@ def main():
         "verdict": {
             "rebuilds_after_warmup": (st.get("rebuilds", 0)
                                       - (warmup_rebuilds or 0)),
+            "bg_rebuilds": st.get("bg_rebuilds", 0),
+            "preemptive_rebuilds": st.get("preemptive_rebuilds", 0),
+            "rebuild_failures": st.get("rebuild_failures", 0),
+            "stale_pair_marks": st.get("stale_pair_marks", 0),
             "placeholder_suppressed": st.get("placeholder_suppressed", 0),
             "suppression_oracle_fallbacks": st.get(
                 "suppression_oracle_fallbacks", 0),
@@ -202,12 +297,18 @@ def main():
             "rss_flat": (len(windows) < 2
                          or windows[-1]["rss_mb"] - windows[1]["rss_mb"]
                          < 256),
+            "slo_pass": not slo_failures if args.assert_slo else None,
+            "slo_failures": slo_failures,
         },
     }
-    with open(out_path, "w") as f:
+    with open(args.out, "w") as f:
         json.dump(final, f, indent=1)
     print(json.dumps(final["verdict"]), flush=True)
-    print(f"wrote {out_path}", flush=True)
+    print(f"wrote {args.out}", flush=True)
+    if args.assert_slo and slo_failures:
+        print("soak: SLO GATE FAILED:\n  " + "\n  ".join(slo_failures),
+              file=sys.stderr, flush=True)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

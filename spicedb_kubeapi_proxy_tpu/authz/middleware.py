@@ -21,6 +21,8 @@ from ..rules.engine import (
     ResolveError,
     filter_rules_with_cel_conditions,
     resolve_input_from_request)
+from ..utils import admission
+from ..utils.admission import AdmissionRejectedError
 from ..utils.audit import (
     AuditEvent,
     AuditSink,
@@ -155,6 +157,18 @@ def with_authorization(handler: Handler, failed: Handler,
 
     async def authorized(req: Request) -> Response:
         info: RequestInfo = req.context["request_info"]
+        if info.verb in UPDATE_VERBS:
+            # dual-writes are never shed: their authorization checks and
+            # the workflow they feed bypass the dispatcher queue bounds
+            # (utils/admission.py) — rejecting a write mid-two-phase
+            # commit is strictly worse than running it slowly.  The
+            # contextvar rides the request context across executor hops.
+            with admission.exempt():
+                return await _authorized(req)
+        return await _authorized(req)
+
+    async def _authorized(req: Request) -> Response:
+        info: RequestInfo = req.context["request_info"]
         user = req.context["user"]
         # structured request logging (reference requestlogger.go +
         # rules.go:242-279): the logging middleware reads these back out
@@ -281,6 +295,8 @@ def with_authorization(handler: Handler, failed: Handler,
         req.context[FILTERER_KEY] = filterer
         try:
             filterer.run_pre_filters()
+        except AdmissionRejectedError:
+            raise  # surfaces as 429 + Retry-After, not a 403 denial
         except Exception as e:
             _emit(req, "check", OUTCOME_ERROR, message=str(e))
             return await failed(req)
@@ -318,6 +334,8 @@ def with_authorization(handler: Handler, failed: Handler,
                     with span("postfilter"):
                         body = await filter_list_response(
                             resp.body, filtered_rules, input, endpoint)
+                except AdmissionRejectedError:
+                    raise  # 429 + Retry-After, not a 403 denial
                 except Exception as e:
                     _emit(req, "postfilter", OUTCOME_ERROR, message=str(e))
                     return await failed(req)

@@ -21,6 +21,7 @@ from typing import Optional
 from ..proxy.httpcore import Request, Response
 from ..proxy.kube import RequestInfo
 from ..proxy.restmapper import CachingRESTMapper, NoKindMatchError
+from ..utils.admission import AdmissionRejectedError
 from ..rules.engine import (
     ResolveInput,
     ResolvedPreFilter,
@@ -176,6 +177,10 @@ class StandardResponseFilterer(ResponseFilterer):
         except asyncio.TimeoutError:
             raise FilterError("timed out waiting for pre-filter") from None
         except FilterError:
+            raise
+        except AdmissionRejectedError:
+            # admission rejection of the prefilter lookup is a 429 with
+            # Retry-After, never a 502 bad-gateway wrap
             raise
         except Exception as e:
             raise FilterError(f"pre-filter error: {e}") from e

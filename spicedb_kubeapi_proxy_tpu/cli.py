@@ -109,6 +109,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "kernel entry points during warm start, so "
                         "first-request-per-bucket jit stalls move to "
                         "startup (jax:// only; on by default)")
+    # admission control (utils/admission.py, docs/performance.md
+    # "Overload & rebuild behavior"; killswitch:
+    # --feature-gates AdmissionControl=false)
+    p.add_argument("--max-queue-depth", type=int, default=0,
+                   help="bound on each dispatcher queue (checks and "
+                        "LookupResources): an enqueue past the bound is "
+                        "rejected with 429 + Retry-After instead of "
+                        "queueing unboundedly; dual-write authorization "
+                        "is exempt (0 = unbounded)")
+    p.add_argument("--shed-queue-depth", type=int, default=0,
+                   help="load-shed threshold: read-only requests are "
+                        "rejected with 429 + Retry-After BEFORE "
+                        "authorization work starts once the dispatcher "
+                        "queues reach this depth (0 = disabled)")
+    p.add_argument("--shed-slo-burn", action="store_true",
+                   help="also shed read-only requests while an SLO "
+                        "(--slo-check-p99-ms / --slo-error-rate) burns "
+                        "on both horizons; update verbs are never shed")
+    p.add_argument("--shed-retry-after", type=float, default=1.0,
+                   help="Retry-After seconds suggested on shed "
+                        "responses")
 
     # upstream cluster (options.go:203-206)
     p.add_argument("--backend-kubeconfig", default="",
@@ -317,6 +338,16 @@ def validate(args: argparse.Namespace) -> list:
         errs.append("--device-hbm-peak-gbps must be >= 0 (0 = auto)")
     if args.pipeline_depth < 1:
         errs.append("--pipeline-depth must be >= 1 (1 = fully serial)")
+    if args.max_queue_depth < 0:
+        errs.append("--max-queue-depth must be >= 0 (0 = unbounded)")
+    if args.shed_queue_depth < 0:
+        errs.append("--shed-queue-depth must be >= 0 (0 = disabled)")
+    if args.shed_retry_after <= 0:
+        errs.append("--shed-retry-after must be > 0")
+    if args.shed_slo_burn and not (args.slo_check_p99_ms > 0
+                                   or args.slo_error_rate > 0):
+        errs.append("--shed-slo-burn needs an SLO configured "
+                    "(--slo-check-p99-ms or --slo-error-rate)")
     return errs
 
 
@@ -435,6 +466,9 @@ def complete(args: argparse.Namespace,
     # fused-dispatch pipeline depth; a `jax://?pipeline_depth=N` URL
     # parameter still overrides the flag inside create_endpoint
     endpoint_kwargs["pipeline_depth"] = args.pipeline_depth
+    # dispatcher queue bound (admission control); a
+    # `jax://?max_queue_depth=N` URL parameter still overrides
+    endpoint_kwargs["max_queue_depth"] = args.max_queue_depth
     if args.decision_cache:
         endpoint_kwargs["decision_cache"] = True
     if args.decision_cache_bytes:
@@ -483,6 +517,9 @@ def complete(args: argparse.Namespace,
         slo_error_rate=args.slo_error_rate,
         device_hbm_peak_gbps=args.device_hbm_peak_gbps,
         prewarm_compiles=args.prewarm_compiles,
+        shed_queue_depth=args.shed_queue_depth,
+        shed_slo_burn=args.shed_slo_burn,
+        shed_retry_after_s=args.shed_retry_after,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,

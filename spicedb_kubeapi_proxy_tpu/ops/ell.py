@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import devtel, timeline
+from ..utils.failpoints import fail_point
 from .graph_compile import (
     GraphProgram,
     PExclude,
@@ -146,9 +147,15 @@ def build_tables(prog: GraphProgram,
         idx_aux = np.full((0, K_AUX), dead, np.int32)
     # spare pool sized to the graph; hub-free graphs keep an empty aux
     # table (no per-iteration aux gather at all) and fall back to the
-    # rebuild path on their rare full-row inserts
+    # rebuild path on their rare full-row inserts.  The pool scales with
+    # BOTH the aux table (hub growth) and the main row count (capped):
+    # under sustained write churn full-row inserts land anywhere in the
+    # graph, and the pool drying up is what turns churn back into
+    # rebuilds — on the 1M-row graph the main-scaled term costs ~0.5MB
+    # of aux table for thousands of extra in-place growths
+    # (docs/performance.md "Overload & rebuild behavior").
     if aux_rows:
-        n_spare = max(64, len(aux_rows) // 4)
+        n_spare = max(64, len(aux_rows) // 4, min(4096, n // 256))
         spare0 = idx_aux.shape[0]
         idx_aux = np.vstack([idx_aux,
                              np.full((n_spare, K_AUX), dead, np.int32)])
@@ -751,11 +758,15 @@ class EllKernelCache:
         # static (slot_offset, slot_length) pair IS part of the jit
         # cache key — every new (type, permission) slot range
         # recompiles, so static_args=2 attributes those too.
+        # shape_args: the check gather and the grow-able tables retrace
+        # the same jit under novel shapes — attribute those compiles
+        # too, not just the first call of the bucket
         fns = (timeline.time_first_call(jax.jit(run_checks),
-                                        bucket=n_words * 32),
+                                        bucket=n_words * 32,
+                                        shape_args=True),
                timeline.time_first_call(
                    jax.jit(run_lookup, static_argnums=(0, 1)),
-                   bucket=n_words * 32, static_args=2))
+                   bucket=n_words * 32, static_args=2, shape_args=True))
         self._jits[n_words] = fns
         return fns
 
@@ -822,11 +833,11 @@ class EllKernelCache:
         # without aliasing support (CPU) and an in-place update on TPU
         fns = (timeline.time_first_call(
                    jax.jit(run_checks, donate_argnums=(3,)),
-                   bucket=n_words * 32),
+                   bucket=n_words * 32, shape_args=True),
                timeline.time_first_call(
                    jax.jit(run_lookup, static_argnums=(0, 1),
                            donate_argnums=(3,)),
-                   bucket=n_words * 32, static_args=2))
+                   bucket=n_words * 32, static_args=2, shape_args=True))
         self._jits[("pipe", n_words)] = fns
         return fns
 
@@ -840,6 +851,10 @@ class EllKernelCache:
         and HBM-ledger-registered on first use.  Donation accounting:
         the registered bytes are constant for the arena's lifetime —
         in-place aliasing neither allocates nor frees."""
+        # kill-matrix site (tests/test_faultmatrix.py): a failure at the
+        # arena pop must fail the dispatching batch fast without
+        # corrupting the pool or the ledger
+        fail_point("arenaTake")
         with self._arena_lock:
             a = self._arenas.pop(n_words, None)
         if a is not None:

@@ -47,12 +47,14 @@ import heapq
 import logging
 import os
 import threading
+import time
 from typing import Iterable, Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..utils.features import pipeline_enabled as _pipeline_on
+from ..utils.failpoints import fail_point
 
 from ..spicedb import schema as sch
 from ..utils import devtel, timeline, tracing
@@ -300,6 +302,56 @@ def _readback_pool():
     return _READBACK_POOL
 
 
+# -- off-loop rebuild executor (docs/performance.md "Overload & rebuild
+# behavior") ------------------------------------------------------------------
+# Background graph rebuilds run here, NOT on the event loop's default
+# executor: a 1M-tuple compile must never occupy a thread the query
+# paths (_off_loop) are waiting on.  Two workers so two coexisting
+# endpoints (bench sweeps) can rebuild concurrently; each endpoint
+# serializes its own rebuilds with an in-flight flag.
+
+_REBUILD_POOL = None
+_REBUILD_POOL_LOCK = threading.Lock()
+
+
+def _rebuild_pool():
+    global _REBUILD_POOL
+    if _REBUILD_POOL is None:
+        with _REBUILD_POOL_LOCK:
+            if _REBUILD_POOL is None:
+                import concurrent.futures
+                _REBUILD_POOL = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="authz-rebuild")
+    return _REBUILD_POOL
+
+
+class _GenState:
+    """One device-graph generation's full host-side state, built as a
+    candidate OFF the endpoint lock and installed atomically under it
+    (the off-loop rebuild's unit of swap).  Field names mirror the
+    endpoint's live attributes so the delta-application machinery
+    (`_apply_batches` and friends) runs identically on the live
+    generation (`st=self`) and on a candidate mid-replay."""
+
+    __slots__ = ("_graph", "_graph_revision", "_spare_pool",
+                 "_assigned_refs", "_spare_seq", "_caveated_pairs",
+                 "_caveat_affected", "_caveated_keys", "_expiry_heap",
+                 "_expiry_meta", "_stale_pairs")
+
+    def __init__(self):
+        self._graph = None
+        self._graph_revision = 0
+        self._spare_pool: dict = {}
+        self._assigned_refs: dict = {}
+        self._spare_seq = 0
+        self._caveated_pairs: set = set()
+        self._caveat_affected: set = set()
+        self._caveated_keys: set = set()
+        self._expiry_heap: list = []
+        self._expiry_meta: dict = {}
+        self._stale_pairs: set = set()
+
+
 def _start_readback(dev, batch_id, bucket: int, sweep_bytes: int,
                     kind: str, on_error=None):
     """Submit the async readback of a dispatched device result; returns
@@ -310,6 +362,10 @@ def _start_readback(dev, batch_id, bucket: int, sweep_bytes: int,
 
     def wait_and_fetch():
         try:
+            # kill-matrix site (tests/test_faultmatrix.py): a waiter
+            # dying here must fail its batch fast, discard the donated
+            # arena via on_error, and leave the ledger consistent
+            fail_point("readbackWaiter")
             dev.block_until_ready()
             t_ready = timeline.now()
             # the true device window: dispatch -> results ready (includes
@@ -372,6 +428,32 @@ def _rel_from_key(key: tuple) -> Relationship:
                         subject=SubjectRef(key[3], key[4], key[5]))
 
 
+def _unify_check_buckets(q_arr, gather_idx, gather_col, dead) -> tuple:
+    """Bucket a check batch's gather arrays so their jit key lands on a
+    shape the prewarm ladder compiled.  The check kernels jit-retrace
+    per (lanes, gather) shape pair; an independent gather ladder put
+    small real batches (gather <= lanes) on sub-diagonal keys prewarm
+    never compiled — each one a multi-second lazy XLA compile on the
+    hot path.  The gather is floored at the lane width (padded slots
+    re-read (row 0, col 0) and are discarded by the caller — free),
+    putting every gather <= lanes batch on the prewarmed diagonal.
+    The query lanes are NEVER padded: q_arr's length keys the donated
+    arena pool (arena_key) and the sweep cost, so inflating it to a
+    large gather bucket would multiply every such batch's kernel work
+    (a 4096-request single-subject postfilter check would sweep 4096
+    lanes instead of 32).  gather > lanes batches therefore keep
+    supra-diagonal keys — prewarm walks those pairs up the ladder, and
+    beyond-ladder shapes pay one attributed compile on first use
+    (shape_args in timeline.time_first_call).  `dead` is unused but
+    kept so call sites document the pad value the lanes already carry."""
+    g = max(bucket(len(gather_idx), _MIN_BATCH_BUCKET), len(q_arr))
+    gi = np.zeros(g, np.int32)
+    gc = np.zeros(g, np.int32)
+    gi[: len(gather_idx)] = gather_idx
+    gc[: len(gather_col)] = gather_col
+    return q_arr, gi, gc
+
+
 class _PrewarmMixin:
     """Compile-prewarm of the common pow-2 bucket ladder, shared by the
     segment and ELL graphs (warm_start(prewarm=True))."""
@@ -398,20 +480,32 @@ class _PrewarmMixin:
         dead = self.prog.dead_index
         snap = self.snapshot()
         warmed = 0
-        for b in lanes:
-            b = self.batch_bucket(b)
+        buckets = sorted({self.batch_bucket(b) for b in lanes})
+        g_max = buckets[-1] if buckets else 0
+        for b in buckets:
             q = np.full(b, dead, np.int32)
-            gi = np.zeros(b, np.int32)
-            gc = np.zeros(b, np.int32)
-            t0 = timeline.now()
-            if pipelined:
-                dev, _ = self.run_checks3_device(q, gi, gc, snap=snap)
-                np.asarray(dev)
-            else:
-                self.run_checks3(q, gi, gc, snap=snap)
-            timeline.record("compile", "rebuild", t0, bucket=b,
-                            prewarm="checks")
-            warmed += 1
+            # checks jit-key per (lanes, gather) shape pair.  Real
+            # batches sit on the diagonal (gather floored at the lane
+            # width, _unify_check_buckets) or ABOVE it (more gather
+            # slots than distinct subjects — the many-requests-per-
+            # subject postfilter shape), so walk gather from b up the
+            # ladder; beyond-ladder gathers pay one attributed compile
+            # on first use.
+            g = b
+            while g <= g_max:
+                gi = np.zeros(g, np.int32)
+                gc = np.zeros(g, np.int32)
+                t0 = timeline.now()
+                if pipelined:
+                    dev, _ = self.run_checks3_device(q, gi, gc, snap=snap)
+                    np.asarray(dev)
+                else:
+                    self.run_checks3(q, gi, gc, snap=snap)
+                timeline.record("compile", "rebuild", t0, bucket=b,
+                                prewarm="checks" if g == b
+                                else f"checks:g{g}")
+                warmed += 1
+                g *= 2
             for (off, length) in slot_ranges:
                 t0 = timeline.now()
                 if pipelined:
@@ -422,7 +516,33 @@ class _PrewarmMixin:
                 timeline.record("compile", "rebuild", t0, bucket=b,
                                 prewarm=f"lookup:{off}")
                 warmed += 1
+        warmed += self.prewarm_flush()
         return warmed
+
+    # delta-flush scatter ladder (pad_scatter buckets dirty-row counts
+    # at a floor of 16; drains bigger than 512 rows are rare enough to
+    # eat their one compile when they first happen)
+    _FLUSH_PREWARM_BUCKETS = (16, 32, 64, 128, 256, 512)
+
+    def prewarm_flush(self) -> int:
+        """Compile the delta-flush scatter ladder NOW: flush() runs
+        `.at[rows].set(vals)` with pad_scatter-bucketed row counts, and
+        each novel (table, bucket) shape is a lazy XLA scatter compile
+        (~0.4s on CPU) that would otherwise land under the endpoint
+        lock on the first drain of that size — a request-visible stall
+        the churn soak flags.  Idempotent: every scatter rewrites row 0
+        with its current value."""
+        warmed = 0
+        for b in self._FLUSH_PREWARM_BUCKETS:
+            t0 = timeline.now()
+            if self._prewarm_flush_bucket(b):
+                warmed += 1
+                timeline.record("compile", "rebuild", t0, bucket=b,
+                                prewarm="flush")
+        return warmed
+
+    def _prewarm_flush_bucket(self, b: int) -> bool:  # per-graph
+        return False
 
 
 class _SegmentGraph(_PrewarmMixin):
@@ -530,6 +650,22 @@ class _SegmentGraph(_PrewarmMixin):
         self._updates = {}
         return True
 
+    def _prewarm_flush_bucket(self, b: int) -> bool:
+        """Idempotent `.at[pos].set` on both edge arrays at dirty-edge
+        bucket `b` (position 0 rewritten with its current value), so
+        flush()'s scatter shapes are compiled before churn arrives.
+        Does NOT clear sorted_edges — nothing changed."""
+        if not len(self.edge_src):
+            return False
+        s0 = int(self.edge_src[0])
+        d0 = int(self.edge_dst[0])
+        pos = jnp.asarray(np.zeros(b, np.int32))
+        self.edge_src = self.edge_src.at[pos].set(
+            jnp.asarray(np.full(b, s0, np.int32))).block_until_ready()
+        self.edge_dst = self.edge_dst.at[pos].set(
+            jnp.asarray(np.full(b, d0, np.int32))).block_until_ready()
+        return True
+
     # -- queries ------------------------------------------------------------
 
     def batch_bucket(self, n: int) -> int:
@@ -545,11 +681,11 @@ class _SegmentGraph(_PrewarmMixin):
     def run_checks(self, q_arr, gather_idx, gather_col,
                    snap=None) -> np.ndarray:
         kern, src, dst = snap if snap is not None else self.snapshot()
-        g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
-        gi = np.zeros(g, np.int32)
-        gc = np.zeros(g, np.int32)
-        gi[: len(gather_idx)] = gather_idx
-        gc[: len(gather_col)] = gather_col
+        # unify lanes and gather into ONE bucket so every check lands
+        # on a diagonal jit key the prewarm ladder already compiled —
+        # see _EllGraph.run_checks3
+        q_arr, gi, gc = _unify_check_buckets(
+            q_arr, gather_idx, gather_col, self.prog.dead_index)
         return kern.checks(q_arr, gi, gc, src, dst)
 
     def run_checks3(self, q_arr, gather_idx, gather_col,
@@ -566,11 +702,9 @@ class _SegmentGraph(_PrewarmMixin):
 
     def run_checks3_device(self, q_arr, gather_idx, gather_col, snap=None):
         kern, src, dst = snap if snap is not None else self.snapshot()
-        g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
-        gi = np.zeros(g, np.int32)
-        gc = np.zeros(g, np.int32)
-        gi[: len(gather_idx)] = gather_idx
-        gc[: len(gather_col)] = gather_col
+        # same bucket unification as run_checks (prewarm-diagonal keys)
+        q_arr, gi, gc = _unify_check_buckets(
+            q_arr, gather_idx, gather_col, self.prog.dead_index)
         return kern.checks3_device(q_arr, gi, gc, src, dst), kern
 
     def run_lookup_T_device(self, offset: int, length: int, q_arr,
@@ -837,6 +971,27 @@ class _EllGraph(_PrewarmMixin):
             changed = True
         return changed
 
+    def _prewarm_flush_bucket(self, b: int) -> bool:
+        """One idempotent `.at[rows].set` per device table at dirty-row
+        bucket `b` (row 0 rewritten with its current host values), so
+        flush()'s scatter shapes are compiled before churn arrives."""
+        rows = np.zeros(b, np.int32)
+        jrows = jnp.asarray(rows)
+        done = False
+        if len(self.host_main):
+            self.dev_main = self.dev_main.at[jrows].set(
+                jnp.asarray(self.host_main[rows])).block_until_ready()
+            done = True
+        if len(self.host_aux):
+            self.dev_aux = self.dev_aux.at[jrows].set(
+                jnp.asarray(self.host_aux[rows])).block_until_ready()
+            done = True
+        if self.host_cav is not None and len(self.host_cav):
+            self.dev_cav = self.dev_cav.at[jrows].set(
+                jnp.asarray(self.host_cav[rows])).block_until_ready()
+            done = True
+        return done
+
     # -- queries ------------------------------------------------------------
 
     def batch_bucket(self, n: int) -> int:
@@ -864,11 +1019,15 @@ class _EllGraph(_PrewarmMixin):
                     snap=None) -> np.ndarray:
         """Tri-state check values {0: NO, 1: CONDITIONAL, 2: HAS}."""
         main, aux, cav = snap if snap is not None else self.snapshot()
-        g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
-        gi = np.zeros(g, np.int32)
-        gc = np.zeros(g, np.int32)
-        gi[: len(gather_idx)] = gather_idx
-        gc[: len(gather_col)] = gather_col
+        # lanes and gather unified into ONE bucket: the check jit
+        # retraces per (lanes, gather) SHAPE pair, so independent
+        # ladders would put small fused batches on off-diagonal keys
+        # the prewarm ladder never compiled — a multi-second
+        # first-request stall.  Padding the smaller side up (dead query
+        # lanes converge in one sweep; gather duplicates of slot 0 are
+        # discarded) keeps every batch on the prewarmed diagonal.
+        q_arr, gi, gc = _unify_check_buckets(
+            q_arr, gather_idx, gather_col, self.prog.dead_index)
         n_words = max(1, len(q_arr) // 32)
         out = self.kernel.checks(q_arr, n_words, gi, gc, main, aux, cav)
         if not self.has_cav:
@@ -893,11 +1052,9 @@ class _EllGraph(_PrewarmMixin):
 
     def run_checks3_device(self, q_arr, gather_idx, gather_col, snap=None):
         main, aux, cav = snap if snap is not None else self.snapshot()
-        g = bucket(len(gather_idx), _MIN_BATCH_BUCKET)
-        gi = np.zeros(g, np.int32)
-        gc = np.zeros(g, np.int32)
-        gi[: len(gather_idx)] = gather_idx
-        gc[: len(gather_col)] = gather_col
+        # same bucket unification as run_checks3 (prewarm-diagonal keys)
+        q_arr, gi, gc = _unify_check_buckets(
+            q_arr, gather_idx, gather_col, self.prog.dead_index)
         n_words = max(1, len(q_arr) // 32)
         return self.kernel.checks_device(q_arr, n_words, gi, gc,
                                          main, aux, cav), self.kernel
@@ -1064,7 +1221,42 @@ class JaxEndpoint(PermissionsEndpoint):
         # scrape-time gauges from the keys present at construction
         self.stats = {"rebuilds": 0, "delta_batches": 0, "kernel_calls": 0,
                       "oracle_residual_checks": 0, "spare_assignments": 0,
-                      "spare_reclaims": 0, "explain_checks": 0}
+                      "spare_reclaims": 0, "explain_checks": 0,
+                      "bg_rebuilds": 0, "preemptive_rebuilds": 0,
+                      "rebuild_failures": 0, "stale_pair_marks": 0,
+                      "stale_routed": 0}
+        # off-loop rebuild state (AsyncRebuild gate; docs/performance.md
+        # "Overload & rebuild behavior").  While a background rebuild is
+        # in flight the OLD generation keeps serving: deltas it can
+        # absorb apply normally (full consistency), deltas it cannot
+        # mark their affected (type, permission) closure STALE and those
+        # pairs route to the host oracle until the swap clears them —
+        # reads never block on a rebuild and never observe a revision
+        # the answer doesn't reflect.
+        self._stale_pairs: set = set()
+        self._stale_closure_cache: dict = {}   # (type, rel) -> pair set
+        self._bg_inflight = False
+        self._bg_future = None
+        self._bg_pending: Optional[collections.deque] = None
+        self._bg_epoch = 0
+        self._bg_not_before = 0.0
+        # generation epoch: bumped at every install; a background
+        # candidate built against epoch N abandons itself if a sync
+        # rebuild (force_rebuild, bulk-load reset) installed N+1 first
+        self._gen_epoch = 0
+        # monotone counter over rebuild lifecycle events (start +
+        # install), exposed to wrappers that need a cheap "did a rebuild
+        # overlap this operation" token
+        self._rebuild_epoch = 0
+        # initial spare-pool sizes of the live generation, for the
+        # low-watermark preemptive rebuild (_spare_pressure)
+        self._spare_initial: dict = {}
+        self._spare_aux_initial = 0
+        # compile the pow-2 bucket ladder on background CANDIDATES
+        # before the swap (the server flips this on with
+        # --prewarm-compiles) so a fresh generation's first requests
+        # recompile nothing
+        self.prewarm_rebuilds = False
         self._spare_pool: dict = {}
         # (type, id) -> live tuple keys, for spare-ASSIGNED ids only: when
         # the set empties the row is renamed back to a placeholder and
@@ -1125,31 +1317,36 @@ class JaxEndpoint(PermissionsEndpoint):
             graph = self._graph
         if not prewarm or graph is None:
             return
-        fn = getattr(graph, "prewarm", None)
-        if fn is None:
+        if getattr(graph, "prewarm", None) is None:
             return
-        slot_ranges = []
-        for t, d in self.schema.definitions.items():
-            for p in d.permissions:
-                rng = graph.prog.slot_range(t, p)
-                if rng is not None:
-                    slot_ranges.append(rng)
-            if len(slot_ranges) >= self._PREWARM_SLOT_CAP:
-                break
+        slot_ranges = self._prewarm_slot_ranges(graph)
         t0 = timeline.now()
-        warmed = fn(lanes=self._PREWARM_LANES,
-                    slot_ranges=slot_ranges[: self._PREWARM_SLOT_CAP],
-                    pipelined=_pipeline_on())
+        # same helper the off-loop rebuild uses on its candidate
+        # generations (_bg_rebuild_run), so startup and post-swap
+        # prewarm coverage can never silently diverge
+        warmed = self._prewarm_graph(graph)
         _log.info("prewarmed %d kernel entry points (%d buckets x %d "
                   "lookup slots + checks) in %.1fs",
-                  warmed, len(self._PREWARM_LANES),
-                  min(len(slot_ranges), self._PREWARM_SLOT_CAP),
+                  warmed, len(self._PREWARM_LANES), len(slot_ranges),
                   timeline.now() - t0)
 
     # -- delta intake -------------------------------------------------------
 
     def _on_delta(self, update: WatchUpdate) -> None:
-        # called under the store lock — must not acquire self._lock
+        # called under the store lock — must not acquire self._lock.
+        # The background intake MUST be appended BEFORE self._pending:
+        # in the reverse order this thread can be preempted after the
+        # _pending append, the foreground drains it onto the OLD
+        # generation, the rebuild replays (without this delta) and
+        # swaps — and the delta is lost from the new generation.  With
+        # bg-first the delta is either in the intake before the swap's
+        # drain (replayed onto the candidate) or appended after the
+        # swap nulled the attribute, in which case _pending still holds
+        # it for the new generation's next drain (re-application of a
+        # delta the candidate also replayed is idempotent by design).
+        bg = self._bg_pending
+        if bg is not None:
+            bg.append(update)
         self._pending.append(update)
 
     def _on_reset(self) -> None:
@@ -1201,52 +1398,64 @@ class JaxEndpoint(PermissionsEndpoint):
                                num_iters=self._num_iters)
 
     def _rebuild(self) -> None:
-        # a rebuild reflects the current store snapshot; any queued deltas
-        # are subsumed by it (re-application of a delta already inside the
-        # snapshot is idempotent).  The snapshot reads and the revision
-        # capture hold the STORE lock together so checked_at can never
-        # name a revision other than the one the graph reflects (checks
-        # run off-loop now, so writes race the rebuild).
+        """Synchronous rebuild under the endpoint lock: first build,
+        wholesale store resets (bulk_load/delete_all), force_rebuild,
+        and the AsyncRebuild-gate-off killswitch path.  Queued deltas
+        are subsumed by the snapshot (re-application of a delta already
+        inside it is idempotent)."""
         t_rebuild = timeline.now()
         self._drain_pending()
         self._graph_invalid = False
-        _evict_id_views(self._graph)
+        st = self._build_candidate()
+        self._install_candidate(st, t_rebuild, mode="sync")
+
+    def _build_candidate(self) -> "_GenState":
+        """Build a complete candidate generation from the current store
+        snapshot WITHOUT mutating endpoint state — callable from the
+        background rebuild executor while the live generation keeps
+        serving.  The snapshot reads and the revision capture hold the
+        STORE lock together so checked_at can never name a revision
+        other than the one the graph reflects."""
+        # kill-matrix site: a rebuild executor crashing here must leave
+        # the old generation serving (tests/test_faultmatrix.py)
+        fail_point("rebuildExecutor")
+        st = _GenState()
         # phantom-subject columns (one reserved column per type so
         # first-contact subjects still hit the kernel) + the spare object
         # pool for rebuild-free object creation.  Pool size amortizes the
         # rebuild: sized from the larger of the previous program's
         # universe (covers subject-only types) and the store's current
         # per-type resource counts (covers the first rebuild after a
-        # bulk_load, where no previous program exists).
-        prev_counts = (self._graph.prog.num_objects
-                       if self._graph is not None else {})
-        # num_objects includes the previous generation's synthetic rows
-        # (1 phantom + the unassigned spare placeholders); subtract them
-        # so pool sizing tracks the REAL universe instead of compounding
-        # by ~1/64 at every rebuild (assigned spares are real objects now
-        # and correctly stay counted)
-        prev_synthetic = ({t: 1 + len(pool)
-                           for t, pool in self._spare_pool.items()}
-                          if self._graph is not None else {})
+        # bulk_load, where no previous program exists).  The live-
+        # generation reads are taken under the endpoint lock (cheap);
+        # the compile below runs with no endpoint lock at all.
+        with self._lock:
+            prev_counts = (self._graph.prog.num_objects
+                           if self._graph is not None else {})
+            # num_objects includes the previous generation's synthetic
+            # rows (1 phantom + the unassigned spare placeholders);
+            # subtract them so pool sizing tracks the REAL universe
+            # instead of compounding by ~1/64 at every rebuild (assigned
+            # spares are real objects now and correctly stay counted)
+            prev_synthetic = ({t: 1 + len(pool)
+                               for t, pool in self._spare_pool.items()}
+                              if self._graph is not None else {})
         extra = {}
-        self._spare_pool = {}
         for t in self.schema.definitions:
             n_t = max(prev_counts.get(t, 0) - prev_synthetic.get(t, 0),
                       len(self.store.object_ids_of_type(t)))
             n_spare = max(_SPARE_FLOOR, n_t // _SPARE_DIVISOR)
             spares = [f"{_SPARE_PREFIX}{k}" for k in range(n_spare)]
             extra[t] = {PHANTOM_ID, *spares}
-            self._spare_pool[t] = spares
-        self._assigned_refs = {}
-        self._spare_seq = 0
+            st._spare_pool[t] = spares
         with self.store.lock:
-            snapshot_revision = self.store.revision
-            self._caveated_pairs = self.store.caveated_relation_pairs()
-            self._caveat_affected = (
-                caveat_affected_pairs(self.schema, self._caveated_pairs)
-                if self._caveated_pairs else set())
-            self._caveated_keys = (self.store.caveated_keys()
-                                   if self._caveated_pairs else set())
+            st._graph_revision = self.store.revision
+            st._caveated_pairs = self.store.caveated_relation_pairs()
+            st._caveat_affected = (
+                caveat_affected_pairs(self.schema, st._caveated_pairs)
+                if st._caveated_pairs else set())
+            st._caveated_keys = (self.store.caveated_keys()
+                                 if st._caveated_pairs else set())
             view = self.store.columnar_view() \
                 if self._graph_cls is _EllGraph or self.mesh is not None \
                 else None
@@ -1261,62 +1470,101 @@ class JaxEndpoint(PermissionsEndpoint):
             prog = compile_graph_columnar(self.schema, snap, rows, overlay,
                                           extra_subject_ids=extra)
             graph = self._make_graph(prog)
-            self._reset_expiry_columnar(snap, rows, overlay)
+            self._reset_expiry_columnar(st, snap, rows, overlay)
         else:
             prog = compile_graph(self.schema, tuples, extra_subject_ids=extra)
             graph = self._make_graph(prog)
             graph.index_tuples(tuples)
-            self._reset_expiry(tuples)
-        self._graph = graph
-        self._graph_revision = snapshot_revision
+            self._reset_expiry(st, tuples)
+        st._graph = graph
+        return st
+
+    def _install_candidate(self, st: "_GenState", t_start: float,
+                           mode: str = "sync") -> None:
+        """Atomically swap a candidate generation in (MUST hold
+        self._lock): the short-lock tail of both the sync and the
+        off-loop rebuild paths."""
+        _evict_id_views(self._graph)
+        self._graph = st._graph
+        self._graph_revision = st._graph_revision
+        self._spare_pool = st._spare_pool
+        self._assigned_refs = st._assigned_refs
+        self._spare_seq = st._spare_seq
+        self._caveated_pairs = st._caveated_pairs
+        self._caveat_affected = st._caveat_affected
+        self._caveated_keys = st._caveated_keys
+        self._expiry_heap = st._expiry_heap
+        self._expiry_meta = st._expiry_meta
+        # the candidate's unresolved stale pairs (replay kept failing)
+        # carry over — they keep routing to the oracle and re-arm the
+        # follow-up rebuild; a clean candidate clears the set
+        self._stale_pairs = set(st._stale_pairs)
+        self._spare_initial = {t: len(p) for t, p in st._spare_pool.items()}
+        self._spare_aux_initial = len(getattr(st._graph, "_spare_aux", ()))
+        self._gen_epoch += 1
+        self._rebuild_epoch += 1
         self.stats["rebuilds"] += 1
+        if mode != "sync":
+            # bg_rebuilds counts every off-loop INSTALL (preemptive
+            # included); preemptive_rebuilds is the subset kicked by the
+            # spare low-watermark.  Both count at install, same as the
+            # authz_rebuilds_total{mode=} metric — an abandoned
+            # candidate (epoch race, store reset) counts nowhere, so
+            # the soak verdict and the Prometheus counter reconcile.
+            self.stats["bg_rebuilds"] += 1
+        if mode == "preemptive":
+            self.stats["preemptive_rebuilds"] += 1
+        devtel.REBUILDS.note_rebuild(mode)
         # HBM ledger: the new generation registers, the outgoing one
         # retires wholesale — a leaked old-generation buffer shows up as
         # a non-returning total within one scrape.  The delta is logged
         # per rebuild/warm-start so leak forensics need no scrape at all.
         old_gen = self._devtel_gen
         self._devtel_gen = devtel.next_generation()
-        added = _register_graph_buffers(graph, self._devtel_gen)
+        added = _register_graph_buffers(st._graph, self._devtel_gen)
         freed = devtel.LEDGER.retire_generation(old_gen) if old_gen else 0
-        # timeline: the rebuild span is the stall window the flight
-        # recorder's p99 spikes point at (ROADMAP item 4); bytes = the
-        # new generation's registered device footprint
-        timeline.record("rebuild", "rebuild", t_rebuild, nbytes=added,
-                        generation=self._devtel_gen)
-        _log.info("device graph rebuild: generation %d registered %d bytes"
-                  "%s; ledger total %d bytes (peak %d)",
-                  self._devtel_gen, added,
+        # timeline: the rebuild span covers build start -> swap.  Off-
+        # loop modes tag background=True so stall attribution can tell
+        # "a rebuild ran" from "a rebuild stalled requests" — with the
+        # old generation serving throughout, this span is no longer a
+        # request stall.
+        timeline.record("rebuild", "rebuild", t_start, nbytes=added,
+                        generation=self._devtel_gen, mode=mode,
+                        background=mode != "sync")
+        _log.info("device graph rebuild (%s): generation %d registered "
+                  "%d bytes%s; ledger total %d bytes (peak %d)",
+                  mode, self._devtel_gen, added,
                   f", generation {old_gen} retired {freed} bytes"
                   if old_gen else "",
                   devtel.LEDGER.total(), devtel.LEDGER.peak)
 
-    def _reset_expiry_columnar(self, snap, rows, overlay) -> None:
-        self._expiry_heap = []
-        self._expiry_meta = {}
+    def _reset_expiry_columnar(self, st, snap, rows, overlay) -> None:
+        st._expiry_heap = []
+        st._expiry_meta = {}
         exp = snap.expiry[rows]
         for i in np.nonzero(~np.isnan(exp))[0]:
             key = snap.key_of(int(rows[i]))
-            self._expiry_meta[key] = float(exp[i])
-            heapq.heappush(self._expiry_heap, (float(exp[i]), key))
+            st._expiry_meta[key] = float(exp[i])
+            heapq.heappush(st._expiry_heap, (float(exp[i]), key))
         for rel in overlay:
             if rel.expires_at is not None:
-                self._expiry_meta[rel.key()] = rel.expires_at
-                heapq.heappush(self._expiry_heap, (rel.expires_at, rel.key()))
+                st._expiry_meta[rel.key()] = rel.expires_at
+                heapq.heappush(st._expiry_heap, (rel.expires_at, rel.key()))
 
-    def _reset_expiry(self, tuples: list) -> None:
-        self._expiry_heap = []
-        self._expiry_meta = {}
+    def _reset_expiry(self, st, tuples: list) -> None:
+        st._expiry_heap = []
+        st._expiry_meta = {}
         for rel in tuples:
             if rel.expires_at is not None:
-                self._expiry_meta[rel.key()] = rel.expires_at
-                heapq.heappush(self._expiry_heap, (rel.expires_at, rel.key()))
+                st._expiry_meta[rel.key()] = rel.expires_at
+                heapq.heappush(st._expiry_heap, (rel.expires_at, rel.key()))
 
-    def _set_expiry(self, key: tuple, expires_at) -> None:
+    def _set_expiry(self, st, key: tuple, expires_at) -> None:
         if expires_at is None:
-            self._expiry_meta.pop(key, None)
+            st._expiry_meta.pop(key, None)
         else:
-            self._expiry_meta[key] = expires_at
-            heapq.heappush(self._expiry_heap, (expires_at, key))
+            st._expiry_meta[key] = expires_at
+            heapq.heappush(st._expiry_heap, (expires_at, key))
 
     def _caveat_decidability(self, rel: Relationship):
         """Mirror of the compiler's caveat resolution (_emit_tuple_edges):
@@ -1331,19 +1579,25 @@ class JaxEndpoint(PermissionsEndpoint):
         except Exception:
             return "unsupported"
 
-    def _assign_spare(self, graph, type_name: str, new_id: str) -> bool:
+    def _assign_spare(self, st, graph, type_name: str, new_id: str) -> bool:
         """Claim a spare row for a brand-new object id by renaming it in
         the program's id maps (slot layout, row count, and device tables
         are untouched — the row exists, dead, in every slot of the type).
-        Runs under self._lock; the graph's cached numpy id view is
-        patched copy-on-write (see _rename_row — never invalidated, and
-        never mutated in place across a drain-epoch boundary)."""
-        pool = self._spare_pool.get(type_name)
+        Runs under self._lock (st is the live endpoint or a candidate
+        generation being replayed at swap time); the graph's cached
+        numpy id view is patched copy-on-write (see _rename_row — never
+        invalidated, and never mutated in place across a drain-epoch
+        boundary)."""
+        pool = st._spare_pool.get(type_name)
         if not pool:
             return False
         self._rename_row(graph, type_name, pool.pop(), new_id)
-        self._assigned_refs[(type_name, new_id)] = set()
-        self.stats["spare_assignments"] += 1
+        st._assigned_refs[(type_name, new_id)] = set()
+        if st is self:
+            # candidate-replay applications re-apply deltas the live
+            # generation already counted — counting both would double
+            # every churn stat across a background rebuild window
+            self.stats["spare_assignments"] += 1
         return True
 
     def _rename_row(self, graph, type_name: str, old_id: str,
@@ -1386,35 +1640,36 @@ class JaxEndpoint(PermissionsEndpoint):
                 mask[local] = "\x00" in new_id
         return True
 
-    def _note_key_applied(self, key: tuple) -> None:
+    def _note_key_applied(self, st, key: tuple) -> None:
         """Record a live tuple against any spare-assigned ids it names."""
         for side in ((key[0], key[1]), (key[3], key[4])):
-            refs = self._assigned_refs.get(side)
+            refs = st._assigned_refs.get(side)
             if refs is not None:
                 refs.add(key)
 
-    def _note_key_removed(self, graph, key: tuple) -> None:
+    def _note_key_removed(self, st, graph, key: tuple) -> None:
         """Drop a tuple from its ids' ref sets; an emptied set reclaims
         the spare row (rename back to a fresh placeholder + repool)."""
         for side in ((key[0], key[1]), (key[3], key[4])):
-            refs = self._assigned_refs.get(side)
+            refs = st._assigned_refs.get(side)
             if refs is None:
                 continue
             refs.discard(key)
             if not refs:
-                self._reclaim_spare(graph, side)
+                self._reclaim_spare(st, graph, side)
 
-    def _reclaim_spare(self, graph, side: tuple) -> None:
+    def _reclaim_spare(self, st, graph, side: tuple) -> None:
         t, old_id = side
-        self._assigned_refs.pop(side, None)
-        self._spare_seq += 1
-        placeholder = f"{_SPARE_PREFIX}r{self._spare_seq}"
+        st._assigned_refs.pop(side, None)
+        st._spare_seq += 1
+        placeholder = f"{_SPARE_PREFIX}r{st._spare_seq}"
         if not self._rename_row(graph, t, old_id, placeholder):
             return
-        self._spare_pool.setdefault(t, []).append(placeholder)
-        self.stats["spare_reclaims"] += 1
+        st._spare_pool.setdefault(t, []).append(placeholder)
+        if st is self:  # not candidate replay (see _assign_spare)
+            self.stats["spare_reclaims"] += 1
 
-    def _ensure_ids_for(self, graph, rel: Relationship) -> bool:
+    def _ensure_ids_for(self, st, graph, rel: Relationship) -> bool:
         """Make every id a TOUCHed tuple names indexable, assigning spare
         rows to new ones; False (pool dry / unknown type combination)
         forces a rebuild."""
@@ -1426,12 +1681,12 @@ class JaxEndpoint(PermissionsEndpoint):
             # will report no edges — never spend spare rows on it
             return True
         if rt in prog.object_index and rid not in prog.object_index[rt]:
-            if not self._assign_spare(graph, rt, rid):
+            if not self._assign_spare(st, graph, rt, rid):
                 return False
-        st, sid = rel.subject.type, rel.subject.id
-        if (st in prog.object_index and sid != WILDCARD
-                and sid not in prog.object_index[st]):
-            if not self._assign_spare(graph, st, sid):
+        stype, sid = rel.subject.type, rel.subject.id
+        if (stype in prog.object_index and sid != WILDCARD
+                and sid not in prog.object_index[stype]):
+            if not self._assign_spare(st, graph, stype, sid):
                 return False
         return True
 
@@ -1444,6 +1699,145 @@ class JaxEndpoint(PermissionsEndpoint):
             except IndexError:
                 return out
 
+    def _stale_closure(self, resource_type: str, relation: str) -> set:
+        """(type, permission) pairs whose answers could depend on tuples
+        of (resource_type, relation) — the reachability closure used for
+        caveat routing, reused to quarantine pairs the live graph can no
+        longer answer (an unapplicable delta).  Memoized per schema
+        (static)."""
+        key = (resource_type, relation)
+        out = self._stale_closure_cache.get(key)
+        if out is None:
+            out = set(caveat_affected_pairs(self.schema, {key}))
+            self._stale_closure_cache[key] = out
+        return out
+
+    def _apply_batches(self, st, batches: list) -> tuple:
+        """Apply drained delta batches + due expirations to one
+        generation's graph (under self._lock).  `st` is the live
+        endpoint or a background candidate mid-replay.
+
+        An update the graph cannot absorb (wildcard change, new id with
+        the spare pool dry, unsupported caveat shape, grown hub budget
+        exhausted) no longer aborts the drain: its affected
+        (type, permission) closure is collected into the returned stale
+        set — the caller routes those pairs to the host oracle and
+        schedules an off-loop rebuild — and application continues, so
+        one hard delta cannot stall every other write.  Returns
+        (stale pairs, applied revision); the caller flushes."""
+        graph = st._graph
+        stale: set = set()
+        applied_revision = st._graph_revision
+        cav_deltas = getattr(graph, "supports_cav_deltas", False)
+        for batch in batches:
+            applied_revision = max(applied_revision, batch.revision)
+            for u in batch.updates:
+                key = u.rel.key()
+                rt, relation = u.rel.resource.type, u.rel.relation
+                if u.op == UpdateOp.DELETE:
+                    if u.rel.subject.id == WILDCARD:
+                        # wildcard contributions are baked into the
+                        # compiled program's masks; only a rebuild
+                        # removes them
+                        stale |= self._stale_closure(rt, relation)
+                        continue
+                    self._set_expiry(st, key, None)
+                    if key in st._caveated_keys:
+                        # caveated tuples can occupy the definite tables
+                        # (context decided True) or the MAYBE plane
+                        # (undecidable): clear both placements
+                        if not (cav_deltas and graph.remove_key(key)
+                                and graph.remove_cav_key(key)):
+                            stale |= self._stale_closure(rt, relation)
+                            continue
+                        st._caveated_keys.discard(key)
+                        self._note_key_removed(st, graph, key)
+                        continue
+                    if not graph.remove_key(key):
+                        stale |= self._stale_closure(rt, relation)
+                        continue
+                    self._note_key_removed(st, graph, key)
+                elif u.rel.caveat is not None:  # TOUCH, caveated
+                    self._set_expiry(st, key, u.rel.expires_at)
+                    if not self._ensure_ids_for(st, graph, u.rel):
+                        stale |= self._stale_closure(rt, relation)
+                        continue
+                    value = self._caveat_decidability(u.rel)
+                    if value == "unsupported" or not cav_deltas:
+                        stale |= self._stale_closure(rt, relation)
+                        continue
+                    # a re-touch may change the caveat's decidability
+                    # (context edits): clear any previous placement, then
+                    # insert per the new value
+                    if not (graph.remove_key(key)
+                            and graph.remove_cav_key(key)):
+                        stale |= self._stale_closure(rt, relation)
+                        continue
+                    st._caveated_keys.add(key)
+                    st._caveated_pairs.add((rt, relation))
+                    if value is True:
+                        if not graph.add_rel(u.rel):
+                            stale |= self._stale_closure(rt, relation)
+                            continue
+                    elif value is None:
+                        # MAYBE: needs compiled bitplanes (add_cav_rel
+                        # fails when the graph has none -> the rebuild
+                        # turns them on)
+                        if not graph.add_cav_rel(u.rel):
+                            stale |= self._stale_closure(rt, relation)
+                            continue
+                    # value False: no edges at all
+                    self._note_key_applied(st, key)
+                else:  # TOUCH, definite
+                    self._set_expiry(st, key, u.rel.expires_at)
+                    if not self._ensure_ids_for(st, graph, u.rel):
+                        stale |= self._stale_closure(rt, relation)
+                        continue
+                    if key in st._caveated_keys:
+                        # previously-caveated tuple replaced by a
+                        # definite one: undo its old plane placement
+                        if not (cav_deltas and graph.remove_cav_key(key)):
+                            stale |= self._stale_closure(rt, relation)
+                            continue
+                        st._caveated_keys.discard(key)
+                    if not graph.add_rel(u.rel):
+                        stale |= self._stale_closure(rt, relation)
+                        continue
+                    self._note_key_applied(st, key)
+        # expire lazily AFTER batch processing so expirations registered by
+        # the batches just drained take effect this query; heap entries whose
+        # expiry no longer matches the current metadata are stale (tuple
+        # deleted/re-touched) and skipped.  The STORE clock is the single
+        # time source: reads filter expired tuples with it, so the device
+        # graph must agree or kernel/oracle results diverge at the expiry
+        # instant.
+        now = self.store.now()
+        while st._expiry_heap and st._expiry_heap[0][0] <= now:
+            exp, key = heapq.heappop(st._expiry_heap)
+            if st._expiry_meta.get(key) != exp:
+                continue
+            del st._expiry_meta[key]
+            if key[4] == WILDCARD:
+                stale |= self._stale_closure(key[0], key[2])
+                continue
+            if key in st._caveated_keys:
+                # may occupy the definite tables (decided True) or the
+                # MAYBE plane — clear both placements
+                if not (cav_deltas and graph.remove_key(key)
+                        and graph.remove_cav_key(key)):
+                    stale |= self._stale_closure(key[0], key[2])
+                    continue
+                st._caveated_keys.discard(key)
+                self._note_key_removed(st, graph, key)
+                continue
+            if not graph.remove_key(key):
+                stale |= self._stale_closure(key[0], key[2])
+                continue
+            self._note_key_removed(st, graph, key)
+        if stale and st is self:  # not candidate replay (_assign_spare)
+            self.stats["stale_pair_marks"] += len(stale)
+        return stale, applied_revision
+
     def _apply_pending(self) -> None:
         """Drain store deltas into the device graph (under self._lock)."""
         if self._graph_invalid:
@@ -1455,6 +1849,12 @@ class JaxEndpoint(PermissionsEndpoint):
         if graph is None:
             self._rebuild()
             return
+        # re-arm a needed rebuild (pairs still quarantined after a
+        # crashed/abandoned background attempt) — rate-limited
+        if (self._stale_pairs and not self._bg_inflight
+                and self._async_rebuild_on()
+                and time.monotonic() >= self._bg_not_before):
+            self._kick_background_rebuild("background")
         batches = self._drain_pending()
         if not batches and not (self._expiry_heap
                                 and self._expiry_heap[0][0]
@@ -1465,122 +1865,21 @@ class JaxEndpoint(PermissionsEndpoint):
         # row flush under the endpoint lock (the rebuild-free churn
         # absorption path); a rebuild taken below records its own span
         t_compact = timeline.now()
-        needs_rebuild = False
-        applied_revision = self._graph_revision
-        cav_deltas = getattr(graph, "supports_cav_deltas", False)
-        for batch in batches:
-            applied_revision = max(applied_revision, batch.revision)
-            for u in batch.updates:
-                key = u.rel.key()
-                if u.op == UpdateOp.DELETE:
-                    if u.rel.subject.id == WILDCARD:
-                        # wildcard contributions are baked into the compiled
-                        # program's masks; only a rebuild removes them
-                        needs_rebuild = True
-                        break
-                    self._set_expiry(key, None)
-                    if key in self._caveated_keys:
-                        # caveated tuples can occupy the definite tables
-                        # (context decided True) or the MAYBE plane
-                        # (undecidable): clear both placements
-                        if not (cav_deltas and graph.remove_key(key)
-                                and graph.remove_cav_key(key)):
-                            needs_rebuild = True
-                            break
-                        self._caveated_keys.discard(key)
-                        self._note_key_removed(graph, key)
-                        continue
-                    if not graph.remove_key(key):
-                        needs_rebuild = True
-                        break
-                    self._note_key_removed(graph, key)
-                elif u.rel.caveat is not None:  # TOUCH, caveated
-                    self._set_expiry(key, u.rel.expires_at)
-                    if not self._ensure_ids_for(graph, u.rel):
-                        needs_rebuild = True
-                        break
-                    value = self._caveat_decidability(u.rel)
-                    if value == "unsupported" or not cav_deltas:
-                        needs_rebuild = True
-                        break
-                    # a re-touch may change the caveat's decidability
-                    # (context edits): clear any previous placement, then
-                    # insert per the new value
-                    if not (graph.remove_key(key)
-                            and graph.remove_cav_key(key)):
-                        needs_rebuild = True
-                        break
-                    self._caveated_keys.add(key)
-                    self._caveated_pairs.add(
-                        (u.rel.resource.type, u.rel.relation))
-                    if value is True:
-                        if not graph.add_rel(u.rel):
-                            needs_rebuild = True
-                            break
-                    elif value is None:
-                        # MAYBE: needs compiled bitplanes (add_cav_rel
-                        # fails when the graph has none -> rebuild turns
-                        # them on)
-                        if not graph.add_cav_rel(u.rel):
-                            needs_rebuild = True
-                            break
-                    # value False: no edges at all
-                    self._note_key_applied(key)
-                else:  # TOUCH, definite
-                    self._set_expiry(key, u.rel.expires_at)
-                    if not self._ensure_ids_for(graph, u.rel):
-                        needs_rebuild = True
-                        break
-                    if key in self._caveated_keys:
-                        # previously-caveated tuple replaced by a definite
-                        # one: undo its old plane placement first
-                        if not (cav_deltas and graph.remove_cav_key(key)):
-                            needs_rebuild = True
-                            break
-                        self._caveated_keys.discard(key)
-                    if not graph.add_rel(u.rel):
-                        needs_rebuild = True
-                        break
-                    self._note_key_applied(key)
-            if needs_rebuild:
-                break
-        # expire lazily AFTER batch processing so expirations registered by
-        # the batches just drained take effect this query; heap entries whose
-        # expiry no longer matches the current metadata are stale (tuple
-        # deleted/re-touched) and skipped.  The STORE clock is the single
-        # time source: reads filter expired tuples with it, so the device
-        # graph must agree or kernel/oracle results diverge at the expiry
-        # instant.
-        now = self.store.now()
-        while (not needs_rebuild and self._expiry_heap
-               and self._expiry_heap[0][0] <= now):
-            exp, key = heapq.heappop(self._expiry_heap)
-            if self._expiry_meta.get(key) != exp:
-                continue
-            del self._expiry_meta[key]
-            if key[4] == WILDCARD:
-                needs_rebuild = True
-                break
-            if key in self._caveated_keys:
-                # may occupy the definite tables (decided True) or the
-                # MAYBE plane — clear both placements
-                if not (getattr(graph, "supports_cav_deltas", False)
-                        and graph.remove_key(key)
-                        and graph.remove_cav_key(key)):
-                    needs_rebuild = True
-                    break
-                self._caveated_keys.discard(key)
-                self._note_key_removed(graph, key)
-                continue
-            if not graph.remove_key(key):
-                needs_rebuild = True
-                break
-            self._note_key_removed(graph, key)
-
-        if needs_rebuild:
+        stale, applied_revision = self._apply_batches(self, batches)
+        if stale and not self._async_rebuild_on():
+            # killswitch path (AsyncRebuild off): reproduce the pre-PR
+            # synchronous rebuild-under-lock — the snapshot subsumes
+            # every drained delta, stale routing never engages
             self._rebuild()
             return
         self._graph_revision = applied_revision
+        if stale:
+            # quarantine: affected pairs route to the host oracle (full
+            # consistency preserved) while the replacement generation
+            # builds off-loop and the old one keeps serving everything
+            # else
+            self._stale_pairs |= stale
+            self._kick_background_rebuild("background")
         flips = getattr(graph, "stage_aux_flips", 0)
         if flips:
             self.stats["stage_aux_flips"] = (
@@ -1590,10 +1889,210 @@ class JaxEndpoint(PermissionsEndpoint):
             self.stats["delta_batches"] += 1
         timeline.record("compact", "rebuild", t_compact,
                         batches=len(batches))
+        if not stale and self._async_rebuild_on() and self._spare_pressure():
+            # low-watermark preemption: rebuild in the background BEFORE
+            # new-object churn drains the spare pool dry, so the pool
+            # refresh is never a request-visible event
+            self._kick_background_rebuild("preemptive")
 
     def _current_graph(self):
         self._apply_pending()
         return self._graph
+
+    # -- off-loop rebuild machinery ------------------------------------------
+
+    _SPARE_LOW_FRACTION = 0.25
+    _BG_RETRY_BACKOFF_S = 1.0
+    _BG_REPLAY_ATTEMPTS = 3
+
+    def _async_rebuild_on(self) -> bool:
+        """AsyncRebuild gate accessor; unknown-gate errors fail CLOSED
+        (sync rebuilds) — the conservative default for a stripped gate
+        registry."""
+        try:
+            from ..utils.features import GATES
+            return GATES.enabled("AsyncRebuild")
+        except Exception:
+            return False
+
+    def _spare_pressure(self) -> bool:
+        """True when the live generation's spare capacity (object pool
+        per type, or the ELL spare-aux grow pool) has dropped below the
+        low watermark — the signal to rebuild preemptively while churn
+        can still be absorbed in place."""
+        for t, init in self._spare_initial.items():
+            if init >= 8 and (len(self._spare_pool.get(t, ()))
+                              < init * self._SPARE_LOW_FRACTION):
+                return True
+        if self._spare_aux_initial >= 8:
+            free_aux = len(getattr(self._graph, "_spare_aux", ()))
+            if free_aux < self._spare_aux_initial * self._SPARE_LOW_FRACTION:
+                return True
+        return False
+
+    def _kick_background_rebuild(self, mode: str) -> None:
+        """Submit one off-loop rebuild (under self._lock); no-op while
+        one is already in flight or inside the failure backoff."""
+        if self._bg_inflight:
+            return
+        if time.monotonic() < self._bg_not_before:
+            return
+        self._bg_inflight = True
+        self._rebuild_epoch += 1
+        self._bg_epoch = self._gen_epoch
+        # open the candidate's delta intake BEFORE the snapshot is
+        # taken: every delta committed from this instant is either
+        # inside the snapshot (idempotent replay) or replayed at swap
+        self._bg_pending = collections.deque()
+        devtel.REBUILDS.note_inflight(+1)
+        try:
+            self._bg_future = _rebuild_pool().submit(self._bg_rebuild_run,
+                                                     mode)
+        except BaseException:
+            # a failed submit (e.g. executor shut down at teardown) must
+            # not leave _bg_inflight latched True — that would disable
+            # background rebuilds for the life of the process and pin
+            # stale pairs on the oracle forever
+            self._bg_pending = None
+            self._bg_inflight = False
+            self._bg_future = None
+            devtel.REBUILDS.note_inflight(-1)
+            self._bg_not_before = (time.monotonic()
+                                   + self._BG_RETRY_BACKOFF_S)
+            _log.exception("background rebuild submit failed; will re-arm")
+
+    def _drain_bg_pending(self) -> list:
+        out = []
+        bg = self._bg_pending
+        if bg is not None:
+            while True:
+                try:
+                    out.append(bg.popleft())
+                except IndexError:
+                    break
+        return out
+
+    def _bg_rebuild_run(self, mode: str) -> None:
+        """Executor body of one off-loop rebuild: build a candidate
+        generation against a store snapshot (no endpoint lock), then
+        under a short lock replay the deltas that accumulated during
+        the build and swap atomically.  A replay that itself hits
+        unapplicable deltas retries from a fresh snapshot; the final
+        attempt installs anyway with the residue quarantined (strictly
+        better than the old generation) and re-arms.  Any crash leaves
+        the old generation serving."""
+        t0 = timeline.now()
+        try:
+            for attempt in range(self._BG_REPLAY_ATTEMPTS):
+                st = self._build_candidate()
+                if self.prewarm_rebuilds:
+                    self._prewarm_graph(st._graph)
+                with self._lock:
+                    if self._gen_epoch != self._bg_epoch:
+                        # a sync rebuild (force_rebuild / store reset)
+                        # installed a newer generation mid-build: this
+                        # candidate is stale wholesale — abandon it
+                        return
+                    if self._graph_invalid:
+                        # bulk_load/delete_all during the build: the
+                        # snapshot predates the reset.  The flag stays
+                        # set — wholesale resets are the foreground's
+                        # job (next query drops the graph and rebuilds
+                        # synchronously); this candidate is abandoned.
+                        return
+                    batches = self._drain_bg_pending()
+                    stale, rev = self._apply_batches(st, batches)
+                    st._graph_revision = max(st._graph_revision, rev)
+                    if stale and attempt < self._BG_REPLAY_ATTEMPTS - 1:
+                        continue  # fresh snapshot subsumes the misfits
+                    st._stale_pairs |= stale
+                    st._graph.flush()
+                    self._install_candidate(st, t0, mode=mode)
+                    if stale:
+                        # residue carried over: back off, then the next
+                        # query's _apply_pending re-arms a follow-up
+                        self._bg_not_before = (time.monotonic()
+                                               + self._BG_RETRY_BACKOFF_S)
+                    return
+            # unreachable: every loop path returns (epoch mismatch and
+            # store resets abandon; the final attempt always installs
+            # with residue quarantined)
+        except BaseException:
+            _log.exception("background device-graph rebuild (%s) failed; "
+                           "the previous generation keeps serving "
+                           "(stale pairs stay oracle-routed)", mode)
+            with self._lock:
+                self.stats["rebuild_failures"] += 1
+                self._bg_not_before = (time.monotonic()
+                                       + self._BG_RETRY_BACKOFF_S)
+        finally:
+            with self._lock:
+                self._bg_pending = None
+                self._bg_inflight = False
+                self._bg_future = None
+            devtel.REBUILDS.note_inflight(-1)
+
+    def _prewarm_graph(self, graph) -> int:
+        """Compile the pow-2 bucket ladder on a graph — the warm-start
+        path AND candidate generations BEFORE they are swapped in
+        (off-lock, graph not yet visible), so first requests recompile
+        nothing.  Returns the number of entry points warmed (0 when
+        the graph has no prewarm or it failed — serving unaffected)."""
+        fn = getattr(graph, "prewarm", None)
+        if fn is None:
+            return 0
+        try:
+            return fn(lanes=self._PREWARM_LANES,
+                      slot_ranges=self._prewarm_slot_ranges(graph),
+                      pipelined=_pipeline_on())
+        except Exception:
+            _log.exception("prewarm failed (serving unaffected)")
+            return 0
+
+    def _prewarm_slot_ranges(self, graph) -> list:
+        slot_ranges = []
+        for t, d in self.schema.definitions.items():
+            for p in d.permissions:
+                rng = graph.prog.slot_range(t, p)
+                if rng is not None:
+                    slot_ranges.append(rng)
+            if len(slot_ranges) >= self._PREWARM_SLOT_CAP:
+                break
+        return slot_ranges[: self._PREWARM_SLOT_CAP]
+
+    @property
+    def rebuild_inflight(self) -> bool:
+        return self._bg_inflight
+
+    @property
+    def rebuild_epoch(self) -> int:
+        """Monotone counter over rebuild starts + installs: wrappers use
+        an unchanged value as proof no rebuild overlapped an operation."""
+        return self._rebuild_epoch
+
+    def wait_rebuilds(self, timeout: float = 30.0) -> bool:
+        """Quiesce background rebuild work: block until no rebuild is in
+        flight and no pairs remain quarantined (kicking a follow-up
+        rebuild if residue needs one).  Test/ops helper — the serving
+        paths never call this.  Returns True when quiescent."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                fut = self._bg_future
+                if fut is None:
+                    if not self._stale_pairs or not self._async_rebuild_on():
+                        return True
+                    self._bg_not_before = 0.0
+                    self._kick_background_rebuild("background")
+                    fut = self._bg_future
+            if fut is not None:
+                try:
+                    fut.result(timeout=max(0.01,
+                                           deadline - time.monotonic()))
+                except Exception:
+                    pass
+        with self._lock:
+            return not self._bg_inflight and not self._stale_pairs
 
     # -- query encoding -----------------------------------------------------
 
@@ -1672,6 +2171,15 @@ class JaxEndpoint(PermissionsEndpoint):
             tri = getattr(graph, "tri_state_capable", False)
 
             for i, r in enumerate(reqs):
+                if (self._stale_pairs and (r.resource.type, r.permission)
+                        in self._stale_pairs):
+                    # quarantined pair: a delta affecting it could not be
+                    # absorbed by this generation (off-loop rebuild in
+                    # flight) — the host oracle reads the live store and
+                    # stays exact
+                    oracle_rows.append(i)
+                    self.stats["stale_routed"] += 1
+                    continue
                 if (not tri and (r.resource.type, r.permission)
                         in self._caveat_affected):
                     # caveat residual with no device plane: host tri-state
@@ -1888,7 +2396,12 @@ class JaxEndpoint(PermissionsEndpoint):
         bid = timeline.next_batch()
         with self._lock:
             graph = self._current_graph()
-            if ((resource_type, permission) in self._caveat_affected
+            if (resource_type, permission) in self._stale_pairs:
+                # quarantined pair (off-loop rebuild in flight): the
+                # host oracle reads the live store and stays exact
+                oracle = True
+                self.stats["stale_routed"] += 1
+            elif ((resource_type, permission) in self._caveat_affected
                     and not getattr(graph, "tri_state_capable", False)):
                 # caveat residual with no device plane: the oracle already
                 # skips CONDITIONAL results (reference lookups.go:85-88);
@@ -1998,7 +2511,12 @@ class JaxEndpoint(PermissionsEndpoint):
         bid = timeline.next_batch()
         with self._lock:
             graph = self._current_graph()
-            if ((resource_type, permission) in self._caveat_affected
+            if (resource_type, permission) in self._stale_pairs:
+                # quarantined pair (off-loop rebuild in flight): exact
+                # answers come from the host oracle until the swap
+                all_oracle = True
+                self.stats["stale_routed"] += 1
+            elif ((resource_type, permission) in self._caveat_affected
                     and not getattr(graph, "tri_state_capable", False)):
                 all_oracle = True
             elif (rng := graph.prog.slot_range(resource_type,

@@ -249,11 +249,14 @@ class KernelCache:
         # first-call-per-compile-key wrappers record each lazy XLA
         # compile as a `compile` slice on the dispatch timeline
         # (utils/timeline.py)
-        self._checks = timeline.time_first_call(jax.jit(run_checks))
+        self._checks = timeline.time_first_call(jax.jit(run_checks),
+                                                shape_args=True)
         # slot offset/length are static: one compile per (type,
-        # permission) — static_args=2 attributes each of them
+        # permission) — static_args=2 attributes each of them;
+        # shape_args additionally attributes batch/edge-shape retraces
         self._lookup = timeline.time_first_call(
-            jax.jit(run_lookup, static_argnums=(0, 1)), static_args=2)
+            jax.jit(run_lookup, static_argnums=(0, 1)), static_args=2,
+            shape_args=True)
         # device-resident pipeline state (mirrors EllKernelCache): lazy
         # donated-arena entry points keyed by batch bucket, feeding the
         # same per-bucket jit hit/compile/storm accounting (the serial
@@ -296,11 +299,11 @@ class KernelCache:
 
         fns = (timeline.time_first_call(
                    jax.jit(run_checks3, donate_argnums=(3,)),
-                   bucket=batch),
+                   bucket=batch, shape_args=True),
                timeline.time_first_call(
                    jax.jit(run_lookup_T, static_argnums=(0, 1),
                            donate_argnums=(3,)),
-                   bucket=batch, static_args=2))
+                   bucket=batch, static_args=2, shape_args=True))
         self._jits[batch] = fns
         return fns
 

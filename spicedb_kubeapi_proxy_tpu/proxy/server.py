@@ -36,6 +36,7 @@ from ..utils.audit import (
     AuditSink,
     LEVEL_METADATA,
     OUTCOME_ALLOWED,
+    OUTCOME_SHED,
     normalize_outcome,
 )
 from .authn import (
@@ -74,6 +75,20 @@ def _untraced(path: str) -> bool:
     """Every debug surface — including trailing-slash and unknown ones,
     which still serve index/404 from _serve_debug — stays untraced."""
     return path in _UNTRACED_PATHS or path.startswith("/debug/")
+
+
+def too_many_requests_response(retry_after_s: float, message: str) -> Response:
+    """Kube-style 429 Status with a Retry-After header (admission
+    control; docs/performance.md "Overload & rebuild behavior")."""
+    resp = json_response(429, {
+        "kind": "Status", "apiVersion": "v1", "metadata": {},
+        "status": "Failure", "message": message,
+        "reason": "TooManyRequests", "code": 429,
+        "details": {"retryAfterSeconds": max(1, int(round(retry_after_s)))},
+    })
+    resp.headers.set("Retry-After",
+                     str(max(1, int(round(retry_after_s)))))
+    return resp
 
 
 def format_request_kv(req) -> str:
@@ -165,6 +180,18 @@ class Options:
     # bucket jit stalls move to startup (docs/performance.md
     # "Device-resident pipeline")
     prewarm_compiles: bool = False
+    # admission control (utils/admission.py, docs/performance.md
+    # "Overload & rebuild behavior").  shed_queue_depth > 0: read-only
+    # requests are rejected with 429 + Retry-After BEFORE authorization
+    # work starts once the dispatcher queues (check + LR) reach that
+    # depth.  shed_slo_burn: also shed reads while an SLO burns on both
+    # horizons (needs --slo-* configured).  Dual-writes are never shed.
+    # The dispatcher's own queue bound is --max-queue-depth (an
+    # endpoint kwarg / jax:// URL param), which 429s queue overflow
+    # that slips past the shedder.
+    shed_queue_depth: int = 0
+    shed_slo_burn: bool = False
+    shed_retry_after_s: float = 1.0
 
 
 class ProxyServer:
@@ -243,6 +270,53 @@ class ProxyServer:
         # window task rides start/stop.
         if opts.enable_metrics:
             self.flight = self._make_flight_recorder()
+        # load shedder (utils/admission.py): reads shed at the door when
+        # the dispatcher queues or the SLO burn signal say the proxy is
+        # already saturated; constructed unconditionally so /readyz can
+        # always report its state (inert when thresholds are unset)
+        from ..utils.admission import LoadShedder
+        # find the dispatcher's O(1) queue_depth accessor through any
+        # wrapper layers (decision cache, instrumentation) once, at
+        # construction — the door check runs per read request
+        depth_fn = None
+        ep = self.endpoint
+        while ep is not None:
+            fn = getattr(ep, "queue_depth", None)
+            if callable(fn):
+                depth_fn = fn
+                break
+            ep = getattr(ep, "inner", None)
+        if opts.shed_queue_depth > 0 and depth_fn is None:
+            stats = dict(getattr(self.endpoint, "stats", None) or {})
+            if ("check_queue_depth" not in stats
+                    and "lr_queue_depth" not in stats):
+                # e.g. `jax://?dispatch=direct`: no dispatcher queues to
+                # measure, so the threshold can never fire — say so
+                # instead of silently serving with shedding inert
+                logger.warning(
+                    "--shed-queue-depth %d is configured but the "
+                    "endpoint exposes no dispatcher queue depth "
+                    "(dispatch=direct?) — queue-depth shedding will "
+                    "never trigger", opts.shed_queue_depth)
+        self.shedder = LoadShedder(
+            shed_queue_depth=opts.shed_queue_depth,
+            shed_on_burn=opts.shed_slo_burn,
+            retry_after_s=opts.shed_retry_after_s,
+            depth_fn=depth_fn,
+            stats_fn=lambda: dict(getattr(self.endpoint, "stats", None)
+                                  or {}),
+            burning_fn=(lambda: self.flight.burning()
+                        if self.flight is not None else []))
+        # off-loop rebuilds prewarm their candidate generations when
+        # compile prewarm is on, so a post-swap first request recompiles
+        # nothing (ops/jax_endpoint.py _prewarm_graph)
+        if opts.prewarm_compiles:
+            inner = self.endpoint
+            while inner is not None and not hasattr(inner,
+                                                    "prewarm_rebuilds"):
+                inner = getattr(inner, "inner", None)
+            if inner is not None:
+                inner.prewarm_rebuilds = True
         # unconditional: set_hbm_peak(0) restores auto-detection, so a
         # server built with the default never inherits a previous
         # server's configured peak through the module singleton
@@ -402,21 +476,48 @@ class ProxyServer:
             # auth and error handling stay uniform across every surface)
             if req.path == "/debug" or req.path.startswith("/debug/"):
                 return self._serve_debug(req)
-            return await authorized(req)
+            # admission control: shed read-only traffic at the door when
+            # the proxy is already saturated (queue depth / SLO burn),
+            # and convert dispatcher queue-bound rejections raised
+            # anywhere in the authorization pipeline into 429s.  Update
+            # verbs are never shed (utils/admission.py).
+            info = req.context.get("request_info")
+            verb = info.verb if info is not None else req.method.lower()
+            reason = self.shedder.check(verb)
+            if reason is not None:
+                req.context["authz_outcome"] = OUTCOME_SHED
+                return too_many_requests_response(
+                    self.shedder.retry_after_s,
+                    f"request shed by admission control ({reason}); "
+                    f"retry after {self.shedder.retry_after_s:.0f}s")
+            from ..utils.admission import AdmissionRejectedError
+            try:
+                return await authorized(req)
+            except AdmissionRejectedError as e:
+                req.context["authz_outcome"] = OUTCOME_SHED
+                return too_many_requests_response(e.retry_after_s, str(e))
 
         async def with_request_info(req: Request) -> Response:
             if req.path in ("/readyz", "/livez", "/healthz"):
                 body = b"ok"
-                if req.path == "/readyz" and self.flight is not None:
-                    burning = self.flight.burning()
-                    if burning:
+                if req.path == "/readyz":
+                    lines = ["ok"]
+                    if self.flight is not None:
                         # burning SLOs surface in readiness output (the
                         # status stays 200: budget burn is an alert, not
                         # an outage — ejecting the pod would make it one)
-                        lines = ["ok"] + [
+                        lines += [
                             f"[!] slo {b['slo']} burning: "
                             f"short={b['short']:.2f} long={b['long']:.2f}"
-                            for b in burning]
+                            for b in self.flight.burning()]
+                    if self.shedder.shedding_recently():
+                        # same contract for admission control: shedding
+                        # is degraded-but-200 — the proxy is protecting
+                        # itself, and ejecting the pod would turn
+                        # deliberate backpressure into a real outage
+                        lines.append("[!] admission control shedding "
+                                     "read-only traffic (429)")
+                    if len(lines) > 1:
                         body = "\n".join(lines).encode()
                 return Response(status=200, body=body)
             req.context["request_info"] = parse_request_info(req.method,

@@ -35,7 +35,8 @@ import contextlib
 import time
 from typing import Iterable, Optional
 
-from ..utils import devtel, timeline, tracing
+from ..utils import admission, devtel, timeline, tracing
+from ..utils.failpoints import fail_point
 from .endpoints import PermissionsEndpoint
 from .store import Watcher
 from .types import (
@@ -135,15 +136,37 @@ def _activate_batch_trace(waiters: list):
 
 
 class BatchingEndpoint(PermissionsEndpoint):
+    # Retry-After hint on queue-bound rejections: one drain cycle is the
+    # natural unit of backoff (the queue that rejected will have turned
+    # over at least once by then)
+    RETRY_AFTER_S = 1.0
+
     def __init__(self, inner: PermissionsEndpoint, max_batch: int = 4096,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2, max_queue_depth: int = 0):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if pipeline_depth < 1:
             raise ValueError(
                 f"pipeline_depth must be >= 1, got {pipeline_depth}")
+        if max_queue_depth < 0:
+            raise ValueError(
+                f"max_queue_depth must be >= 0, got {max_queue_depth}")
         self.inner = inner
         self.max_batch = max_batch
+        # admission control (utils/admission.py, --max-queue-depth):
+        # bound on EACH of the check and LR queues; an enqueue that
+        # would exceed it raises AdmissionRejectedError instead of
+        # queueing (0 = unbounded, the pre-admission behavior).
+        # Exempt callers (dual-write authorization, admission.exempt())
+        # and singleflight followers (they add no queue entry) always
+        # admit.
+        self.max_queue_depth = max_queue_depth
+        if max_queue_depth:
+            # only a configured bound publishes the gauge: endpoints
+            # constructed later with the default 0 (bench sweeps, test
+            # fixtures) must not reset the serving proxy's exported
+            # limit to "unbounded"
+            admission.set_queue_limit(max_queue_depth)
         # fused batches allowed in flight at once (device-resident
         # pipeline, --pipeline-depth): depth N keeps N-1 STARTED batches
         # pending, so batch N+1's host encode + H2D upload + kernel
@@ -155,6 +178,14 @@ class BatchingEndpoint(PermissionsEndpoint):
         # waiters are (item, Future, trace-ctx-or-None) triples
         self._check_queue: list = []   # [(CheckRequest, Future, tc)]
         self._lr_queue: dict = {}      # (type, perm) -> [(SubjectRef, Future, tc)]
+        # fair service order across LR keys: every queued (type, perm)
+        # key appears exactly once; the drain serves the head and a key
+        # with remaining waiters rejoins at the TAIL, so one hot lookup
+        # key cannot monopolize the drain while others starve
+        self._lr_rotation: collections.deque = collections.deque()
+        # live LR queue depth (all keys), maintained incrementally so
+        # the admission bound check stays O(1) per enqueue
+        self._lr_depth = 0
         # in-flight singleflight index: (type, perm, subject) -> the
         # QUEUED leader future.  Entries are removed at drain pickup, so
         # arrivals during execution start a fresh query (a write may have
@@ -171,7 +202,13 @@ class BatchingEndpoint(PermissionsEndpoint):
         # gauge registration sees the key
         self._stats = {"drains": 0, "fused_checks": 0, "fused_lookups": 0,
                        "max_fused_batch": 0, "explain_bypass": 0,
-                       "singleflight_hits": 0}
+                       "singleflight_hits": 0, "admission_rejected": 0}
+
+    def queue_depth(self) -> int:
+        """Total queued (not in-flight) entries across both queues —
+        O(1), allocation-free; the load shedder's door check reads this
+        on every read-only request (proxy/server.py)."""
+        return len(self._check_queue) + self._lr_depth
 
     @property
     def stats(self) -> dict:
@@ -185,9 +222,34 @@ class BatchingEndpoint(PermissionsEndpoint):
         out["lr_queue_depth"] = sum(len(v) for v in self._lr_queue.values())
         out["inflight_batch"] = len(self._inflight)
         out["pipeline_depth"] = self.pipeline_depth
+        out["queue_limit"] = self.max_queue_depth
         return out
 
     # -- queue plumbing ------------------------------------------------------
+
+    def _admit(self, queue_depth: int, adding: int, which: str) -> None:
+        """Reject an enqueue that would push `which` queue past the
+        bound (fail fast instead of queueing unboundedly).  The bound
+        limits BACKLOG, not request size: a bulk arriving at an empty
+        queue always admits whole — otherwise any batch larger than the
+        bound would be rejected forever, idle or not, and retrying
+        could never succeed.  Worst-case resident depth is therefore
+        bound + one batch.  Exempt callers — dual-write authorization
+        runs under admission.exempt() — always pass, as does everything
+        when the AdmissionControl gate (killswitch) is off."""
+        if not self.max_queue_depth:
+            return
+        if queue_depth == 0 or queue_depth + adding <= self.max_queue_depth:
+            return
+        if admission.is_exempt() or not admission.enabled():
+            return
+        self._stats["admission_rejected"] += 1
+        admission.note_rejected("queue_limit")
+        raise admission.AdmissionRejectedError(
+            f"{which} queue at depth {queue_depth} (bound "
+            f"{self.max_queue_depth}); retry after "
+            f"{self.RETRY_AFTER_S:.1f}s",
+            reason="queue_limit", retry_after_s=self.RETRY_AFTER_S)
 
     def _kick(self) -> None:
         if self._drain_task is None or self._drain_task.done():
@@ -220,42 +282,56 @@ class BatchingEndpoint(PermissionsEndpoint):
             two_ck = False
         try:
             while self._check_queue or self._lr_queue or pending:
+                fail_point("dispatchDrain")
                 self._stats["drains"] += 1
-                if self._check_queue:
-                    batch = self._check_queue[: self.max_batch]
-                    del self._check_queue[: len(batch)]
-                    self._inflight = batch
-                    if two_ck:
-                        started = await self._start_checks(batch)
-                        self._inflight = []
-                        if started:
-                            pending.append(started)
-                    else:
-                        await self._run_checks(batch)
-                        self._inflight = []
-                if self._lr_queue:
-                    key, waiters = next(iter(self._lr_queue.items()))
-                    del self._lr_queue[key]
-                    rest = waiters[self.max_batch:]
-                    waiters = waiters[: self.max_batch]
-                    if rest:
-                        self._lr_queue.setdefault(key, []).extend(rest)
-                    self._unregister_pending(key, waiters)
-                    self._inflight = waiters
-                    if two_lr:
-                        # `started` joins `pending` BEFORE any blocking
-                        # finish, so a drain death during that await
-                        # still knows about every started batch
-                        started = await self._start_lookups(key, waiters)
-                        self._inflight = []
-                        if started:
-                            pending.append(started)
-                    else:
-                        await self._run_lookups(key, waiters)
-                        self._inflight = []
+                # alternate which queue goes first each iteration so
+                # sustained traffic on one verb cannot push the other
+                # behind it in every drain cycle (fairness, half of the
+                # hot-key rotation below)
+                order = (("ck", "lr") if self._stats["drains"] % 2
+                         else ("lr", "ck"))
+                for side in order:
+                    if side == "ck" and self._check_queue:
+                        batch = self._check_queue[: self.max_batch]
+                        del self._check_queue[: len(batch)]
+                        self._inflight = batch
+                        if two_ck:
+                            started = await self._start_checks(batch)
+                            self._inflight = []
+                            if started:
+                                pending.append(started)
+                        else:
+                            await self._run_checks(batch)
+                            self._inflight = []
+                    elif side == "lr" and self._lr_queue:
+                        key, waiters = self._next_lr_key()
+                        rest = waiters[self.max_batch:]
+                        waiters = waiters[: self.max_batch]
+                        if rest:
+                            # remainder rejoins at the BACK of the
+                            # rotation: a hot key yields the drain to
+                            # every other queued key between its batches
+                            self._lr_queue[key] = rest
+                            self._lr_rotation.append(key)
+                        self._lr_depth -= len(waiters)
+                        self._unregister_pending(key, waiters)
+                        self._inflight = waiters
+                        if two_lr:
+                            # `started` joins `pending` BEFORE any
+                            # blocking finish, so a drain death during
+                            # that await still knows about every
+                            # started batch
+                            started = await self._start_lookups(key, waiters)
+                            self._inflight = []
+                            if started:
+                                pending.append(started)
+                        else:
+                            await self._run_lookups(key, waiters)
+                            self._inflight = []
                 while pending and (len(pending) > window
                                    or not (self._check_queue
                                            or self._lr_queue)):
+                    fail_point("dispatchDrainBeforeFinish")
                     kind, waiters, started = pending.popleft()
                     self._inflight = waiters
                     if kind == "lr":
@@ -278,12 +354,28 @@ class BatchingEndpoint(PermissionsEndpoint):
             for ws in self._lr_queue.values():
                 stranded.extend(ws)
             self._lr_queue.clear()
+            self._lr_rotation.clear()
+            self._lr_depth = 0
             self._lr_pending.clear()
             self._sf_counts.clear()
             for w in stranded:
                 if not w[1].done():
                     w[1].set_exception(failure)
             raise
+
+    def _next_lr_key(self) -> tuple:
+        """Pop the next (type, perm) key in fair rotation order and its
+        full waiter list.  Invariant: a key is in the rotation exactly
+        once iff it has a queue entry, so the popleft loop's guard is
+        defensive only."""
+        while self._lr_rotation:
+            key = self._lr_rotation.popleft()
+            waiters = self._lr_queue.pop(key, None)
+            if waiters is not None:
+                return key, waiters
+        # defensive resync (should be unreachable): serve dict order
+        key = next(iter(self._lr_queue))
+        return key, self._lr_queue.pop(key)
 
     def _unregister_pending(self, key: tuple, waiters: list) -> None:
         """Close the singleflight window for a batch being picked up:
@@ -299,19 +391,35 @@ class BatchingEndpoint(PermissionsEndpoint):
         devtel.OCCUPANCY.note_collapsed(collapsed)
 
     def _enqueue_lookup(self, resource_type: str, permission: str,
-                        subject: SubjectRef, tc) -> asyncio.Future:
+                        subject: SubjectRef, tc,
+                        pre_admitted: bool = False) -> asyncio.Future:
         """Queue one lookup, singleflight-deduped: an identical query
         already QUEUED shares its waiter (one kernel column, one cache
         fill upstream) through an internal leader future; the returned
-        future is always caller-private (see _follow)."""
+        future is always caller-private (see _follow).  `pre_admitted`:
+        lookup_resources_batch already admitted the WHOLE batch — a
+        second per-leader check here would reject mid-batch (the
+        batch's own leaders raise the depth past the bound), stranding
+        the already-enqueued members and breaking the admit-whole
+        guarantee."""
         loop = asyncio.get_running_loop()
         k = (resource_type, permission, subject)
         leader = self._lr_pending.get(k)
         if leader is None:
+            # only a NEW leader adds queue depth; followers below join
+            # an existing column for free, so under overload identical
+            # queries collapse instead of rejecting
+            if not pre_admitted:
+                self._admit(self._lr_depth, 1, "lookup")
             leader = loop.create_future()
             self._lr_pending[k] = leader
-            self._lr_queue.setdefault((resource_type, permission), []).append(
-                (subject, leader, tc))
+            qkey = (resource_type, permission)
+            q = self._lr_queue.get(qkey)
+            if q is None:
+                q = self._lr_queue[qkey] = []
+                self._lr_rotation.append(qkey)
+            q.append((subject, leader, tc))
+            self._lr_depth += 1
         else:
             self._stats["singleflight_hits"] += 1
             self._sf_counts[k] = self._sf_counts.get(k, 0) + 1
@@ -484,6 +592,7 @@ class BatchingEndpoint(PermissionsEndpoint):
     # -- batched verbs -------------------------------------------------------
 
     async def check_permission(self, req: CheckRequest):
+        self._admit(len(self._check_queue), 1, "check")
         tc = _trace_ctx()
         fut = asyncio.get_running_loop().create_future()
         self._check_queue.append((req, fut, tc))
@@ -496,6 +605,10 @@ class BatchingEndpoint(PermissionsEndpoint):
     async def check_bulk_permissions(self, reqs: list) -> list:
         if not reqs:
             return []
+        # admit or reject the bulk WHOLE: partially enqueueing one
+        # caller's batch and then rejecting the rest would run half its
+        # checks for an answer the caller never sees
+        self._admit(len(self._check_queue), len(reqs), "check")
         loop = asyncio.get_running_loop()
         tc = _trace_ctx()  # one shared ctx: the bulk is one caller
         futs = []
@@ -523,8 +636,14 @@ class BatchingEndpoint(PermissionsEndpoint):
                                      subjects: list) -> list:
         if not subjects:
             return []
+        # whole-batch admission (conservative: duplicates that would
+        # collapse into followers still count) — enqueueing half a
+        # caller's batch then rejecting the rest wastes kernel lanes on
+        # an answer the caller never sees
+        self._admit(self._lr_depth, len(subjects), "lookup")
         tc = _trace_ctx()  # one shared ctx: the batch is one caller
-        futs = [self._enqueue_lookup(resource_type, permission, s, tc)
+        futs = [self._enqueue_lookup(resource_type, permission, s, tc,
+                                     pre_admitted=True)
                 for s in subjects]
         self._kick()
         try:

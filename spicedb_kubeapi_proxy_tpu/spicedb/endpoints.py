@@ -376,6 +376,9 @@ def create_endpoint(url: str,
     # kwargs, `jax://?pipeline_depth=N` overrides; popped here so the
     # non-batched schemes never see an unexpected kwarg
     pipeline_depth = kwargs.pop("pipeline_depth", None)
+    # dispatcher queue bound (admission control, --max-queue-depth;
+    # `jax://?max_queue_depth=N` overrides; 0 = unbounded)
+    max_queue_depth = kwargs.pop("max_queue_depth", None)
     # a pre-built store (the persistence layer hands its recovered store
     # in here) only makes sense for the store-backed backends
     store = kwargs.pop("store", None)
@@ -453,13 +456,18 @@ def create_endpoint(url: str,
                 max_batch = int((params.get("max_batch") or ["4096"])[0])
                 if "pipeline_depth" in params:
                     pipeline_depth = int(params["pipeline_depth"][0])
+                if "max_queue_depth" in params:
+                    max_queue_depth = int(params["max_queue_depth"][0])
                 ep = BatchingEndpoint(
                     ep, max_batch=max_batch,
                     pipeline_depth=(pipeline_depth
-                                    if pipeline_depth is not None else 2))
+                                    if pipeline_depth is not None else 2),
+                    max_queue_depth=(max_queue_depth
+                                     if max_queue_depth is not None else 0))
             except ValueError as e:
                 raise EndpointConfigError(
-                    f"invalid max_batch/pipeline_depth in {url!r}: {e}") from e
+                    f"invalid max_batch/pipeline_depth/max_queue_depth "
+                    f"in {url!r}: {e}") from e
         elif dispatch != "direct":
             raise EndpointConfigError(
                 f"unknown dispatch mode {dispatch!r}; use batched|direct")

@@ -1,0 +1,214 @@
+"""Admission control: bounded dispatcher queues + load shedding
+(docs/performance.md "Overload & rebuild behavior").
+
+Overload used to turn into unbounded queueing: the dispatcher's check/LR
+queues grew without limit, every caller waited, and the proxy's latency
+under 2x sustained capacity was "eventually" instead of an answer.  This
+module is the shared vocabulary for turning overload into *fast failure*:
+
+1. **Queue bounds** (`spicedb/dispatch.py --max-queue-depth`): an enqueue
+   that would push a dispatcher queue past its bound raises
+   `AdmissionRejectedError(reason="queue_limit")` instead of queueing.
+
+2. **Load shedder** (`LoadShedder`, wired in proxy/server.py): read-only
+   verbs are rejected BEFORE authorization work starts when the
+   dispatcher queues are already past a threshold or the flight
+   recorder's SLO burn-rate signal (utils/devtel.py) is burning on both
+   horizons.  Dual-writes are never shed — an interrupted two-phase
+   write is strictly worse than a slow one — and the middleware marks
+   update-verb requests exempt (`exempt()`) so their authorization
+   checks bypass the queue bounds too.
+
+3. **429 semantics**: every rejection carries a `retry_after_s` hint the
+   server turns into a kube-style 429 `Status` with a `Retry-After`
+   header; `/readyz` reports recent shedding as degraded-but-200 (load
+   shed is an alert, not an outage — ejecting the pod would make it
+   one).
+
+Metrics: `authz_admission_rejected_total{reason=}` counts every
+rejection (reasons: queue_limit, queue_depth, slo_burn) and
+`authz_admission_queue_limit` exports the configured dispatcher bound
+(0 = unbounded).  The `AdmissionControl` feature gate is the killswitch:
+off, bounds and shedding are inert and overload queues exactly as
+before.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+import time
+from typing import Callable, Optional
+
+from . import metrics as m
+
+# verbs that may be shed: reads can be retried by any well-behaved kube
+# client; update verbs ride the dual-write workflow and are never shed
+READ_ONLY_VERBS = frozenset(("get", "list", "watch"))
+
+
+class AdmissionRejectedError(Exception):
+    """A request rejected by admission control (never a correctness
+    failure): the caller should surface HTTP 429 with Retry-After."""
+
+    def __init__(self, message: str, reason: str = "queue_limit",
+                 retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+def enabled() -> bool:
+    """AdmissionControl gate (killswitch); unknown-gate errors fail open
+    so embedded users with a stripped gate registry keep the bounds they
+    configured."""
+    try:
+        from .features import GATES
+        return GATES.enabled("AdmissionControl")
+    except Exception:
+        return True
+
+
+# -- write-path exemption -----------------------------------------------------
+# Update-verb requests (dual-writes) must never be rejected by a queue
+# bound mid-workflow: the middleware wraps their whole authorization in
+# exempt(), and the contextvar crosses executor hops with the rest of
+# the request context.
+
+_EXEMPT: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "authz_admission_exempt", default=False)
+
+
+@contextlib.contextmanager
+def exempt():
+    token = _EXEMPT.set(True)
+    try:
+        yield
+    finally:
+        _EXEMPT.reset(token)
+
+
+def is_exempt() -> bool:
+    return _EXEMPT.get()
+
+
+# -- metrics ------------------------------------------------------------------
+
+_REJECTED = m.REGISTRY.counter(
+    "authz_admission_rejected_total",
+    "Requests rejected by admission control, by reason (queue_limit = "
+    "dispatcher queue bound, queue_depth / slo_burn = load shedder)",
+    labels=("reason",))
+_QUEUE_LIMIT = m.REGISTRY.gauge(
+    "authz_admission_queue_limit",
+    "Configured dispatcher queue bound (--max-queue-depth; 0 = unbounded)")
+_QUEUE_LIMIT.set(0.0)
+
+
+def note_rejected(reason: str) -> None:
+    _REJECTED.inc(reason=reason)
+
+
+def set_queue_limit(n: int) -> None:
+    _QUEUE_LIMIT.set(float(n))
+
+
+# -- load shedder -------------------------------------------------------------
+
+
+class LoadShedder:
+    """Sheds read-only traffic above the endpoint when the system is
+    already saturated, so queue depth stays bounded and in-flight
+    requests keep their latency.
+
+    Two independent signals, either sufficient:
+    - `shed_queue_depth` > 0: total dispatcher queue depth (check + LR,
+      read through `stats_fn`) at/over the threshold.
+    - `shed_on_burn`: the flight recorder reports an SLO burning on both
+      horizons (`burning_fn` non-empty) — the PR 5 burn-rate signal.
+
+    `check(verb)` returns the rejection reason (or None to admit);
+    callers build the 429 from `retry_after_s`.  `shedding_recently()`
+    feeds /readyz: shed decisions within the last window mark the proxy
+    degraded (still 200)."""
+
+    RECENT_WINDOW_S = 10.0
+
+    def __init__(self, shed_queue_depth: int = 0, shed_on_burn: bool = False,
+                 retry_after_s: float = 1.0,
+                 stats_fn: Optional[Callable[[], dict]] = None,
+                 burning_fn: Optional[Callable[[], list]] = None,
+                 depth_fn: Optional[Callable[[], int]] = None):
+        self.shed_queue_depth = shed_queue_depth
+        self.shed_on_burn = shed_on_burn
+        self.retry_after_s = max(retry_after_s, 0.001)
+        self._stats_fn = stats_fn
+        self._burning_fn = burning_fn
+        # depth_fn (an O(1), allocation-free queue-depth accessor) is
+        # preferred over stats_fn: the door check runs on EVERY
+        # read-only request, before any authorization work — it must
+        # not build the full merged stats dict each time
+        self._depth_fn = depth_fn
+        self._lock = threading.Lock()
+        self._last_shed = 0.0
+        self._shed_total = 0
+
+    @property
+    def active(self) -> bool:
+        return self.shed_queue_depth > 0 or self.shed_on_burn
+
+    def _queue_depth(self) -> int:
+        if self._depth_fn is not None:
+            try:
+                return int(self._depth_fn())
+            except Exception:
+                return 0
+        if self._stats_fn is None:
+            return 0
+        try:
+            stats = self._stats_fn() or {}
+        except Exception:
+            return 0
+        return (int(stats.get("check_queue_depth", 0))
+                + int(stats.get("lr_queue_depth", 0)))
+
+    def check(self, verb: str) -> Optional[str]:
+        """Rejection reason for one request, or None to admit.  Only
+        read-only verbs are ever shed; update verbs always pass."""
+        if not self.active or not enabled():
+            return None
+        if verb not in READ_ONLY_VERBS:
+            return None
+        reason = None
+        if (self.shed_queue_depth > 0
+                and self._queue_depth() >= self.shed_queue_depth):
+            reason = "queue_depth"
+        elif self.shed_on_burn and self._burning_fn is not None:
+            try:
+                if self._burning_fn():
+                    reason = "slo_burn"
+            except Exception:
+                reason = None
+        if reason is not None:
+            note_rejected(reason)
+            with self._lock:
+                self._last_shed = time.monotonic()
+                self._shed_total += 1
+        return reason
+
+    def shedding_recently(self) -> bool:
+        with self._lock:
+            last = self._last_shed
+        return bool(last) and time.monotonic() - last <= self.RECENT_WINDOW_S
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            last = self._last_shed
+            total = self._shed_total
+        recent = bool(last) and (time.monotonic() - last
+                                 <= self.RECENT_WINDOW_S)
+        return {"shed_total": total,
+                "shedding_recently": recent,
+                "shed_queue_depth": self.shed_queue_depth,
+                "shed_on_burn": self.shed_on_burn}

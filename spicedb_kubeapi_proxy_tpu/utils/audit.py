@@ -56,9 +56,13 @@ OUTCOME_DENIED = "denied"
 OUTCOME_ALWAYS_ALLOW = "always_allow"
 OUTCOME_CONDITIONAL = "conditional"
 OUTCOME_ERROR = "error"
+# admission control rejected the request before/while authorizing (429 +
+# Retry-After; utils/admission.py) — distinct from `denied` (a policy
+# decision) and `error` (a failure): the request was never evaluated
+OUTCOME_SHED = "shed"
 
 OUTCOMES = frozenset((OUTCOME_ALLOWED, OUTCOME_DENIED, OUTCOME_ALWAYS_ALLOW,
-                      OUTCOME_CONDITIONAL, OUTCOME_ERROR))
+                      OUTCOME_CONDITIONAL, OUTCOME_ERROR, OUTCOME_SHED))
 
 
 def normalize_outcome(raw: Optional[str]) -> str:

@@ -390,11 +390,62 @@ class BatchOccupancy:
         return out
 
 
+# -- rebuild concurrency accounting ------------------------------------------
+
+
+class RebuildAccounting:
+    """Counts device-graph rebuilds by mode and exposes whether one is
+    in flight right now — the observable difference between "a rebuild
+    stalled this request" (sync) and "a rebuild ran in the background
+    while requests kept serving" (background/preemptive).  Modes:
+    sync (built under the endpoint lock), background (delta-forced,
+    built off-lock), preemptive (spare-pool low-watermark, built
+    off-lock before churn forces one)."""
+
+    def __init__(self, registry: Optional[m.Registry] = None):
+        registry = registry or m.REGISTRY
+        self._lock = threading.Lock()
+        self._counter = registry.counter(
+            "authz_rebuilds_total",
+            "Device-graph rebuilds by mode (sync = under the endpoint "
+            "lock, background = delta-forced off-loop, preemptive = "
+            "spare-pool low-watermark off-loop)",
+            labels=("mode",))
+        self._inflight = 0
+        registry.gauge(
+            "authz_rebuild_inflight",
+            "Background device-graph rebuilds currently in flight",
+            callback=lambda: float(self._inflight))
+        self._totals: dict = {}
+
+    def note_rebuild(self, mode: str) -> None:
+        if not enabled():
+            return
+        self._counter.inc(mode=mode)
+        with self._lock:
+            self._totals[mode] = self._totals.get(mode, 0) + 1
+
+    def note_inflight(self, delta: int) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight + delta)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"by_mode": dict(self._totals),
+                    "inflight": self._inflight}
+
+
 # -- module singletons -------------------------------------------------------
 
 LEDGER = HbmLedger()
 KERNELS = KernelAccounting()
 OCCUPANCY = BatchOccupancy()
+REBUILDS = RebuildAccounting()
 
 _gen_lock = threading.Lock()
 _gen_counter = 0

@@ -5,6 +5,11 @@ next n `fail_point(name)` calls raise FailPointPanic (simulating a process
 crash inside an activity, recovered by the workflow journal).  The reference
 gates these behind a build tag; here they are enabled via this module (a
 no-op unless armed).
+
+Sites now live on the dispatch hot path (drain loop, readback waiters,
+arena pool, background rebuild executor — see tests/test_faultmatrix.py),
+so the disarmed fast path is a single module-global bool read: no lock,
+no dict lookup, until the first enable_failpoint() of the process.
 """
 
 from __future__ import annotations
@@ -22,19 +27,31 @@ class FailPointPanic(Exception):
 
 _lock = threading.Lock()
 _armed: dict[str, int] = {}
+# fast-path gate: False until the first arm, True until disable_all().
+# fail_point() reads it unlocked — a benign race (a site observing the
+# old value takes at most one extra no-op pass, never a missed panic
+# for the thread that armed it: enable_failpoint publishes under the
+# lock before returning).
+_active = False
 
 
 def enable_failpoint(name: str, times: int) -> None:
+    global _active
     with _lock:
         _armed[name] = times
+        _active = True
 
 
 def disable_all() -> None:
+    global _active
     with _lock:
         _armed.clear()
+        _active = False
 
 
 def fail_point(name: str) -> None:
+    if not _active:
+        return
     with _lock:
         remaining = _armed.get(name, 0)
         if remaining <= 0:

@@ -108,6 +108,19 @@ GATES.register("Timeline", stage=BETA, default=True)
 # gate is the killswitch: off reproduces the pre-pipeline serial path
 # (host word-transpose, blocking device sync, single-slot lookup window)
 GATES.register("DevicePipeline", stage=BETA, default=True)
+# off-loop incremental rebuilds (ops/jax_endpoint.py): device-graph
+# rebuilds run on a background executor against a store snapshot while
+# the old generation keeps serving (queries on pairs the old graph can
+# no longer answer route to the host oracle), then swap atomically
+# under a short lock.  This gate is the killswitch: off reproduces the
+# pre-PR synchronous rebuild-under-lock behavior exactly.
+GATES.register("AsyncRebuild", stage=BETA, default=True)
+# admission control (utils/admission.py, spicedb/dispatch.py,
+# proxy/server.py): bounded dispatcher queues + read-only load shedding
+# with 429/Retry-After.  This gate is the killswitch: off, configured
+# bounds and shed thresholds are inert and overload queues unboundedly
+# as before.
+GATES.register("AdmissionControl", stage=BETA, default=True)
 
 
 def pipeline_enabled() -> bool:

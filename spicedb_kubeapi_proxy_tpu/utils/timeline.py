@@ -445,7 +445,7 @@ class Timeline:
 
     def time_first_call(self, fn, bucket: Optional[int] = None,
                         stage: str = "compile", track: str = "device",
-                        static_args: int = 0):
+                        static_args: int = 0, shape_args: bool = False):
         """Wrap a jitted entry point so the first call PER COMPILE KEY
         records a `compile` timeline event: XLA compiles lazily inside
         the first execution, which is where recompile storms actually
@@ -454,12 +454,18 @@ class Timeline:
         key (jax.jit static_argnums): a lookup jitted with static
         (slot_offset, slot_length) recompiles for every new
         (type, permission) slot range, and each of those compiles must
-        be attributed — not just the first ever.  Steady-state calls
-        pay one tuple-slice + set lookup."""
+        be attributed — not just the first ever.  `shape_args` adds the
+        positional arguments' array shapes to the key: entry points
+        whose traced arguments vary in shape independently of the
+        bucket (the check gather) retrace per novel shape tuple, and
+        those silent recompiles must be attributed too.  Steady-state
+        calls pay one tuple-slice + set lookup."""
         seen: set = set()
 
         def wrapper(*args, **kwargs):
             key = args[:static_args] if static_args else ()
+            if shape_args:
+                key += tuple(getattr(a, "shape", None) for a in args)
             if key in seen:
                 return fn(*args, **kwargs)
             t0 = time.perf_counter()
@@ -641,9 +647,10 @@ def span(stage: str, track: str, **kw):
 
 
 def time_first_call(fn, bucket: Optional[int] = None,
-                    static_args: int = 0):
+                    static_args: int = 0, shape_args: bool = False):
     return TIMELINE.time_first_call(fn, bucket=bucket,
-                                    static_args=static_args)
+                                    static_args=static_args,
+                                    shape_args=shape_args)
 
 
 def summary(since: Optional[float] = None) -> dict:

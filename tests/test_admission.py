@@ -1,0 +1,392 @@
+"""Admission control (utils/admission.py, docs/performance.md "Overload
+& rebuild behavior"): bounded dispatcher queues fail fast with 429
+semantics, dual-writes are exempt, the load shedder rejects read-only
+traffic on queue-depth/SLO-burn signals, and the proxy chain surfaces it
+all as kube-style 429 + Retry-After with /readyz degraded-but-200."""
+
+import asyncio
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.utils import admission
+from spicedb_kubeapi_proxy_tpu.utils.admission import (
+    AdmissionRejectedError,
+    LoadShedder,
+)
+from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+from spicedb_kubeapi_proxy_tpu.utils.metrics import REGISTRY
+
+SCHEMA = """
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+
+
+class GatedEndpoint(EmbeddedEndpoint):
+    """Embedded endpoint whose fused calls block on an event, so tests
+    can hold a batch in flight while queues build deterministically."""
+
+    def __init__(self, schema):
+        super().__init__(schema)
+        self.gate = asyncio.Event()
+        self.gate.set()
+
+    async def check_bulk_permissions(self, reqs):
+        await self.gate.wait()
+        return await super().check_bulk_permissions(reqs)
+
+    async def lookup_resources_batch(self, resource_type, permission,
+                                     subjects):
+        await self.gate.wait()
+        return await super().lookup_resources_batch(
+            resource_type, permission, subjects)
+
+
+def make(max_queue_depth=2, n_docs=6):
+    inner = GatedEndpoint(sch.parse_schema(SCHEMA))
+    inner.store.write([
+        RelationshipUpdate(op=UpdateOp.TOUCH, rel=parse_relationship(
+            f"doc:d{i}#viewer@user:u{i % 3}")) for i in range(n_docs)])
+    return BatchingEndpoint(inner, max_batch=64,
+                            max_queue_depth=max_queue_depth), inner
+
+
+def check(user, doc="d0"):
+    return CheckRequest(resource=ObjectRef("doc", doc), permission="view",
+                        subject=SubjectRef("user", user))
+
+
+def rejected_total():
+    return sum(REGISTRY.get(
+        "authz_admission_rejected_total").snapshot().values())
+
+
+async def hold_batch_inflight(ep, inner):
+    """Close the inner gate and park one check batch in execution so
+    subsequent arrivals accumulate in the dispatcher queue."""
+    inner.gate.clear()
+    first = asyncio.create_task(ep.check_permission(check("u0")))
+    for _ in range(50):
+        await asyncio.sleep(0.001)
+        if ep.stats["inflight_batch"]:
+            break
+    assert ep.stats["inflight_batch"] == 1
+    return first
+
+
+class TestQueueBounds:
+    def test_check_queue_bound_rejects_fast(self):
+        ep, inner = make(max_queue_depth=2)
+
+        async def run():
+            first = await hold_batch_inflight(ep, inner)
+            # depth bound 2: two queued checks admit, the third rejects
+            q1 = asyncio.create_task(ep.check_permission(check("u1")))
+            q2 = asyncio.create_task(ep.check_permission(check("u2")))
+            await asyncio.sleep(0.005)
+            before = rejected_total()
+            with pytest.raises(AdmissionRejectedError) as ei:
+                await ep.check_permission(check("u0", "d3"))
+            assert ei.value.reason == "queue_limit"
+            assert ei.value.retry_after_s > 0
+            assert rejected_total() == before + 1
+            assert ep.stats["admission_rejected"] >= 1
+            inner.gate.set()
+            # admitted work completes correctly after the rejection
+            assert (await first).allowed
+            assert (await q1).allowed is False or (await q1) is not None
+            await q2
+
+        asyncio.run(run())
+
+    def test_bulk_check_admitted_or_rejected_whole(self):
+        ep, inner = make(max_queue_depth=3)
+
+        async def run():
+            first = await hold_batch_inflight(ep, inner)
+            # the bound limits BACKLOG, not request size: a bulk larger
+            # than the bound arriving at an EMPTY queue admits whole
+            # (rejecting it would make large lists permanently
+            # unservable — retry could never succeed)
+            big = asyncio.create_task(ep.check_bulk_permissions(
+                [check(f"w{i}") for i in range(5)]))
+            await asyncio.sleep(0.005)
+            assert ep.stats["check_queue_depth"] == 5
+            # but with a backlog standing, a bulk that would grow it
+            # past the bound rejects WHOLE: nothing half-queued
+            with pytest.raises(AdmissionRejectedError):
+                await ep.check_bulk_permissions(
+                    [check(f"u{i}") for i in range(4)])
+            assert ep.stats["check_queue_depth"] == 5
+            inner.gate.set()
+            assert len(await big) == 5
+            await first
+
+        asyncio.run(run())
+
+    def test_lookup_bound_and_singleflight_followers_free(self):
+        ep, inner = make(max_queue_depth=1)
+
+        async def run():
+            first = await hold_batch_inflight(ep, inner)
+            lead = asyncio.create_task(ep.lookup_resources(
+                "doc", "view", SubjectRef("user", "u0")))
+            await asyncio.sleep(0.005)
+            # identical query: singleflight follower, no queue entry,
+            # admitted despite the bound being full
+            follow = asyncio.create_task(ep.lookup_resources(
+                "doc", "view", SubjectRef("user", "u0")))
+            await asyncio.sleep(0.005)
+            assert not follow.done()
+            # distinct query needs a new queue entry: rejected
+            with pytest.raises(AdmissionRejectedError):
+                await ep.lookup_resources("doc", "view",
+                                          SubjectRef("user", "u1"))
+            inner.gate.set()
+            assert sorted(await lead) == sorted(await follow)
+            assert ep.stats["singleflight_hits"] == 1
+            await first
+
+        asyncio.run(run())
+
+    def test_lookup_bulk_larger_than_bound_admits_whole_when_idle(self):
+        """The whole-batch admit at the door must not be undone by the
+        per-leader admit inside _enqueue_lookup: a 10-subject batch
+        against bound 4 at an idle queue admits WHOLE (rejecting at
+        subject 5 would strand the first 4 leaders and make large
+        batches permanently unservable)."""
+        ep, inner = make(max_queue_depth=4)
+
+        async def run():
+            subs = [SubjectRef("user", f"u{i}") for i in range(10)]
+            out = await ep.lookup_resources_batch("doc", "view", subs)
+            assert len(out) == 10
+
+        asyncio.run(run())
+
+    def test_exempt_context_bypasses_bound(self):
+        ep, inner = make(max_queue_depth=1)
+
+        async def run():
+            first = await hold_batch_inflight(ep, inner)
+            q1 = asyncio.create_task(ep.check_permission(check("u1")))
+            await asyncio.sleep(0.005)
+            # bound full — but a dual-write's authorization is exempt
+            with admission.exempt():
+                exempt_task = asyncio.create_task(
+                    ep.check_permission(check("u2")))
+            await asyncio.sleep(0.005)
+            assert not exempt_task.done()
+            inner.gate.set()
+            await asyncio.gather(first, q1, exempt_task)
+
+        asyncio.run(run())
+
+    def test_gate_off_disables_bounds(self):
+        ep, inner = make(max_queue_depth=1)
+        GATES.set("AdmissionControl", False)
+        try:
+            async def run():
+                first = await hold_batch_inflight(ep, inner)
+                tasks = [asyncio.create_task(
+                    ep.check_permission(check(f"u{i}"))) for i in range(5)]
+                await asyncio.sleep(0.005)
+                inner.gate.set()
+                await asyncio.gather(first, *tasks)
+
+            asyncio.run(run())
+        finally:
+            GATES.set("AdmissionControl", True)
+
+    def test_unbounded_default_never_rejects(self):
+        ep, inner = make(max_queue_depth=0)
+
+        async def run():
+            first = await hold_batch_inflight(ep, inner)
+            tasks = [asyncio.create_task(
+                ep.check_permission(check(f"u{i % 3}"))) for i in range(32)]
+            await asyncio.sleep(0.005)
+            inner.gate.set()
+            await asyncio.gather(first, *tasks)
+
+        asyncio.run(run())
+
+
+class TestLoadShedder:
+    def test_sheds_reads_on_queue_depth(self):
+        depth = {"check_queue_depth": 5, "lr_queue_depth": 3}
+        s = LoadShedder(shed_queue_depth=8, retry_after_s=2.0,
+                        stats_fn=lambda: depth)
+        assert s.check("list") == "queue_depth"
+        assert s.shedding_recently()
+        # update verbs are never shed
+        assert s.check("create") is None
+        assert s.check("delete") is None
+        depth["check_queue_depth"] = 0
+        assert s.check("list") is None
+
+    def test_sheds_reads_on_slo_burn(self):
+        burning = [{"slo": "latency_p99"}]
+        s = LoadShedder(shed_on_burn=True, burning_fn=lambda: burning)
+        assert s.check("get") == "slo_burn"
+        burning.clear()
+        assert s.check("get") is None
+
+    def test_inert_without_thresholds_and_with_gate_off(self):
+        s = LoadShedder(stats_fn=lambda: {"check_queue_depth": 99})
+        assert s.check("list") is None
+        s2 = LoadShedder(shed_queue_depth=1,
+                         stats_fn=lambda: {"check_queue_depth": 99})
+        GATES.set("AdmissionControl", False)
+        try:
+            assert s2.check("list") is None
+        finally:
+            GATES.set("AdmissionControl", True)
+        assert s2.check("list") == "queue_depth"
+
+    def test_metrics_and_snapshot(self):
+        before = rejected_total()
+        s = LoadShedder(shed_queue_depth=1,
+                        stats_fn=lambda: {"check_queue_depth": 2})
+        assert s.check("list") == "queue_depth"
+        assert rejected_total() == before + 1
+        snap = s.snapshot()
+        assert snap["shed_total"] == 1
+        assert snap["shedding_recently"] is True
+
+
+class TestProxyChain:
+    """End-to-end 429 mapping through the real handler chain."""
+
+    def _server(self, **opt_kw):
+        from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import (
+            FakeKubeApiServer)
+        from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
+        from spicedb_kubeapi_proxy_tpu.proxy.server import (
+            Options, ProxyServer)
+        from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+
+        kube = FakeKubeApiServer()
+        kube.seed("", "v1", "pods",
+                  {"metadata": {"name": "p0", "namespace": "ns"}})
+        rules = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list]}]
+prefilter:
+- fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+"""
+        schema = """
+definition user {}
+definition pod {
+  relation creator: user
+  permission view = creator
+}
+"""
+        server = ProxyServer(Options(
+            spicedb_endpoint="embedded://",
+            bootstrap=Bootstrap(schema_text=schema),
+            rules_yaml=rules,
+            upstream_transport=HandlerTransport(kube),
+            **opt_kw))
+        server.endpoint.store.bulk_load(
+            [parse_relationship("pod:ns/p0#creator@user:alice")])
+        return server
+
+    def test_admission_error_maps_to_429_with_retry_after(self):
+        server = self._server()
+
+        def reject_stream(*a, **kw):
+            async def gen():
+                raise AdmissionRejectedError(
+                    "queue full", reason="queue_limit", retry_after_s=3.0)
+                yield  # pragma: no cover — makes this an async generator
+
+            return gen()
+
+        async def run():
+            # inject a rejection at the endpoint boundary (the prefilter
+            # LR stream): the chain must surface 429 + Retry-After, not
+            # 403/500/502
+            server.endpoint.lookup_resources_stream = reject_stream
+            client = server.get_embedded_client(user="alice")
+            resp = await client.get("/api/v1/pods")
+            assert resp.status == 429, resp.body
+            assert resp.headers.get("Retry-After") == "3"
+            assert b"TooManyRequests" in resp.body
+
+        asyncio.run(run())
+
+    def test_shedder_rejects_reads_keeps_writes(self):
+        server = self._server(shed_queue_depth=1, shed_retry_after_s=2.0)
+        # force the saturation signal
+        server.shedder._stats_fn = lambda: {"check_queue_depth": 5}
+
+        async def run():
+            client = server.get_embedded_client(user="alice")
+            resp = await client.get("/api/v1/pods")
+            assert resp.status == 429, resp.body
+            assert resp.headers.get("Retry-After") == "2"
+            # /readyz reflects shedding as degraded-but-200
+            ready = await client.get("/readyz")
+            assert ready.status == 200
+            assert b"admission control shedding" in ready.body
+            # health endpoints and metrics are never shed
+            assert (await client.get("/livez")).status == 200
+            resp = await client.get("/metrics")
+            assert resp.status == 200
+            assert b"authz_admission_rejected_total" in resp.body
+
+        asyncio.run(run())
+
+
+class TestOverloadBehavior:
+    """Overload turns into fast 429s + sustained goodput, never a hang:
+    queues bounded, admitted work completes, rejected work fails fast."""
+
+    def test_overload_sheds_and_keeps_goodput(self):
+        ep, inner = make(max_queue_depth=4, n_docs=9)
+
+        async def run():
+            first = await hold_batch_inflight(ep, inner)
+            results = []
+
+            async def one(i):
+                try:
+                    r = await ep.check_permission(check(f"u{i % 3}",
+                                                        f"d{i % 9}"))
+                    results.append(("ok", r))
+                except AdmissionRejectedError:
+                    results.append(("shed", None))
+
+            tasks = [asyncio.create_task(one(i)) for i in range(24)]
+            await asyncio.sleep(0.01)
+            inner.gate.set()
+            # never hangs: everything resolves quickly once the gate
+            # opens (rejections resolved even before it)
+            await asyncio.wait_for(asyncio.gather(first, *tasks), timeout=10)
+            kinds = [k for k, _ in results]
+            assert kinds.count("shed") >= 1, "overload never shed"
+            assert kinds.count("ok") >= 4, "no goodput under overload"
+            # post-overload: the system recovers completely
+            r = await ep.check_permission(check("u0"))
+            assert r.allowed
+
+        asyncio.run(run())

@@ -282,11 +282,16 @@ class TestRandomizedDifferential:
         assert_matches(ep, oracle, "doc", ["d0"], ["view"], subjects)
         assert ep.stats["rebuilds"] == rebuilds
 
-        # first UNDECIDABLE caveat: exactly one rebuild (turns planes on)
+        # first UNDECIDABLE caveat: exactly one rebuild (turns planes
+        # on).  The rebuild runs off-loop now: answers stay exact
+        # throughout (stale pairs route to the oracle), and
+        # wait_rebuilds() quiesces before the count is asserted.
         ep.store.write([RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
             f"doc:d0#required@user:a{UNDECIDED}"))])
         assert_matches(ep, oracle, "doc", ["d0"], ["gated"], subjects)
+        assert ep.wait_rebuilds()
         assert ep.stats["rebuilds"] == rebuilds + 1
+        assert not ep._stale_pairs
 
         # subsequent undecidable writes on compiled ids are incremental
         # (user:a is compiled; user:b would be a new-id rebuild, which is
@@ -295,6 +300,7 @@ class TestRandomizedDifferential:
             f"doc:d0#blocked@user:a{UNDECIDED}"))])
         assert_matches(ep, oracle, "doc", ["d0"], ["view", "strict"],
                        subjects)
+        assert ep.wait_rebuilds()
         assert ep.stats["rebuilds"] == rebuilds + 1
 
     @pytest.mark.parametrize("seed", [0, 1])

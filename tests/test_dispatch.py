@@ -479,3 +479,136 @@ def test_two_phase_finish_failure_isolates_poison_member():
     assert sorted(good) == ["d0", "d1", "d2"]
     assert isinstance(bad, RuntimeError) and "poison" in str(bad)
     assert inner.finish_calls >= 1  # the fused phase 2 actually ran+failed
+
+
+def test_lr_rotation_no_head_of_line_starvation():
+    """Fairness regression (two competing keys under load): a hot
+    (type, perm) key with a deep backlog must yield the drain to every
+    other queued key between its batches — strict rotation, so the cold
+    key's single waiter never sits behind the hot key's whole backlog."""
+    schema_text = """
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+definition pod {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+    schema = sch.parse_schema(schema_text)
+    inner = CountingEndpoint(schema)
+    inner.store.write(
+        [RelationshipUpdate(op=UpdateOp.TOUCH, rel=parse_relationship(r))
+         for r in ["doc:d0#viewer@user:h0", "pod:p0#viewer@user:cold"]])
+    order = []
+    orig = inner.lookup_resources_batch
+
+    async def recording(resource_type, permission, subjects):
+        order.append((resource_type, len(subjects)))
+        return await orig(resource_type, permission, subjects)
+
+    inner.lookup_resources_batch = recording
+    inner.slow = True
+    ep = BatchingEndpoint(inner, max_batch=2)
+
+    async def run():
+        first = asyncio.create_task(ep.lookup_resources(
+            "doc", "view", SubjectRef("user", "h0")))
+        await asyncio.sleep(0.002)
+        # hot key backlog: 8 distinct doc subjects = 4 batches at
+        # max_batch=2; then ONE cold pod waiter arrives behind them
+        hot = [asyncio.create_task(ep.lookup_resources(
+            "doc", "view", SubjectRef("user", f"h{i}")))
+            for i in range(1, 9)]
+        await asyncio.sleep(0)
+        cold = asyncio.create_task(ep.lookup_resources(
+            "pod", "view", SubjectRef("user", "cold")))
+        await asyncio.gather(first, cold, *hot)
+
+    asyncio.run(run())
+    # drop the lone leader call; the cold key must be served before the
+    # hot backlog finishes (rotation), not after all 4 hot batches
+    fused = order[1:]
+    cold_pos = next(i for i, (t, _n) in enumerate(fused) if t == "pod")
+    assert cold_pos <= 1, (
+        f"cold key starved behind hot backlog: order={fused}")
+
+
+def test_cobatched_member_cancellation_mid_fused_batch():
+    """Regression (client disconnect): cancelling ONE waiter while its
+    fused batch is mid-flight must not poison co-batched members (they
+    still get results) and must not leak the singleflight leader (the
+    pending map empties; an identical later query starts fresh)."""
+    ep, inner = make(n_docs=4, users=("alice", "bob"))
+    inner.slow = True
+
+    async def run():
+        first = asyncio.create_task(ep.check_permission(check("alice", "d0")))
+        await asyncio.sleep(0.002)
+        # co-batched: two checks + two lookups (distinct subjects) queue
+        # for the next drain
+        c_keep = asyncio.create_task(ep.check_permission(check("bob", "d1")))
+        c_cancel = asyncio.create_task(
+            ep.check_permission(check("alice", "d2")))
+        l_keep = asyncio.create_task(ep.lookup_resources(
+            "doc", "view", SubjectRef("user", "alice")))
+        l_cancel = asyncio.create_task(ep.lookup_resources(
+            "doc", "view", SubjectRef("user", "bob")))
+        await asyncio.sleep(0)
+        # wait until the co-batch is IN FLIGHT, then disconnect two
+        # members mid-batch
+        for _ in range(100):
+            await asyncio.sleep(0.001)
+            if ep.stats["inflight_batch"]:
+                break
+        c_cancel.cancel()
+        l_cancel.cancel()
+        keep_res = await c_keep
+        keep_ids = sorted(await l_keep)
+        with pytest.raises(asyncio.CancelledError):
+            await c_cancel
+        with pytest.raises(asyncio.CancelledError):
+            await l_cancel
+        await first
+        assert keep_res.allowed
+        assert keep_ids == ["d0", "d2"]
+        # no singleflight leader leaked for the cancelled lookup: the
+        # window closed at pickup and the maps drained with the batch
+        assert ep._lr_pending == {}
+        assert ep._sf_counts == {}
+        # an identical re-issue of the cancelled query starts fresh and
+        # completes (nothing poisoned)
+        again = sorted(await ep.lookup_resources(
+            "doc", "view", SubjectRef("user", "bob")))
+        assert again == ["d1", "d3"]
+
+    asyncio.run(run())
+
+
+def test_cancelled_follower_before_pickup_leader_still_drains():
+    """A follower cancelled BEFORE drain pickup leaves the queued leader
+    intact: the leader future completes at drain, the pending map entry
+    is removed at pickup, and nothing leaks."""
+    ep, inner = make(n_docs=2, users=("alice",))
+    inner.slow = True
+
+    async def run():
+        first = asyncio.create_task(ep.lookup_resources(
+            "doc", "view", SubjectRef("user", "alice")))
+        await asyncio.sleep(0.002)
+        doomed = asyncio.create_task(ep.lookup_resources(
+            "doc", "view", SubjectRef("user", "alice")))
+        survivor = asyncio.create_task(ep.lookup_resources(
+            "doc", "view", SubjectRef("user", "alice")))
+        await asyncio.sleep(0)
+        doomed.cancel()
+        got = sorted(await survivor)
+        with pytest.raises(asyncio.CancelledError):
+            await doomed
+        await first
+        assert got == ["d0", "d1"]
+        assert ep._lr_pending == {}
+
+    asyncio.run(run())

@@ -1,0 +1,229 @@
+"""Dispatch-path fault-injection kill matrix (utils/failpoints.py).
+
+Every injected fault on the dispatch/rebuild pipeline must (1) fail the
+waiters it strands FAST — bounded by a timeout, never a hang; (2) keep
+the arena pool and HBM ledger invariant; (3) leave the system serving
+correct answers afterwards (a crashed background rebuild leaves the old
+generation up).  Sites: drain-task death (both before dispatch and
+between two-phase start/finish), readback-waiter death, arena-pool
+poisoning, and a rebuild-executor crash.
+"""
+
+import asyncio
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.utils import devtel
+from spicedb_kubeapi_proxy_tpu.utils.failpoints import (
+    FailPointPanic,
+    disable_all,
+    enable_failpoint,
+)
+
+SCHEMA = """
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+
+WAIT_S = 10  # fail-fast bound: every stranded waiter resolves within this
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    disable_all()
+    yield
+    disable_all()
+
+
+def make(n_docs=8, **batch_kw):
+    schema = sch.parse_schema(SCHEMA)
+    jx = JaxEndpoint(schema, store=TupleStore())
+    jx.store.write([
+        RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            f"doc:d{i}#viewer@user:u{i % 4}")) for i in range(n_docs)])
+    oracle = Evaluator(schema, jx.store)
+    return BatchingEndpoint(jx, **batch_kw), jx, oracle
+
+
+def check(user, doc="d0"):
+    return CheckRequest(resource=ObjectRef("doc", doc), permission="view",
+                        subject=SubjectRef("user", user))
+
+
+async def fanout(ep, n=6):
+    """n concurrent lookups on distinct subjects + n checks; returns the
+    per-task results/exceptions (never hangs past WAIT_S)."""
+    tasks = [asyncio.create_task(ep.lookup_resources(
+        "doc", "view", SubjectRef("user", f"u{i % 4}")))
+        for i in range(n)]
+    tasks += [asyncio.create_task(ep.check_permission(check(f"u{i % 4}",
+                                                           f"d{i % 8}")))
+              for i in range(n)]
+    done = await asyncio.wait_for(
+        asyncio.gather(*tasks, return_exceptions=True), timeout=WAIT_S)
+    return done
+
+
+def assert_serving_correctly(ep, oracle):
+    async def run():
+        for u in ("u0", "u1", "u2"):
+            got = sorted(await ep.lookup_resources(
+                "doc", "view", SubjectRef("user", u)))
+            want = sorted(oracle.lookup_resources(
+                "doc", "view", SubjectRef("user", u)))
+            assert got == want, (u, got, want)
+
+    asyncio.run(run())
+
+
+def arena_ledger_consistent(jx):
+    """Ledger invariant: at most one registered arena per (gen, bucket)
+    name, and the per-generation total matches what register() summed —
+    i.e. no double-count and no stranded negative entries."""
+    gen = jx._devtel_gen
+    with devtel.LEDGER._lock:
+        entries = {k: v for k, v in devtel.LEDGER._buffers.items()
+                   if k[0] == gen and k[1] == "state_arena"}
+        names = [k[2] for k in entries]
+        assert len(names) == len(set(names))
+        assert all(v >= 0 for v in entries.values())
+    return True
+
+
+class TestKillMatrix:
+    def test_drain_death_fails_every_waiter_fast(self):
+        ep, jx, oracle = make()
+
+        async def run():
+            enable_failpoint("dispatchDrain", 1)
+            results = await fanout(ep)
+            # the dying drain failed its waiters promptly — every task
+            # resolved (to the panic or a drain-cancel error), none hung
+            failures = [r for r in results if isinstance(r, Exception)]
+            assert failures, "drain death produced no failures?"
+            assert all(isinstance(r, (FailPointPanic, RuntimeError))
+                       for r in failures), results
+
+        asyncio.run(run())
+        # disarmed: a fresh drain task serves correctly again
+        disable_all()
+        assert_serving_correctly(ep, oracle)
+        assert arena_ledger_consistent(jx)
+
+    def test_drain_death_between_start_and_finish(self):
+        # pipeline window >= 1 so started-but-unfinished batches exist
+        ep, jx, oracle = make(pipeline_depth=3)
+
+        async def run():
+            enable_failpoint("dispatchDrainBeforeFinish", 1)
+            results = await fanout(ep, n=8)
+            failures = [r for r in results if isinstance(r, Exception)]
+            # started batches joined `pending` before the blocking
+            # finish, so the drain's death failed them too — fast
+            assert failures, "no waiter observed the drain death"
+
+        asyncio.run(run())
+        disable_all()
+        assert_serving_correctly(ep, oracle)
+        assert arena_ledger_consistent(jx)
+
+    def test_readback_waiter_death_discards_arena_and_recovers(self):
+        ep, jx, oracle = make(pipeline_depth=2)
+        # prime: one pipelined call allocates + pools the arena
+        assert_serving_correctly(ep, oracle)
+
+        async def run():
+            enable_failpoint("readbackWaiter", 1)
+            results = await fanout(ep, n=4)
+            # the dispatcher's per-member retry absorbs the failed fused
+            # finish: callers still get ANSWERS, not exceptions
+            failures = [r for r in results if isinstance(r, Exception)]
+            assert not failures, failures
+
+        asyncio.run(run())
+        # the poisoned arena was discarded (on_error) — never re-pooled
+        # into later calls — and the ledger stayed consistent
+        assert arena_ledger_consistent(jx)
+        disable_all()
+        assert_serving_correctly(ep, oracle)
+        assert arena_ledger_consistent(jx)
+
+    def test_arena_take_poisoning_fails_fast_then_recovers(self):
+        ep, jx, oracle = make(pipeline_depth=2)
+        assert_serving_correctly(ep, oracle)
+
+        async def run():
+            # poison several takes: the pipelined dispatch degrades to
+            # the serial fused path (no arenas) and still answers
+            enable_failpoint("arenaTake", 4)
+            results = await fanout(ep, n=4)
+            failures = [r for r in results if isinstance(r, Exception)]
+            assert not failures, failures
+
+        asyncio.run(run())
+        disable_all()
+        assert_serving_correctly(ep, oracle)
+        assert arena_ledger_consistent(jx)
+
+    def test_rebuild_executor_crash_leaves_old_generation_serving(self):
+        ep, jx, oracle = make()
+        assert_serving_correctly(ep, oracle)
+        gen_before = jx._devtel_gen
+        total_before = devtel.LEDGER.generation_bytes(gen_before)
+        failures_before = jx.stats["rebuild_failures"]
+
+        enable_failpoint("rebuildExecutor", 1)
+        # wildcard write forces a rebuild; the background build crashes
+        jx.store.write([RelationshipUpdate(UpdateOp.TOUCH,
+                                           parse_relationship(
+                                               "doc:dw#viewer@user:*"))])
+        # answers stay exact THROUGH the crash: quarantined pairs route
+        # to the oracle, everything else rides the old generation
+        assert_serving_correctly(ep, oracle)
+        for _ in range(200):
+            if jx.stats["rebuild_failures"] > failures_before:
+                break
+            import time
+            time.sleep(0.01)
+        assert jx.stats["rebuild_failures"] == failures_before + 1
+        # old generation untouched in the ledger
+        assert jx._devtel_gen == gen_before
+        assert devtel.LEDGER.generation_bytes(gen_before) == total_before
+        # failpoint consumed: the retry (re-armed by the next query via
+        # wait_rebuilds) succeeds and clears the quarantine
+        disable_all()
+        assert jx.wait_rebuilds()
+        assert not jx._stale_pairs
+        assert jx._devtel_gen != gen_before
+        assert_serving_correctly(ep, oracle)
+
+    def test_matrix_sweep_no_hang_anywhere(self):
+        """Belt-and-braces: arm every site in sequence under the same
+        traffic shape; the only universal invariant is NO HANG and full
+        recovery after disarm."""
+        for site in ("dispatchDrain", "dispatchDrainBeforeFinish",
+                     "readbackWaiter", "arenaTake", "rebuildExecutor"):
+            ep, jx, oracle = make(pipeline_depth=3)
+            assert_serving_correctly(ep, oracle)
+            enable_failpoint(site, 2)
+            asyncio.run(fanout(ep, n=6))  # bounded by WAIT_S internally
+            disable_all()
+            assert_serving_correctly(ep, oracle)
+            assert arena_ledger_consistent(jx)
+            assert jx.wait_rebuilds()

@@ -301,7 +301,12 @@ class TestIncrementalDeltas:
         the boundary.  The sizing divisor is patched to 1 so the resize
         is observable at unit-test scale."""
         from spicedb_kubeapi_proxy_tpu.ops import jax_endpoint as je
+        from spicedb_kubeapi_proxy_tpu.utils.features import GATES
         monkeypatch.setattr(je, "_SPARE_DIVISOR", 1)
+        # this test probes the SYNCHRONOUS exhaustion->rebuild fallback
+        # (the AsyncRebuild killswitch path); the off-loop flavor is
+        # covered by tests/test_rebuild_async.py
+        monkeypatch.setattr(GATES._gates["AsyncRebuild"], "value", False)
         jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns1#viewer@user:alice"])
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
         floor_pool = len(jx._spare_pool["namespace"])

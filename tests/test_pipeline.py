@@ -390,6 +390,68 @@ class TestPrewarm:
                     if e.stage == "compile" and e.track == "device"]
         assert compiles == []
 
+    def test_off_diagonal_bulk_check_compiles_nothing(self, kernel_kind):
+        """A bulk check whose request count and distinct-subject count
+        land in DIFFERENT pow-2 buckets must still hit a prewarmed jit
+        key: the gather bucket is floored at the lane width, because an
+        independent gather ladder put the first real fused check on an
+        off-diagonal (lanes, gather) shape — a multi-second lazy
+        compile on the hot path that the churn soak flagged (the shape
+        retrace is attributed as a device-track compile slice, so this
+        asserts on the timeline, not wall time)."""
+        jx, _ = make_pair(seed=31)
+        jx.warm_start(prewarm=True)
+        mark = timeline.now()
+
+        async def go():
+            # 16 requests from 3 distinct subjects: gather bucket 16,
+            # subject lanes bucket 8/32 — off-diagonal before the floor
+            await jx.check_bulk_permissions([
+                CheckRequest(ObjectRef("doc", f"d{i}"), "view",
+                             SubjectRef("user", f"u{i % 3}"))
+                for i in range(16)])
+
+        asyncio.run(go())
+        compiles = [e for e in timeline.TIMELINE.events(since=mark)
+                    if e.stage == "compile" and e.track == "device"]
+        assert compiles == []
+
+    def test_prewarm_covers_flush_scatter_ladder(self, kernel_kind):
+        """warm_start(prewarm=True) pre-compiles the delta-flush
+        scatter ladder (pad_scatter buckets 16..512): each novel
+        `.at[rows].set` shape was a lazy XLA scatter compile under the
+        endpoint lock on the first drain of that size.  The prewarm
+        scatters are idempotent — device tables must be bit-identical
+        after — and recorded as `prewarm="flush"` compile events."""
+        jx, oracle = make_pair(seed=33)
+        jx.warm_start()  # build the graph without prewarm
+        g = jx._graph
+        before = {}
+        for name in ("dev_main", "dev_aux", "dev_cav",
+                     "edge_src", "edge_dst"):
+            arr = getattr(g, name, None)
+            if arr is not None and getattr(arr, "size", 0):
+                before[name] = np.asarray(arr).copy()
+        mark = timeline.now()
+        warmed = g.prewarm_flush()
+        assert warmed > 0
+        evs = [e for e in timeline.TIMELINE.events(since=mark)
+               if e.stage == "compile" and e.track == "rebuild"
+               and e.attrs and e.attrs.get("prewarm") == "flush"]
+        assert {e.bucket for e in evs} >= {16, 64, 512}
+        for name, arr in before.items():
+            np.testing.assert_array_equal(np.asarray(getattr(g, name)),
+                                          arr, err_msg=name)
+        # and the graph still answers correctly after the rewrite
+        async def go():
+            got = await jx.lookup_resources_batch(
+                "doc", "view", [SubjectRef("user", "u0")])
+            want = oracle.lookup_resources("doc", "view",
+                                           SubjectRef("user", "u0"))
+            assert sorted(got[0]) == sorted(want)
+
+        asyncio.run(go())
+
 
 # -- CPU e2e: the pipeline overlaps transfer with compute ---------------------
 
