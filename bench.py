@@ -1038,9 +1038,354 @@ def bench_pipeline_depth(args) -> dict:
     return out
 
 
+REPLICA_WORKER_SPEC = {
+    "n_pods": 10_000, "n_users": 100, "n_tuples": 30_000,
+    "lookup_batch": 32, "measure_s": 4.0,
+}
+
+
+def replica_worker(spec_json: str) -> None:
+    """`bench.py --replica-worker <spec-json>` subprocess: one follower
+    tailing the leader's replication API over real HTTP and serving
+    batched filtered-list reads from its own device graph.  Protocol
+    on stdio: print READY after warm; each `RUN` line on stdin runs one
+    measured window and prints `DONE <json>`; `EXIT` quits.  A separate
+    process per follower is the point — N proxy replicas behind a load
+    balancer are separate processes, and the GIL would serialize
+    in-process reader threads into an anti-measurement."""
+    import asyncio
+
+    spec = json.loads(spec_json)
+    from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+    from spicedb_kubeapi_proxy_tpu.proxy.httpcore import H11Transport
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.replication import ReplicaFollower
+    from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+    workload = wl.pods_depth1(n_pods=spec["n_pods"],
+                              n_users=spec["n_users"],
+                              n_tuples=spec["n_tuples"])
+    schema = sch.parse_schema(workload.schema_text)
+    store = TupleStore()
+    repl = ReplicaFollower(store, H11Transport(spec["leader"]),
+                           identity=spec["identity"])
+    ep = JaxEndpoint(schema, store=store)
+    lookup_batch = spec["lookup_batch"]
+
+    def subjects(base):
+        return [SubjectRef("user", workload.subjects[
+            (base + k) % len(workload.subjects)])
+            for k in range(lookup_batch)]
+
+    async def measured_window(seconds: float) -> dict:
+        await repl.sync_once()  # catch up the backlog untimed
+        lists = 0
+        lags: list = []
+        base = 0
+        stop = asyncio.Event()
+
+        async def tail():
+            # the tail runs CONCURRENTLY with reads, exactly like the
+            # server's follower task — reads never block on leader RTT.
+            # Lag is sampled just BEFORE each sync: the staleness a
+            # read arriving at that moment would actually observe.
+            while not stop.is_set():
+                lags.append(repl.lag_revisions())
+                try:
+                    await repl.sync_once()
+                except Exception:
+                    pass  # transient leader hiccup; lag keeps counting
+                await asyncio.sleep(0.05)
+
+        tail_task = asyncio.ensure_future(tail())
+        t0 = time.time()
+        while time.time() - t0 < seconds:
+            await ep.lookup_resources_batch(
+                workload.resource_type, workload.permission,
+                subjects(base))
+            base += lookup_batch
+            lists += lookup_batch
+        elapsed = time.time() - t0
+        stop.set()
+        await tail_task
+        lags.sort()
+
+        def pct(p):
+            return (float(lags[min(len(lags) - 1, int(p * len(lags)))])
+                    if lags else 0.0)
+
+        return {"lists": lists, "elapsed_s": round(elapsed, 3),
+                "lists_per_s": round(lists / elapsed, 1),
+                "lag_p50": pct(0.5), "lag_p99": pct(0.99),
+                "lag_samples": len(lags),
+                "applied_records": repl.stats["applied_records"]}
+
+    async def main_loop():
+        await repl.sync_once()
+        await ep.lookup_resources_batch(
+            workload.resource_type, workload.permission, subjects(0))
+        print("READY", flush=True)
+        loop = asyncio.get_running_loop()
+        while True:
+            line = await loop.run_in_executor(None, sys.stdin.readline)
+            if not line or line.strip() == "EXIT":
+                return
+            if line.strip() == "RUN":
+                res = await measured_window(spec["measure_s"])
+                print("DONE " + json.dumps(res), flush=True)
+
+    asyncio.run(main_loop())
+
+
+def bench_replica_scale(args) -> dict:
+    """WAL-shipping read-replica scaling (ISSUE 9): one leader taking
+    write churn, its WAL served over real localhost HTTP by the
+    replication hub, and N follower PROCESSES (replica_worker above —
+    one process per replica, as deployed) each bootstrapping, tailing,
+    and serving batched filtered-list reads from its own device graph.
+    Reports aggregate filtered-list throughput at 1/2/4 followers plus
+    per-follower lag percentiles; headline column
+    `replica_read_scaling` = 2-follower aggregate over 1-follower
+    (acceptance >= 1.7x on CPU — note the hardware ceiling: aggregate
+    scaling cannot exceed the machine's core count)."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+    from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+        HttpServer,
+        json_response,
+    )
+    from spicedb_kubeapi_proxy_tpu.spicedb.persist import PersistenceManager
+    from spicedb_kubeapi_proxy_tpu.spicedb.replication import ReplicationHub
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+        RelationshipUpdate,
+        UpdateOp,
+        parse_relationship,
+    )
+
+    spec = dict(REPLICA_WORKER_SPEC)
+    fleet_sizes = (1, 2, 4)
+    workload = wl.pods_depth1(n_pods=spec["n_pods"],
+                              n_users=spec["n_users"],
+                              n_tuples=spec["n_tuples"])
+
+    tmp = tempfile.mkdtemp(prefix="replica-bench-")
+    stage("replica-scale: leader build + journal")
+    mgr = PersistenceManager(tmp, fsync="never")
+    leader_store = mgr.recover()
+    mgr.attach(leader_store)
+    leader_store.bulk_load_text("\n".join(workload.relationships))
+    hub = ReplicationHub(leader_store, mgr)
+    hub.attach()
+
+    async def hub_handler(req):
+        path = req.path
+        if path == "/replication/manifest":
+            return await hub.serve_manifest(req)
+        if path.startswith("/replication/segment/"):
+            return hub.serve_segment(req, path.rsplit("/", 1)[1])
+        if path.startswith("/replication/checkpoint/"):
+            return hub.serve_checkpoint(req, path.rsplit("/", 1)[1])
+        return json_response(404, {"message": f"unknown {path}"})
+
+    # leader HTTP serving + churn run on a dedicated thread's loop so
+    # the measured follower processes see a live leader throughout
+    ready = threading.Event()
+    stop = threading.Event()
+    port_box: dict = {}
+
+    def leader_thread():
+        async def run():
+            server = HttpServer(hub_handler)
+            port_box["port"] = await server.start("127.0.0.1", 0)
+            ready.set()
+            # ~50 writes/s of churn: enough to keep every follower's
+            # tail busy without the (unpinned) leader thread eating the
+            # fixed per-replica core budgets it is refereeing
+            i = 0
+            while not stop.is_set():
+                line = workload.relationships[
+                    i % len(workload.relationships)]
+                op = UpdateOp.DELETE if i % 2 else UpdateOp.TOUCH
+                leader_store.write([RelationshipUpdate(
+                    op, parse_relationship(line))])
+                i += 1
+                await asyncio.sleep(0.02)
+            await server.stop()
+
+        asyncio.run(run())
+
+    lt = threading.Thread(target=leader_thread, daemon=True)
+    lt.start()
+    ready.wait(10)
+    leader_url = f"http://127.0.0.1:{port_box['port']}"
+
+    out: dict = {"fleet": {}, "measure_s": spec["measure_s"],
+                 "lookup_batch": spec["lookup_batch"],
+                 "tuples": len(workload.relationships),
+                 "cores": os.cpu_count()}
+    workers: list = []
+    try:
+        stage(f"replica-scale: spawn + warm {max(fleet_sizes)} follower "
+              f"processes")
+        # fixed per-replica CPU budget (1 core, single-threaded XLA):
+        # production replicas are separate nodes, so the scaling claim
+        # is "aggregate throughput grows as replicas are added at a
+        # constant per-replica budget" — without the pin, one XLA
+        # intra-op pool eats every local core and the baseline is
+        # already machine-saturated, measuring contention, not scaling
+        taskset = shutil.which("taskset")
+        ncores = os.cpu_count() or 1
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_cpu_multi_thread_eigen=false "
+                             "intra_op_parallelism_threads=1",
+                   OMP_NUM_THREADS="1", OPENBLAS_NUM_THREADS="1")
+        for i in range(max(fleet_sizes)):
+            wspec = dict(spec, leader=leader_url, identity=f"replica-{i}")
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--replica-worker", json.dumps(wspec)]
+            if taskset:
+                cmd = [taskset, "-c", str(i % ncores)] + cmd
+            workers.append(subprocess.Popen(
+                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                env=env, text=True, bufsize=1))
+        for w in workers:
+            line = w.stdout.readline()
+            assert line.strip() == "READY", f"worker said {line!r}"
+
+        def window(n):
+            for w in workers[:n]:
+                w.stdin.write("RUN\n")
+                w.stdin.flush()
+            results = []
+            for w in workers[:n]:
+                while True:
+                    line = w.stdout.readline()
+                    if line.startswith("DONE "):
+                        results.append(json.loads(line[5:]))
+                        break
+                    if not line:
+                        raise AssertionError("worker died mid-run")
+            return results
+
+        # interleaved rounds, median per fleet size (same methodology
+        # as the pipeline-depth A/B): this box's background load drifts
+        # minute to minute, and sequential one-shot windows would hand
+        # whichever fleet size ran during a quiet patch a fake win
+        rounds = 3
+        acc: dict = {n: [] for n in fleet_sizes}
+        for r in range(rounds):
+            for n in fleet_sizes:
+                stage(f"replica-scale round {r + 1}/{rounds}: {n} "
+                      f"follower process(es) under churn")
+                acc[n].append(window(n))
+        for n in fleet_sizes:
+            aggs = [sum(res["lists_per_s"] for res in results)
+                    for results in acc[n]]
+            agg = statistics.median(aggs)
+            flat = [res for results in acc[n] for res in results]
+            lag_p50 = statistics.median(res["lag_p50"] for res in flat)
+            lag_p99 = max(res["lag_p99"] for res in flat)
+            out["fleet"][str(n)] = {
+                "aggregate_lists_per_s": round(agg, 1),
+                "aggregate_lists_per_s_rounds": [round(a, 1)
+                                                 for a in aggs],
+                "aggregate_checks_per_s": round(
+                    agg * workload.expected_objects, 1),
+                "per_follower_lists_per_s": round(agg / n, 1),
+                "lag_revisions_p50": lag_p50,
+                "lag_revisions_p99": lag_p99,
+                "lag_samples": sum(res["lag_samples"] for res in flat),
+            }
+            log(f"replica-scale n={n}: {agg:.1f} lists/s aggregate "
+                f"(median of {aggs}), lag p50/p99 = "
+                f"{lag_p50}/{lag_p99} revisions")
+    finally:
+        for w in workers:
+            try:
+                w.stdin.write("EXIT\n")
+                w.stdin.flush()
+            except OSError:
+                pass
+        for w in workers:
+            try:
+                w.wait(10)
+            except subprocess.TimeoutExpired:
+                w.kill()
+        stop.set()
+        lt.join(10)
+        mgr.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    stage("replica-scale: CPU pair-scaling ceiling probe")
+    out["cpu_pair_scaling_ceiling"] = _cpu_pair_ceiling(taskset)
+
+    # scaling is estimated from PAIRED per-round ratios (windows inside
+    # one round are adjacent in time), because ambient load on a shared
+    # box drifts across rounds by more than the effect being measured;
+    # the n=1 round spread is recorded so a reader can judge the noise
+    base_rounds = [sum(res["lists_per_s"] for res in results)
+                   for results in acc[1]]
+    out["noise_spread_1x"] = round(
+        max(base_rounds) / max(min(base_rounds), 1e-9), 2)
+    for n in fleet_sizes[1:]:
+        ratios = [
+            sum(res["lists_per_s"] for res in results) / max(b, 1e-9)
+            for results, b in zip(acc[n], base_rounds)]
+        out[f"scaling_{n}x"] = round(statistics.median(ratios), 2)
+        out[f"scaling_{n}x_rounds"] = [round(r, 2) for r in ratios]
+    out["replica_read_scaling"] = out.get("scaling_2x", 0.0)
+    ceiling = out["cpu_pair_scaling_ceiling"]
+    out["replica_read_scaling_normalized"] = round(
+        out["replica_read_scaling"] / max(ceiling, 1e-9), 2)
+    log(f"replica-scale: read scaling at 2 followers = "
+        f"{out['replica_read_scaling']}x raw (acceptance >= 1.7x on >=2 "
+        f"free cores), {out['replica_read_scaling_normalized']}x of this "
+        f"box's measured pair ceiling {ceiling}x; at 4 = "
+        f"{out.get('scaling_4x')}x on {out['cores']} cores "
+        f"(n=1 round noise spread {out['noise_spread_1x']}x)")
+    return out
+
+
+def _cpu_pair_ceiling(taskset) -> float:
+    """This box's measured 2-process CPU scaling ceiling: two pinned
+    pure-python burners over one, same pinning as the follower workers.
+    Throttled/oversubscribed CI vCPUs cap well below 2.0 (measured 1.57
+    on the 2-vCPU sandbox) — the replica scaling number cannot exceed
+    this no matter how perfect the replication path is, so the artifact
+    records it next to the raw scaling."""
+    burn = ("import time\nt0=time.time()\nn=0\n"
+            "while time.time()-t0<1.5:\n"
+            "    x=0\n"
+            "    for i in range(100000):\n"
+            "        x+=i*i\n"
+            "    n+=1\n"
+            "print(n)")
+
+    def spawn(pin):
+        cmd = [sys.executable, "-c", burn]
+        if taskset:
+            cmd = [taskset, "-c", str(pin)] + cmd
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+
+    single = int(spawn(0).communicate(timeout=30)[0])
+    pair = [spawn(0), spawn(1)]
+    total = sum(int(p.communicate(timeout=30)[0]) for p in pair)
+    return round(total / max(single, 1), 2)
+
+
 # device-resident pipeline A/B (ISSUE 7): same contract as CACHE_CONFIGS
 PIPELINE_CONFIGS = {
     "pipeline-depth": bench_pipeline_depth,
+}
+
+# WAL-shipping replication scale-out (ISSUE 9): same contract
+REPLICATION_CONFIGS = {
+    "replica-scale": bench_replica_scale,
 }
 
 # decision-cache bench configs (ISSUE 3): run standalone via --config or
@@ -1075,7 +1420,8 @@ def main() -> None:
     ap.add_argument("--config", default="multitenant-1m",
                     choices=(list(CONFIGS) + list(CACHE_CONFIGS)
                              + list(PERSIST_CONFIGS)
-                             + list(PIPELINE_CONFIGS)))
+                             + list(PIPELINE_CONFIGS)
+                             + list(REPLICATION_CONFIGS)))
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--oracle-queries", type=int, default=2)
@@ -1106,7 +1452,14 @@ def main() -> None:
     ap.add_argument("--direct-only", action="store_true",
                     help="headline = direct batched call instead of the "
                          "concurrent dispatcher path")
+    ap.add_argument("--replica-worker", default="", help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.replica_worker:
+        # replica-scale follower subprocess: no probe, no watchdog —
+        # the parent bench owns the lifecycle (see replica_worker)
+        replica_worker(args.replica_worker)
+        return
 
     start_watchdog(args.deadline)
     path_desc = (f"{args.batch}-subject direct batched call"
@@ -1175,6 +1528,24 @@ def main() -> None:
               "platform": _STATE["platform"],
               "baseline": "DevicePipeline gate off (host-pack serial "
                           "dispatch, the pre-PR path)",
+              **res})
+        return
+
+    if args.config in REPLICATION_CONFIGS:
+        # standalone replication config: 2-follower read scaling is the
+        # headline, single-follower aggregate is the baseline
+        stage(f"replication config {args.config}")
+        tel_before = devtel_snapshot()
+        res = REPLICATION_CONFIGS[args.config](args)
+        tel = devtel_delta(tel_before)
+        if tel:
+            res["device_telemetry"] = tel
+        _STATE["metric"] = f"replication {args.config}"
+        emit({"metric": _STATE["metric"],
+              "value": res.get("replica_read_scaling", 0.0), "unit": "x",
+              "platform": _STATE["platform"],
+              "baseline": "single follower aggregate filtered-list "
+                          "throughput (same churn, same graph)",
               **res})
         return
 
@@ -1387,7 +1758,7 @@ def main() -> None:
         # too (hit rate, on/off speedup, churn divergences, and the
         # restart time-to-serve + WAL write-overhead columns)
         for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS,
-                         **PIPELINE_CONFIGS}.items():
+                         **PIPELINE_CONFIGS, **REPLICATION_CONFIGS}.items():
             try:
                 tel_before = devtel_snapshot()
                 tl_mark = timeline_mark()

@@ -25,6 +25,13 @@ echo "== lint gate (scripts/lint.py; CI additionally runs ruff)"
 # pipeline's win must not silently reserialize)
 python scripts/lint.py
 
+echo "== static schema/rule lint (--lint-schema, Cedar-inspired)"
+# unreachable relations, statically-DENY permissions, rule templates
+# naming undefined relations — all from the relation_footprint closure,
+# before a single request is served (spicedb/schema_lint.py; errors
+# fail the gate, warnings are informational)
+JAX_PLATFORMS=cpu python -m spicedb_kubeapi_proxy_tpu --lint-schema
+
 if [[ "${1:-}" != "--fast" ]]; then
   echo "== unit + e2e suites with enforced-minimum line coverage"
   # COV_MIN overrides the floor; the default sits safely under the
@@ -47,6 +54,14 @@ echo "== crash-recovery smoke (kill -9 mid write-churn, restart, parity)"
 # SIGKILL mid-churn, recover on the same data dir, compare against an
 # uninterrupted host-oracle replay (fast, deterministic, no jax import)
 python scripts/crash_smoke.py
+
+echo "== replication smoke (leader + follower over localhost, kill -9)"
+# WAL-shipping read replicas (docs/replication.md): write through the
+# leader, assert the follower serves the filtered list within the lag
+# bound, kill -9 the leader, assert bounded-staleness reads keep
+# flowing with a degraded-but-200 /readyz (fast, embedded endpoint,
+# no jax on the serving path)
+JAX_PLATFORMS=cpu python scripts/replication_smoke.py
 
 echo "== device-telemetry smoke (/metrics + /debug/flight + /debug/timeline)"
 # the device-telemetry metric families (HBM ledger, jit-cache counters,

@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Replication smoke for scripts/check.sh (ISSUE 9).
+
+Three REAL processes over localhost HTTP:
+
+  1. spawn ONE shared fake kube-apiserver, then a leader (embedded
+     endpoint + durable data dir) and a follower (--replicate-from the
+     leader) both proxying it — like production, where N proxies front
+     the same cluster;
+  2. create a pod THROUGH the leader (dual-write: kube object + tuple);
+  3. assert the follower serves the filtered list including it within
+     the lag bound — replicated, not forwarded;
+  4. kill -9 the leader;
+  5. assert the follower keeps serving bounded-staleness reads, reports
+     degraded (still 200) /readyz, and rejects writes 503.
+
+No jax import on the serving path (embedded endpoint): runs in seconds.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  permission view = creator
+}
+definition pod {
+  relation creator: user
+  permission view = creator
+}
+"""
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+lock: Optimistic
+check: [{tpl: "namespace:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+"""
+
+LAG_BOUND_S = 8.0
+
+
+def serve(role: str, port: int, data_dir: str, leader_url: str,
+          kube_url: str) -> None:
+    """Child process: the shared fake kube-apiserver, or one proxy
+    serving plain HTTP with header authn in front of it."""
+    import asyncio
+    import logging
+
+    logging.basicConfig(
+        level=logging.WARNING,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+
+    from spicedb_kubeapi_proxy_tpu.proxy.authn import HeaderAuthenticator
+    from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+        H11Transport,
+        HttpServer,
+    )
+    from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import parse_relationship
+
+    if role == "kube":
+        from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import (
+            FakeKubeApiServer,
+        )
+
+        async def run_kube():
+            kube = FakeKubeApiServer()
+            kube.seed("", "v1", "namespaces",
+                      {"metadata": {"name": "team-a"}})
+            server = HttpServer(kube)
+            await server.start("127.0.0.1", port)
+            print(f"kube serving on {port}", flush=True)
+            await asyncio.Event().wait()
+
+        asyncio.run(run_kube())
+        return
+
+    opts = Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=H11Transport(kube_url),
+        authenticators=[HeaderAuthenticator()],
+        workflow_database_path="",  # in-memory dual-write journal
+    )
+    if role == "leader":
+        opts.data_dir = data_dir
+        opts.wal_fsync = "never"
+    else:
+        opts.replicate_from = leader_url
+        opts.replica_user = "system:replica"
+
+    async def run():
+        proxy = ProxyServer(opts)
+        if role == "leader":
+            proxy.endpoint.store.bulk_load([parse_relationship(
+                "namespace:team-a#creator@user:alice")])
+            proxy.enable_dual_writes()
+        await proxy.start("127.0.0.1", port)
+        print(f"{role} serving on {port}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+# -- parent-side helpers -----------------------------------------------------
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http(method: str, url: str, user: str = "", body=None, timeout=5.0):
+    headers = {"Accept": "application/json"}
+    if user:
+        headers["X-Remote-User"] = user
+    data = None
+    if body is not None:
+        data = json.dumps(body).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers,
+                                 method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def wait_ready(base: str, deadline_s: float, want_degraded=False) -> bytes:
+    t0 = time.time()
+    last = b""
+    while time.time() - t0 < deadline_s:
+        try:
+            status, _, body = http("GET", base + "/readyz", timeout=2.0)
+            last = body
+            if status == 200 and (b"[!]" in body if want_degraded else True):
+                return body
+        except OSError:
+            pass
+        time.sleep(0.1)
+    raise AssertionError(
+        f"{base}/readyz not {'degraded' if want_degraded else 'ready'} "
+        f"within {deadline_s}s (last: {last!r})")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", default="",
+                    choices=["", "kube", "leader", "follower"])
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--data-dir", default="")
+    ap.add_argument("--leader", default="")
+    ap.add_argument("--kube", default="")
+    args = ap.parse_args()
+    if args.role:
+        serve(args.role, args.port, args.data_dir, args.leader, args.kube)
+        return 0
+
+    tmp = tempfile.mkdtemp(prefix="repl-smoke-")
+    kp, lp, fp = free_port(), free_port(), free_port()
+    kube_url = f"http://127.0.0.1:{kp}"
+    leader_url = f"http://127.0.0.1:{lp}"
+    follower_url = f"http://127.0.0.1:{fp}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        print("== spawn shared kube + leader + follower")
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", "kube",
+             "--port", str(kp)], env=env))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", "leader",
+             "--port", str(lp), "--data-dir", os.path.join(tmp, "leader"),
+             "--kube", kube_url], env=env))
+        wait_ready(leader_url, 30.0)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", "follower",
+             "--port", str(fp), "--leader", leader_url, "--kube", kube_url],
+            env=env))
+        wait_ready(follower_url, 30.0)  # 503 until checkpoint adoption
+
+        print("== write through the leader (dual-write create)")
+        status, headers, body = http(
+            "POST", leader_url + "/api/v1/namespaces/team-a/pods", "alice",
+            body={"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "smoke-pod", "namespace": "team-a"}})
+        assert status in (200, 201), (status, body)
+        rev = int(headers.get("X-Authz-Revision", "0"))
+        assert rev > 0, "leader response must carry its revision"
+
+        print(f"== follower serves the write within {LAG_BOUND_S}s "
+              f"(revision {rev})")
+        t0 = time.time()
+        while True:
+            status, headers, body = http(
+                "GET", follower_url + "/api/v1/namespaces/team-a/pods",
+                "alice")
+            names = [i["metadata"]["name"]
+                     for i in json.loads(body).get("items", [])]
+            if status == 200 and "smoke-pod" in names:
+                assert headers.get("X-Authz-Forwarded-To") != "leader", \
+                    "must be replicated, not forwarded"
+                assert int(headers.get("X-Authz-Revision", "0")) >= rev
+                break
+            if time.time() - t0 > LAG_BOUND_S:
+                raise AssertionError(
+                    f"follower did not serve the write within "
+                    f"{LAG_BOUND_S}s (status {status}, items {names})")
+            time.sleep(0.1)
+        lag_s = time.time() - t0
+        print(f"   replicated in {lag_s:.2f}s")
+
+        print("== kill -9 the leader")
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait(10)
+
+        print("== follower keeps serving bounded-staleness reads")
+        status, headers, body = http(
+            "GET", follower_url + "/api/v1/namespaces/team-a/pods", "alice")
+        assert status == 200, (status, body)
+        assert "smoke-pod" in [i["metadata"]["name"]
+                               for i in json.loads(body)["items"]]
+
+        print("== follower /readyz reports degraded (still 200)")
+        ready = wait_ready(follower_url, 45.0, want_degraded=True)
+        print("   " + ready.decode().replace("\n", " | "))
+
+        print("== follower rejects writes 503 with the leader down")
+        status, _, body = http(
+            "POST", follower_url + "/api/v1/namespaces/team-a/pods", "alice",
+            body={"metadata": {"name": "p2", "namespace": "team-a"}})
+        assert status == 503, (status, body)
+
+        print("replication_smoke: ALL GREEN")
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(5)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

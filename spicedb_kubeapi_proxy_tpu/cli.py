@@ -131,6 +131,52 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Retry-After seconds suggested on shed "
                         "responses")
 
+    # WAL-shipping replication (spicedb/replication, docs/replication.md;
+    # killswitch: --feature-gates Replication=false)
+    p.add_argument("--replicate-from", default="",
+                   help="run as a read replica of the proxy at this base "
+                        "URL (e.g. http://leader:8443): bootstrap from "
+                        "its newest checkpoint, tail its WAL segments, "
+                        "serve read-only traffic at bounded staleness, "
+                        "and forward update verbs to it.  Exclusive "
+                        "with --data-dir (the leader owns the log).  "
+                        "The leader serves the replication API whenever "
+                        "it has a --data-dir")
+    p.add_argument("--replica-wait-ms", type=float, default=2000.0,
+                   help="how long a replica read carrying "
+                        "X-Authz-Min-Revision waits for the tail to "
+                        "reach that revision before forwarding to the "
+                        "leader (or 503 when forwarding is disabled)")
+    p.add_argument("--replica-forward", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="forward update verbs and too-stale ZedToken "
+                        "reads to the leader; --no-replica-forward "
+                        "rejects them 503 with a Status naming the "
+                        "leader instead")
+    p.add_argument("--replica-user", default="system:replica",
+                   help="identity this follower presents to the leader "
+                        "(header authentication; the leader must trust "
+                        "the follower's transport path)")
+    p.add_argument("--shed-replica-lag", type=float, default=0.0,
+                   help="shed read-only requests with 429 + Retry-After "
+                        "once this replica is at least this many "
+                        "seconds behind its leader (0 = disabled); a "
+                        "stale replica sheds before serving garbage")
+
+    # static schema/rule lint (spicedb/schema_lint.py, Cedar-inspired):
+    # analyze instead of serve
+    p.add_argument("--lint-schema", action="store_true",
+                   help="lint the bootstrap schema (--spicedb-bootstrap; "
+                        "the built-in default schema when omitted) and "
+                        "the proxy rules (--rule-config) instead of "
+                        "serving: flags unreachable relations, "
+                        "permissions with empty footprints, and rule "
+                        "templates referencing undefined relations.  "
+                        "Exit 1 on errors; --lint-schema-strict also "
+                        "fails on warnings")
+    p.add_argument("--lint-schema-strict", action="store_true",
+                   help="with --lint-schema, exit 1 on warnings too")
+
     # upstream cluster (options.go:203-206)
     p.add_argument("--backend-kubeconfig", default="",
                    help="path to the kubeconfig for the upstream apiserver; "
@@ -295,6 +341,10 @@ class OptionsError(ValueError):
 def validate(args: argparse.Namespace) -> list:
     """Mirror of Options.Validate (reference options.go:412-427)."""
     errs = []
+    if args.lint_schema:
+        # analysis mode: no upstream, no serving — only the schema/rule
+        # inputs matter
+        return []
     if not args.backend_kubeconfig and not args.use_in_cluster_config:
         errs.append("either --backend-kubeconfig or --use-in-cluster-config"
                     " must be specified")
@@ -348,6 +398,23 @@ def validate(args: argparse.Namespace) -> list:
                                    or args.slo_error_rate > 0):
         errs.append("--shed-slo-burn needs an SLO configured "
                     "(--slo-check-p99-ms or --slo-error-rate)")
+    if args.replicate_from:
+        if not args.spicedb_endpoint.startswith(("embedded", "jax")):
+            errs.append("--replicate-from requires a store-backed "
+                        "endpoint (embedded:// or jax://)")
+        if args.data_dir:
+            errs.append("--replicate-from is exclusive with --data-dir: "
+                        "a follower re-bootstraps from its leader and "
+                        "must not journal the leader's log as its own")
+        if not args.replicate_from.startswith(("http://", "https://")):
+            errs.append("--replicate-from must be an http(s) base URL")
+    if args.replica_wait_ms < 0:
+        errs.append("--replica-wait-ms must be >= 0")
+    if args.shed_replica_lag < 0:
+        errs.append("--shed-replica-lag must be >= 0 (0 = disabled)")
+    if args.shed_replica_lag > 0 and not args.replicate_from:
+        errs.append("--shed-replica-lag only applies to a replica "
+                    "(--replicate-from)")
     return errs
 
 
@@ -520,6 +587,11 @@ def complete(args: argparse.Namespace,
         shed_queue_depth=args.shed_queue_depth,
         shed_slo_burn=args.shed_slo_burn,
         shed_retry_after_s=args.shed_retry_after,
+        replicate_from=args.replicate_from,
+        replica_wait_ms=args.replica_wait_ms,
+        replica_forward=args.replica_forward,
+        replica_user=args.replica_user,
+        shed_replica_lag_s=args.shed_replica_lag,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,
@@ -583,6 +655,42 @@ def _sync_jax_platforms() -> None:
         pass
 
 
+def run_schema_lint(args: argparse.Namespace) -> int:
+    """`--lint-schema`: static schema/rule analysis (Cedar-inspired;
+    spicedb/schema_lint.py) instead of serving.  Exit 0 = clean (or
+    warnings only, unless --lint-schema-strict), 1 = findings, 2 = the
+    inputs would not even boot."""
+    from .spicedb import schema_lint
+    from .spicedb import schema as sch
+    from .spicedb.endpoints import (
+        Bootstrap,
+        DEFAULT_BOOTSTRAP_SCHEMA,
+        merge_internal_definitions,
+    )
+
+    try:
+        schema_text = DEFAULT_BOOTSTRAP_SCHEMA
+        if args.spicedb_bootstrap:
+            bootstrap = Bootstrap.from_file(args.spicedb_bootstrap)
+            if bootstrap.schema_text:
+                schema_text = bootstrap.schema_text
+        schema = merge_internal_definitions(sch.parse_schema(schema_text))
+        rule_configs = (proxyrule.parse_file(args.rule_config)
+                        if args.rule_config else [])
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    findings = schema_lint.lint_schema(schema, rule_configs)
+    for f in findings:
+        print(f"{f.severity.upper()} {f.code} [{f.where}] {f.message}")
+    errors = [f for f in findings if f.severity == "error"]
+    warnings = [f for f in findings if f.severity != "error"]
+    print(f"schema lint: {len(errors)} errors, {len(warnings)} warnings")
+    if errors or (warnings and args.lint_schema_strict):
+        return 1
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     _sync_jax_platforms()
     parser = build_parser()
@@ -593,6 +701,8 @@ def main(argv: Optional[list] = None) -> int:
         for e in errs:
             print(f"error: {e}", file=sys.stderr)
         return 2
+    if args.lint_schema:
+        return run_schema_lint(args)
     try:
         completed = complete(args)
     except OptionsError as e:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import dataclasses
 import json
 import logging
 import ssl
@@ -73,8 +74,11 @@ _UNTRACED_PATHS = frozenset(
 
 def _untraced(path: str) -> bool:
     """Every debug surface — including trailing-slash and unknown ones,
-    which still serve index/404 from _serve_debug — stays untraced."""
-    return path in _UNTRACED_PATHS or path.startswith("/debug/")
+    which still serve index/404 from _serve_debug — stays untraced; so
+    does the replication API (a follower's long-poll parks for tens of
+    seconds by design and would evict every real slow trace)."""
+    return (path in _UNTRACED_PATHS or path.startswith("/debug/")
+            or path == "/replication" or path.startswith("/replication/"))
 
 
 def too_many_requests_response(retry_after_s: float, message: str) -> Response:
@@ -192,6 +196,28 @@ class Options:
     shed_queue_depth: int = 0
     shed_slo_burn: bool = False
     shed_retry_after_s: float = 1.0
+    # WAL-shipping replication (spicedb/replication, docs/replication.md;
+    # killswitch: --feature-gates Replication=false).  Leader side: with
+    # a data dir, the authed /replication/* API serves the live WAL
+    # segments + checkpoints.  Follower side: replicate_from names the
+    # leader's base URL — the server bootstraps its (in-memory) store
+    # from the leader's newest checkpoint, tails WAL segments, serves
+    # read-only traffic at bounded staleness, and forwards update verbs
+    # to the leader (or rejects them 503 when forwarding is off).
+    replicate_from: str = ""
+    # how long a read carrying X-Authz-Min-Revision waits for the tail
+    # to catch up before it is forwarded to the leader / rejected
+    replica_wait_ms: float = 2000.0
+    replica_forward: bool = True
+    # identity the follower presents to the leader (header authn; front
+    # the leader with a trusted path — see docs/replication.md)
+    replica_user: str = "system:replica"
+    # shed read-only traffic once the follower is this many seconds
+    # stale (0 = disabled); feeds the PR 8 LoadShedder
+    shed_replica_lag_s: float = 0.0
+    # transport seam to the leader (tests inject an in-process
+    # HandlerTransport); None = H11Transport(replicate_from)
+    leader_transport: Optional[Transport] = None
 
 
 class ProxyServer:
@@ -206,6 +232,7 @@ class ProxyServer:
         # bootstrap-once then skips re-applying it onto recovered state
         self.persistence = None
         endpoint_kwargs = dict(opts.endpoint_kwargs)
+        from ..spicedb import replication as repl
         if opts.data_dir:
             from ..utils.features import GATES
             if GATES.enabled("DurableStore"):
@@ -219,6 +246,52 @@ class ProxyServer:
             else:
                 logger.info("--data-dir %r set but the DurableStore gate is "
                             "disabled; running in-memory", opts.data_dir)
+        # WAL-shipping replication (spicedb/replication).  Follower mode:
+        # an in-memory store the ReplicaFollower bootstraps from the
+        # leader's newest checkpoint and tails; built BEFORE the endpoint
+        # so the device graph / decision cache ride the store's listener
+        # hooks exactly as they do on a leader.  Leader mode: the hub is
+        # attached below once the endpoint exists.  The Replication gate
+        # is the killswitch — off, neither object is constructed and the
+        # proxy is exactly single-node.
+        self.replication = None        # ReplicaFollower (follower mode)
+        self.replication_hub = None    # ReplicationHub (leader mode)
+        self._leader_transport: Optional[Transport] = None
+        if self.persistence is not None and repl.enabled():
+            # leader: publish the data dir; attach AFTER the persistence
+            # manager so the WAL append precedes every long-poll wakeup
+            self.replication_hub = repl.ReplicationHub(
+                self.persistence._store, self.persistence)
+            self.replication_hub.attach()
+        if opts.replicate_from and repl.enabled():
+            if self.persistence is not None:
+                raise ValueError(
+                    "--replicate-from is exclusive with --data-dir: a "
+                    "follower re-bootstraps from its leader and must not "
+                    "journal the leader's log as its own")
+            from ..spicedb.store import TupleStore
+            store = TupleStore()
+            endpoint_kwargs["store"] = store
+            # a follower takes the bootstrap SCHEMA only: relationships
+            # are the leader's state and arrive via replication — a
+            # locally-applied bootstrap would advance the revision
+            # counter past 0 and the follower could never anchor the
+            # leader's log to it
+            if opts.bootstrap is not None:
+                opts = dataclasses.replace(
+                    opts, bootstrap=Bootstrap(
+                        schema_text=opts.bootstrap.schema_text))
+                self.opts = opts
+            from .httpcore import H11Transport
+            self._leader_transport = (opts.leader_transport
+                                      or H11Transport(opts.replicate_from))
+            self.replication = repl.ReplicaFollower(
+                store, self._leader_transport,
+                identity=opts.replica_user)
+        elif opts.replicate_from:
+            logger.info("--replicate-from %r set but the Replication gate "
+                        "is disabled; running single-node",
+                        opts.replicate_from)
         self.endpoint: PermissionsEndpoint = create_endpoint(
             opts.spicedb_endpoint, bootstrap=opts.bootstrap,
             **endpoint_kwargs)
@@ -306,7 +379,13 @@ class ProxyServer:
             stats_fn=lambda: dict(getattr(self.endpoint, "stats", None)
                                   or {}),
             burning_fn=(lambda: self.flight.burning()
-                        if self.flight is not None else []))
+                        if self.flight is not None else []),
+            # a stale replica sheds reads before serving garbage
+            # (docs/replication.md "Staleness contract")
+            shed_lag_s=(opts.shed_replica_lag_s
+                        if self.replication is not None else 0.0),
+            lag_fn=(self.replication.lag_seconds
+                    if self.replication is not None else None))
         # off-loop rebuilds prewarm their candidate generations when
         # compile prewarm is on, so a post-swap first request recompiles
         # nothing (ops/jax_endpoint.py _prewarm_graph)
@@ -337,12 +416,22 @@ class ProxyServer:
             slos.append(devtel.Slo(
                 "error_rate", "error",
                 objective=self.opts.slo_error_rate))
+        def stats_fn() -> dict:
+            # follower lag rides every flight window, so the PR 5 SLO
+            # burn-rate machinery and window history see staleness next
+            # to latency (docs/replication.md "Observability")
+            out = dict(getattr(self.endpoint, "stats", None) or {})
+            if self.replication is not None:
+                out["replica_lag_revisions"] = self.replication.lag_revisions()
+                out["replica_lag_seconds"] = round(
+                    self.replication.lag_seconds(), 3)
+            return out
+
         return devtel.FlightRecorder(
             window_s=self.opts.flight_window_s,
             capacity=self.opts.flight_windows,
             slos=slos,
-            stats_fn=lambda: dict(getattr(self.endpoint, "stats", None)
-                                  or {}))
+            stats_fn=stats_fn)
 
     # -- dual-write wiring ---------------------------------------------------
 
@@ -374,6 +463,10 @@ class ProxyServer:
                          "(load in Perfetto): pack/transpose/transfer/"
                          "kernel/extract/rebuild slices + overlap/"
                          "roofline/stall summary", self._debug_timeline),
+            "replication": ("replication state: leader (served segments, "
+                            "long-poll waiters) or follower (applied "
+                            "revision, lag, cursor, bootstraps); "
+                            "docs/replication.md", self._debug_replication),
         }
         return surfaces
 
@@ -440,6 +533,140 @@ class ProxyServer:
                 "burning": self.flight.burning(),
                 "windows": self.flight.snapshots()}
 
+    def _debug_replication(self) -> dict:
+        if self.replication_hub is not None:
+            return self.replication_hub.snapshot()
+        if self.replication is not None:
+            return self.replication.snapshot()
+        from ..spicedb import replication as repl
+        return {"enabled": False,
+                "reason": ("Replication feature gate disabled"
+                           if not repl.enabled() else
+                           "not configured (leader needs --data-dir, "
+                           "follower needs --replicate-from)")}
+
+    # -- replication serving (spicedb/replication) ---------------------------
+
+    async def _serve_replication(self, req: Request) -> Response:
+        """Leader-side replication API (authenticated, like /metrics)."""
+        if self.replication_hub is None:
+            return json_response(503, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 503,
+                "reason": "ServiceUnavailable",
+                "message": "replication is not served here: this proxy "
+                           "has no durable data dir (--data-dir) or is "
+                           "itself a follower"})
+        hub = self.replication_hub
+        path = req.path
+        if path == "/replication/manifest":
+            return await hub.serve_manifest(req)
+        if path.startswith("/replication/segment/"):
+            return hub.serve_segment(req, path.rsplit("/", 1)[1])
+        if path.startswith("/replication/checkpoint/"):
+            return hub.serve_checkpoint(req, path.rsplit("/", 1)[1])
+        return json_response(404, {
+            "kind": "Status", "apiVersion": "v1", "metadata": {},
+            "status": "Failure", "reason": "NotFound", "code": 404,
+            "message": f"unknown replication endpoint {path!r}; use "
+                       f"/replication/manifest, /replication/segment/"
+                       f"<name>, /replication/checkpoint/<name>"})
+
+    def _leader_unavailable(self, message: str) -> Response:
+        return json_response(503, {
+            "kind": "Status", "apiVersion": "v1", "metadata": {},
+            "status": "Failure", "reason": "ServiceUnavailable",
+            "code": 503, "message": message,
+            "details": {"leader": self.opts.replicate_from,
+                        "leaderId": getattr(self.replication, "leader_id",
+                                            "")}})
+
+    async def _forward_to_leader(self, req: Request,
+                                 why: str) -> Response:
+        """Relay a request to the leader verbatim, re-asserting the
+        follower-authenticated identity as X-Remote-* headers (the
+        leader must trust this follower's transport path — see
+        docs/replication.md "Deployment & trust")."""
+        if not self.opts.replica_forward or self._leader_transport is None:
+            return self._leader_unavailable(
+                f"{why}; write/fresh-read forwarding is disabled — "
+                f"retry against the leader")
+        up = Headers()
+        for k, v in req.headers.items():
+            lk = k.lower()
+            if lk in ("authorization", "connection", "content-length",
+                      "host") or lk.startswith("x-remote-"):
+                continue
+            up.add(k, v)
+        user = req.context.get("user")
+        if user is not None:
+            up.set(REMOTE_USER_HEADER, user.name)
+            for g in user.groups:
+                up.add(REMOTE_GROUP_HEADER, g)
+            for key, values in (getattr(user, "extra", None) or {}).items():
+                for v in values:
+                    up.add(REMOTE_EXTRA_PREFIX + key, v)
+        try:
+            resp = await self._leader_transport.round_trip(Request(
+                method=req.method, target=req.target, headers=up,
+                body=req.body))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            return self._leader_unavailable(
+                f"{why}; forwarding to the leader failed: {e}")
+        if self.replication is not None:
+            self.replication.stats["forwarded"] = (
+                self.replication.stats.get("forwarded", 0) + 1)
+        resp.headers.set("X-Authz-Forwarded-To", "leader")
+        return resp
+
+    async def _replica_gate(self, req: Request,
+                            verb: str) -> Optional[Response]:
+        """Follower-mode admission: anything that can mutate goes to
+        the leader (the gate is allowlist-by-read-verb, so
+        `deletecollection` and any future mutating verb forward too);
+        reads whose ZedToken (X-Authz-Min-Revision) is ahead of the
+        applied revision wait up to --replica-wait-ms, then forward.
+        None = serve locally."""
+        from ..spicedb import replication as repl
+        from ..utils.admission import READ_ONLY_VERBS
+        if verb not in READ_ONLY_VERBS:
+            return await self._forward_to_leader(
+                req, "this proxy is a read replica; update verbs are "
+                     "served by the leader")
+        raw = req.headers.get(repl.MIN_REVISION_HEADER)
+        if raw:
+            try:
+                min_rev = int(raw)
+            except ValueError:
+                return json_response(400, {
+                    "kind": "Status", "apiVersion": "v1", "metadata": {},
+                    "status": "Failure", "code": 400,
+                    "message": f"invalid {repl.MIN_REVISION_HEADER} "
+                               f"header {raw!r}: want an integer "
+                               f"revision"})
+            if not await self.replication.wait_for_revision(
+                    min_rev, self.opts.replica_wait_ms / 1e3):
+                return await self._forward_to_leader(
+                    req, f"replica at revision "
+                         f"{self.replication.store.revision} has not "
+                         f"reached requested min-revision {min_rev} "
+                         f"within {self.opts.replica_wait_ms:.0f}ms")
+        return None
+
+    def _stamp_revision(self, resp: Response) -> None:
+        """Every authenticated response from a replicating proxy carries
+        the revision it served at — the ZedToken a client threads back
+        as X-Authz-Min-Revision to read-your-writes on any replica."""
+        from ..spicedb import replication as repl
+        if self.replication_hub is not None:
+            resp.headers.set(repl.REVISION_HEADER,
+                             str(self.replication_hub.store.revision))
+        elif self.replication is not None:
+            resp.headers.set(repl.REVISION_HEADER,
+                             str(self.replication.store.revision))
+
     # -- chain ---------------------------------------------------------------
 
     def _build_chain(self) -> Handler:
@@ -476,11 +703,17 @@ class ProxyServer:
             # auth and error handling stay uniform across every surface)
             if req.path == "/debug" or req.path.startswith("/debug/"):
                 return self._serve_debug(req)
+            # leader-side replication API (spicedb/replication): same
+            # trust level as /metrics — any authenticated principal
+            if (req.path == "/replication"
+                    or req.path.startswith("/replication/")):
+                return await self._serve_replication(req)
             # admission control: shed read-only traffic at the door when
-            # the proxy is already saturated (queue depth / SLO burn),
-            # and convert dispatcher queue-bound rejections raised
-            # anywhere in the authorization pipeline into 429s.  Update
-            # verbs are never shed (utils/admission.py).
+            # the proxy is already saturated (queue depth / SLO burn /
+            # replica staleness), and convert dispatcher queue-bound
+            # rejections raised anywhere in the authorization pipeline
+            # into 429s.  Update verbs are never shed
+            # (utils/admission.py).
             info = req.context.get("request_info")
             verb = info.verb if info is not None else req.method.lower()
             reason = self.shedder.check(verb)
@@ -490,18 +723,62 @@ class ProxyServer:
                     self.shedder.retry_after_s,
                     f"request shed by admission control ({reason}); "
                     f"retry after {self.shedder.retry_after_s:.0f}s")
+            # follower mode: update verbs forward to the leader, a read
+            # whose ZedToken is ahead of the tail waits or forwards —
+            # never a stale answer below its min-revision
+            if self.replication is not None:
+                gated = await self._replica_gate(req, verb)
+                if gated is not None:
+                    return gated
             from ..utils.admission import AdmissionRejectedError
             try:
-                return await authorized(req)
+                resp = await authorized(req)
             except AdmissionRejectedError as e:
                 req.context["authz_outcome"] = OUTCOME_SHED
                 return too_many_requests_response(e.retry_after_s, str(e))
+            # the revision this answer reflects — the ZedToken a client
+            # threads back to read-your-writes on any replica
+            self._stamp_revision(resp)
+            return resp
 
         async def with_request_info(req: Request) -> Response:
             if req.path in ("/readyz", "/livez", "/healthz"):
                 body = b"ok"
                 if req.path == "/readyz":
+                    if (self.replication is not None
+                            and not self.replication.ever_bootstrapped):
+                        # not-ready before the FIRST adoption only: a
+                        # follower with no adopted state would answer
+                        # every read "nothing exists".  A re-bootstrap
+                        # later keeps serving the already-adopted state
+                        # and reports degraded below — hard-failing it
+                        # would eject every replica at once.
+                        return Response(
+                            status=503,
+                            body=b"[-] replication: bootstrapping from "
+                                 b"leader (no checkpoint adopted yet)")
                     lines = ["ok"]
+                    if self.replication is not None:
+                        # degraded-but-200 while catching up or cut off
+                        # from the leader: bounded-staleness reads are
+                        # still correct answers — ejecting the pod would
+                        # turn staleness into an outage
+                        from ..spicedb.replication import follower as f
+                        if self.replication.state == f.STATE_DEGRADED:
+                            lines.append(
+                                "[!] replication degraded: leader "
+                                "unreachable, serving reads at revision "
+                                f"{self.replication.store.revision}")
+                        elif not self.replication.bootstrapped:
+                            lines.append(
+                                "[!] replication re-bootstrapping: "
+                                "serving reads at revision "
+                                f"{self.replication.store.revision}")
+                        elif self.replication.lag_revisions() > 0:
+                            lines.append(
+                                "[!] replication catching up: "
+                                f"{int(self.replication.lag_revisions())}"
+                                " revisions behind the leader")
                     if self.flight is not None:
                         # burning SLOs surface in readiness output (the
                         # status stays 200: budget burn is an alert, not
@@ -675,6 +952,11 @@ class ProxyServer:
         bound = await self._http.start(host, port)
         if self.persistence is not None:
             await self.persistence.start()
+        if self.replication is not None:
+            # follower tail task: bootstrap happens inside the loop so
+            # serving starts immediately (/readyz stays 503 until the
+            # first checkpoint adoption)
+            self.replication.start()
         if self._worker is not None:
             # the worker's first drain replays dual-write instances left
             # pending by a crash — AFTER the store above was recovered,
@@ -708,6 +990,10 @@ class ProxyServer:
             await self._lag_probe.stop()
         if self.flight is not None:
             await self.flight.stop()
+        if self.replication is not None:
+            await self.replication.stop()
+        if self.replication_hub is not None:
+            self.replication_hub.detach()
         if self.persistence is not None:
             # final checkpoint: a clean shutdown restarts from the
             # checkpoint alone, with an empty WAL tail

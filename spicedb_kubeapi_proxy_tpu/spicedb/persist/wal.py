@@ -82,6 +82,50 @@ def segment_name(seq: int) -> str:
     return f"seg-{seq:08d}.wal"
 
 
+class TornFrameError(Exception):
+    """A frame in the middle of a byte stream failed its CRC/length check
+    (parse_frames): the stream is damaged beyond a torn tail."""
+
+
+def parse_frames(data: bytes, offset: int = 0) -> tuple:
+    """Parse complete CRC frames out of `data[offset:]` -> (records,
+    consumed) where `consumed` is the offset just past the last WHOLE
+    valid frame.  A truncated FINAL frame (torn tail / still-being-
+    written segment) stops the parse cleanly; a bad frame followed by
+    more bytes raises TornFrameError.  This is the ONE frame decoder:
+    segment replay (SegmentedWal._replay_segment below) and replication
+    followers parsing segment bytes fetched over HTTP
+    (spicedb/replication/follower.py) both call it, so leader recovery
+    and follower tailing can never disagree on framing."""
+    records = []
+    off = offset
+    n = len(data)
+    while off < n:
+        if off + _FRAME.size > n:
+            break  # torn header: wait for more bytes
+        length, crc = _FRAME.unpack_from(data, off)
+        start, end = off + _FRAME.size, off + _FRAME.size + length
+        if end > n:
+            break  # torn payload
+        bad = None
+        if zlib.crc32(data[start:end]) != crc:
+            bad = "crc mismatch"
+        else:
+            try:
+                rec = json.loads(data[start:end])
+            except ValueError:
+                rec = None
+            if not isinstance(rec, dict) or "k" not in rec or "r" not in rec:
+                bad = "undecodable record"
+        if bad is not None:
+            if end == n:
+                break  # torn tail shape: retry once more bytes arrive
+            raise TornFrameError(f"frame at offset {off}: {bad}")
+        records.append(rec)
+        off = end
+    return records, off
+
+
 class SegmentedWal:
     """Append/replay over the `wal/` directory of a data dir.
 
@@ -290,40 +334,23 @@ class SegmentedWal:
                 _fsync_dir(self.dir)
                 return
             raise WalCorruptionError(f"{path}: bad segment header")
-        off = len(SEGMENT_MAGIC)
-        n = len(data)
-        while off < n:
-            bad = None
-            at_eof = True  # the bad frame reaches EOF (torn-append shape)
-            if off + _FRAME.size > n:
-                bad = "truncated frame header"
-            else:
-                length, crc = _FRAME.unpack_from(data, off)
-                start, end = off + _FRAME.size, off + _FRAME.size + length
-                if end > n:
-                    bad = "truncated payload"
-                else:
-                    at_eof = end == n
-                    if zlib.crc32(data[start:end]) != crc:
-                        bad = "crc mismatch"
-                    else:
-                        try:
-                            rec = json.loads(data[start:end])
-                        except ValueError:
-                            rec = None
-                        if (not isinstance(rec, dict) or "k" not in rec
-                                or "r" not in rec):
-                            bad = "undecodable record"
-            if bad is not None:
-                # a torn append can only be the LAST frame of the LAST
-                # segment; a bad frame followed by more data (or in a
-                # sealed segment) means committed revisions are damaged
-                if final and at_eof:
-                    self._truncate(path, off, bad)
-                    return
-                raise WalCorruptionError(f"{path}@{off}: {bad}")
-            yield rec
-            off = end
+        # the one shared frame decoder (parse_frames): a bad frame
+        # reaching EOF stops the parse (torn-append shape), a bad frame
+        # followed by more bytes raises — replay layers the repair
+        # policy on top: a torn tail is repairable only at the end of
+        # the LAST segment; anywhere else committed revisions are
+        # damaged
+        try:
+            records, consumed = parse_frames(data, len(SEGMENT_MAGIC))
+        except TornFrameError as e:
+            raise WalCorruptionError(f"{path}: {e}") from e
+        yield from records
+        if consumed < len(data):
+            if final:
+                self._truncate(path, consumed, "torn or damaged final frame")
+                return
+            raise WalCorruptionError(
+                f"{path}@{consumed}: torn frame in a sealed segment")
 
     def _truncate(self, path: str, offset: int, why: str) -> None:
         logger.warning("wal: torn final record in %s at offset %d (%s); "

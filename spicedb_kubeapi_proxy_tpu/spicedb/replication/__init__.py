@@ -1,0 +1,54 @@
+"""WAL-shipping read replication (ROADMAP item 3, docs/replication.md).
+
+The durable store's segmented CRC-framed WAL + columnar checkpoints
+(spicedb/persist) are already a replication log: WAL-before-visibility
+ordering guarantees any shipped record is replayable, and the revision
+counter is the ZedToken.  This package ships that log over HTTP:
+
+- **Leader** (`leader.py` ReplicationHub): serves the live data dir —
+  `/replication/manifest` (revision + artifact listing, with a long-poll
+  "wait for revision > R" mode fed by the store's commit-listener hook),
+  `/replication/segment/<name>` and `/replication/checkpoint/<name>`
+  (raw bytes with offset/range semantics, safe-name validated).
+
+- **Follower** (`follower.py` ReplicaFollower): bootstraps from the
+  newest checkpoint, tails segments, applies records through the
+  exact-replay `TupleStore.apply_replica_batch` path into the live
+  store — driving the normal delta pipeline (device-graph deltas,
+  decision-cache epoch bumps, watch events) — and re-bootstraps from
+  the checkpoint instead of diverging when the tail is torn or
+  reclaimed.
+
+Consistency contract: a follower serves any read whose min-revision
+(ZedToken, `X-Authz-Min-Revision`) it has already applied; fresher
+reads wait up to `--replica-wait-ms` and then forward to the leader
+(or 503 naming it).  Update verbs always go to the leader.  The
+`Replication` feature gate is the killswitch: off, routes and follower
+mode are inert and the proxy is exactly single-node.
+"""
+
+from .follower import ReplicaFollower
+from .leader import ReplicationHub, safe_artifact_name
+
+MIN_REVISION_HEADER = "X-Authz-Min-Revision"
+REVISION_HEADER = "X-Authz-Revision"
+
+
+def enabled() -> bool:
+    """Replication gate accessor; unknown-gate errors fail CLOSED — a
+    stripped gate registry must not accidentally serve the data dir."""
+    try:
+        from ...utils.features import GATES
+        return GATES.enabled("Replication")
+    except Exception:
+        return False
+
+
+__all__ = [
+    "MIN_REVISION_HEADER",
+    "REVISION_HEADER",
+    "ReplicaFollower",
+    "ReplicationHub",
+    "enabled",
+    "safe_artifact_name",
+]

@@ -1,0 +1,280 @@
+"""Leader side of WAL-shipping replication: the ReplicationHub.
+
+Serves the persistence data dir over the proxy's authenticated HTTP
+surface (routes wired in proxy/server.py):
+
+    GET /replication/manifest
+        {"revision": N, "checkpoint": {...MANIFEST.json...} | null,
+         "segments": [{"name", "seq", "size", "sealed"}...],
+         "sidecars": ["snap-*.npz"...], "leader_id": "..."}
+        ?wait_revision=R&timeout_ms=T long-polls until the store's
+        revision EXCEEDS R (or the timeout lapses — the caller gets the
+        current manifest either way and decides from `revision`).
+
+    GET /replication/segment/<name>[?offset=N]
+        Raw bytes of a WAL segment or bulk-load snapshot sidecar from
+        byte N (also honors `Range: bytes=N-`).  206 on a partial
+        serve, 404 when reclaimed — the follower's signal to
+        re-bootstrap from the newest checkpoint.
+
+    GET /replication/checkpoint/<name>
+        Raw bytes of a columnar checkpoint file.
+
+Names are validated against the exact artifact patterns before touching
+the filesystem (no traversal).  The long-poll is fed by the store's
+commit-listener hook: the hub attaches AFTER the PersistenceManager, so
+by WAL-before-visibility ordering every revision a waiter is woken for
+is already on disk and replayable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import threading
+import time
+import uuid
+from typing import Optional
+from urllib.parse import parse_qs, urlsplit
+
+from ...utils import metrics as m
+from ..store import TupleStore
+
+_SAFE_NAME = re.compile(
+    r"^(seg-\d{8}\.wal|snap-\d{12}\.npz|ckpt-\d{12}\.npz)$")
+
+DEFAULT_LONGPOLL_S = 25.0
+MAX_LONGPOLL_S = 60.0
+
+
+def safe_artifact_name(name: str) -> bool:
+    """True when `name` is exactly one WAL segment / sidecar / checkpoint
+    file name — the only paths the hub will ever read."""
+    return bool(_SAFE_NAME.match(name))
+
+
+class ReplicationHub:
+    """Publishes one PersistenceManager's data dir to followers."""
+
+    def __init__(self, store: TupleStore, persistence,
+                 leader_id: str = "",
+                 registry: Optional[m.Registry] = None):
+        self.store = store
+        self.persistence = persistence
+        # unique per INCARNATION, not per host: segment seqs restart
+        # after a leader restart (reclaim empties the wal dir), so a
+        # follower must detect "same name, different log" by the id
+        # changing and re-bootstrap rather than resume its byte cursor
+        self.leader_id = (leader_id
+                          or f"leader-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        # (loop, future) pairs parked in wait_for_revision; woken from
+        # the commit listener via call_soon_threadsafe (the listener runs
+        # under the store lock — it must only schedule, never block)
+        self._waiters: list = []
+        self._waiters_lock = threading.Lock()
+        self._attached = False
+        self.stats = {"manifest_serves": 0, "longpoll_waits": 0,
+                      "segment_serves": 0, "checkpoint_serves": 0}
+        registry = registry or m.REGISTRY
+        self._shipped = registry.counter(
+            "authz_replication_shipped_bytes_total",
+            "Bytes of WAL segments / sidecars / checkpoints served to "
+            "replication followers, by artifact kind",
+            labels=("kind",))
+
+    # -- commit hook ---------------------------------------------------------
+
+    def attach(self) -> None:
+        """Start waking long-poll waiters on commits.  Call AFTER the
+        PersistenceManager attached: listener order is append order, so
+        the WAL append precedes the wakeup for every commit."""
+        if not self._attached:
+            self.store.add_commit_listener(self._on_commit)
+            self._attached = True
+
+    def detach(self) -> None:
+        if self._attached:
+            self.store.remove_commit_listener(self._on_commit)
+            self._attached = False
+
+    def _on_commit(self, kind: str, revision: int, payload) -> None:
+        # under the store lock — schedule only.  The waiter re-checks the
+        # store revision on its own loop, which cannot run before this
+        # commit completes and the new revision is reader-visible.
+        with self._waiters_lock:
+            waiters, self._waiters = self._waiters, []
+        for loop, fut in waiters:
+            try:
+                loop.call_soon_threadsafe(self._resolve, fut)
+            except RuntimeError:
+                pass  # waiter's loop already closed
+
+    @staticmethod
+    def _resolve(fut) -> None:
+        if not fut.done():
+            fut.set_result(None)
+
+    async def wait_for_revision(self, min_exclusive: int,
+                                timeout_s: float) -> bool:
+        """Park until store.revision > min_exclusive (True) or the
+        timeout lapses (False)."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        loop = asyncio.get_running_loop()
+        while self.store.revision <= min_exclusive:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            fut = loop.create_future()
+            with self._waiters_lock:
+                self._waiters.append((loop, fut))
+            # re-check AFTER publishing the waiter: a commit landing
+            # between the loop-condition read and the append above has
+            # already drained the (then-empty) waiter list — without
+            # this, that waiter sleeps the full timeout on a revision
+            # that is long since visible
+            if self.store.revision > min_exclusive:
+                with self._waiters_lock:
+                    try:
+                        self._waiters.remove((loop, fut))
+                    except ValueError:
+                        pass
+                return True
+            try:
+                await asyncio.wait_for(fut, remaining)
+            except asyncio.TimeoutError:
+                return self.store.revision > min_exclusive
+            finally:
+                with self._waiters_lock:
+                    try:
+                        self._waiters.remove((loop, fut))
+                    except ValueError:
+                        pass
+        return True
+
+    # -- manifest ------------------------------------------------------------
+
+    def manifest(self) -> dict:
+        from ..persist import checkpoint as ckpt
+        wal = self.persistence.wal
+        segments = []
+        for seq in wal.segment_seqs():
+            path = wal._path(seq)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue  # reclaimed between listdir and stat
+            segments.append({
+                "name": os.path.basename(path), "seq": seq, "size": size,
+                # the open segment keeps growing; anything else is sealed
+                "sealed": not (seq == wal._cur_seq
+                               and wal._cur_file is not None),
+            })
+        sidecars = []
+        try:
+            for name in sorted(os.listdir(wal.dir)):
+                if re.match(r"^snap-\d{12}\.npz$", name):
+                    sidecars.append(name)
+        except OSError:
+            pass
+        self.stats["manifest_serves"] += 1
+        return {
+            "leader_id": self.leader_id,
+            "revision": self.store.revision,
+            "checkpoint": ckpt.read_manifest(self.persistence.data_dir),
+            "segments": segments,
+            "sidecars": sidecars,
+        }
+
+    async def serve_manifest(self, req) -> "Response":
+        from ...proxy.httpcore import json_response
+        params = parse_qs(urlsplit(req.target).query)
+        wait_raw = (params.get("wait_revision") or [""])[0]
+        if wait_raw:
+            try:
+                wait_rev = int(wait_raw)
+                timeout_ms = float(
+                    (params.get("timeout_ms")
+                     or [str(DEFAULT_LONGPOLL_S * 1e3)])[0])
+            except ValueError:
+                return json_response(400, {
+                    "kind": "Status", "apiVersion": "v1", "metadata": {},
+                    "status": "Failure", "code": 400,
+                    "message": "wait_revision/timeout_ms must be integers"})
+            self.stats["longpoll_waits"] += 1
+            await self.wait_for_revision(
+                wait_rev, min(max(timeout_ms / 1e3, 0.0), MAX_LONGPOLL_S))
+        return json_response(200, self.manifest())
+
+    # -- artifact bytes ------------------------------------------------------
+
+    def _serve_file(self, req, path: str, kind: str) -> "Response":
+        from ...proxy.httpcore import Response, json_response
+        params = parse_qs(urlsplit(req.target).query)
+        offset = 0
+        raw_off = (params.get("offset") or ["0"])[0]
+        range_hdr = req.headers.get("Range")
+        try:
+            offset = int(raw_off)
+            if range_hdr:
+                mm = re.match(r"^bytes=(\d+)-$", range_hdr.strip())
+                if mm is None:
+                    raise ValueError(f"unsupported Range {range_hdr!r}")
+                offset = int(mm.group(1))
+        except ValueError as e:
+            return json_response(400, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 400, "message": str(e)})
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                if offset:
+                    f.seek(offset)
+                body = f.read()
+        except OSError:
+            return json_response(404, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "reason": "NotFound", "code": 404,
+                "message": f"artifact {os.path.basename(path)!r} is gone "
+                           f"(reclaimed by a checkpoint?); re-bootstrap "
+                           f"from /replication/manifest"})
+        self._shipped.inc(len(body), kind=kind)
+        self.stats[f"{kind}_serves"] += 1
+        resp = Response(status=206 if offset else 200, body=body)
+        resp.headers.set("Content-Type", "application/octet-stream")
+        resp.headers.set("X-Replication-Offset", str(offset))
+        resp.headers.set("X-Replication-Size", str(size))
+        return resp
+
+    def serve_segment(self, req, name: str) -> "Response":
+        from ...proxy.httpcore import json_response
+        if not safe_artifact_name(name) or name.startswith("ckpt-"):
+            return json_response(400, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 400,
+                "message": f"invalid segment name {name!r}"})
+        return self._serve_file(
+            req, os.path.join(self.persistence.wal.dir, name), "segment")
+
+    def serve_checkpoint(self, req, name: str) -> "Response":
+        from ...proxy.httpcore import json_response
+        if not safe_artifact_name(name) or not name.startswith("ckpt-"):
+            return json_response(400, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 400,
+                "message": f"invalid checkpoint name {name!r}"})
+        return self._serve_file(
+            req, os.path.join(self.persistence.ckpt_dir, name), "checkpoint")
+
+    def snapshot(self) -> dict:
+        """/debug/replication payload (leader role)."""
+        with self._waiters_lock:
+            waiters = len(self._waiters)
+        man = self.manifest()
+        return {"role": "leader", "leader_id": self.leader_id,
+                "revision": man["revision"],
+                "checkpoint_revision": (man["checkpoint"] or {}).get(
+                    "revision"),
+                "segments": man["segments"],
+                "longpoll_waiters": waiters,
+                **self.stats}

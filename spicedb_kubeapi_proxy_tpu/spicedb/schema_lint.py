@@ -1,0 +1,176 @@
+"""Static schema/rule lint (Cedar-inspired; ROADMAP item 5 side-quest).
+
+Cedar's design argument (PAPERS.md) is that an authorization language
+should be *analyzable*: most policy bugs are reachable by static
+inspection, before any request is served.  The proxy already has the
+machinery — `ops.graph_compile.relation_footprint` is the transitive
+"which relations can influence this permission" closure the decision
+cache invalidates by — so the lint is cheap:
+
+  SL001 (error)  rule template references an undefined type
+  SL002 (error)  rule template references an undefined relation or
+                 permission on its type (including the subject's
+                 `#subrelation`)
+  SL003 (warn)   permission with an EMPTY footprint: no tuple anywhere
+                 can ever grant it (e.g. `permission x = nil`) — every
+                 check is statically DENY
+  SL004 (warn)   unreachable relation: no permission's footprint
+                 includes it and no rule template reads it directly —
+                 tuples written to it can never influence a decision
+
+Proxy-internal definitions (lock / workflow / activity — the dual-write
+engine's bookkeeping, spicedb/endpoints.py INTERNAL_SCHEMA) are exempt
+from reachability: the engine reads them through its own code paths,
+not through permissions.
+
+Run via the CLI: `python -m spicedb_kubeapi_proxy_tpu --lint-schema
+[--spicedb-bootstrap x.yaml] [--rule-config rules.yaml]
+[--lint-schema-strict]`; wired into scripts/check.sh.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+from . import schema as sch
+from ..ops.graph_compile import relation_footprint
+
+# definitions the dual-write engine owns (endpoints.INTERNAL_SCHEMA):
+# written/read by engine code, not by schema permissions
+INTERNAL_TYPES = frozenset(("lock", "workflow", "activity"))
+
+_TPL_RE = re.compile(
+    r"^(?P<rtype>[A-Za-z0-9_/]+):(?P<rid>.*)"
+    r"#(?P<rel>[A-Za-z0-9_]+)"
+    r"@(?P<stype>[A-Za-z0-9_/]+):(?P<sid>[^#]*)"
+    r"(?:#(?P<srel>[A-Za-z0-9_*]+))?$")
+
+
+@dataclass
+class Finding:
+    code: str
+    severity: str  # "error" | "warn"
+    where: str     # "rule <name>" | "type#relation" | "type#permission"
+    message: str
+
+
+def _iter_rule_templates(rule_configs):
+    """Yield (rule_name, template_string) for every relationship-shaped
+    template a ProxyRule can carry (checks, post-checks, pre/post
+    filters, update ops, preconditions)."""
+    for cfg in rule_configs:
+        spec = cfg.spec
+        groups = [spec.checks, spec.post_checks,
+                  spec.update.creates, spec.update.touches,
+                  spec.update.deletes, spec.update.delete_by_filter,
+                  spec.update.precondition_exists,
+                  spec.update.precondition_does_not_exist]
+        for pf in spec.pre_filters:
+            if pf.lookup_matching_resources is not None:
+                groups.append([pf.lookup_matching_resources])
+        for pf in spec.post_filters:
+            if pf.check_permission_template is not None:
+                groups.append([pf.check_permission_template])
+        for group in groups:
+            for st in group:
+                if getattr(st, "template", ""):
+                    yield cfg.name, st.template
+                rt = getattr(st, "relationship_template", None)
+                if rt is not None:
+                    res, sub = rt.resource, rt.subject
+                    tpl = (f"{res.type}:{res.id or 'x'}#{res.relation}"
+                           f"@{sub.type}:{sub.id or 'x'}"
+                           + (f"#{sub.relation}" if sub.relation else ""))
+                    yield cfg.name, tpl
+
+
+def _parse_template(tpl: str):
+    """-> (rtype, rel, stype, srel) or None when the string is not a
+    single relationship template (tupleSets, exotic expressions)."""
+    mm = _TPL_RE.match(tpl.split("[", 1)[0].strip())
+    if mm is None:
+        return None
+    return (mm.group("rtype"), mm.group("rel"), mm.group("stype"),
+            mm.group("srel") or "")
+
+
+def lint_schema(schema: sch.Schema, rule_configs=()) -> list:
+    """Run every lint pass; returns Findings (errors first)."""
+    findings: list = []
+    referenced: set = set()  # (type, relation) pairs rules read directly
+
+    # -- SL001/SL002: rule templates vs the schema ---------------------------
+    for rule_name, tpl in _iter_rule_templates(rule_configs or ()):
+        parsed = _parse_template(tpl)
+        if parsed is None:
+            continue  # not a single-relationship template; nothing to check
+        rtype, rel, stype, srel = parsed
+        where = f"rule {rule_name}"
+        d = schema.definitions.get(rtype)
+        if d is None:
+            findings.append(Finding(
+                "SL001", "error", where,
+                f"template {tpl!r} references undefined type {rtype!r}"))
+        elif not d.has_relation_or_permission(rel):
+            findings.append(Finding(
+                "SL002", "error", where,
+                f"template {tpl!r} references {rtype}#{rel}, but "
+                f"{rtype!r} defines no relation or permission {rel!r}"))
+        else:
+            referenced.add((rtype, rel))
+            if rel in d.relations:
+                referenced.update(
+                    (ref.type, ref.relation) for ref in d.relations[rel]
+                    if ref.relation)
+        sd = schema.definitions.get(stype)
+        if sd is None:
+            findings.append(Finding(
+                "SL001", "error", where,
+                f"template {tpl!r} references undefined subject type "
+                f"{stype!r}"))
+        elif srel and srel != "*" and not sd.has_relation_or_permission(srel):
+            findings.append(Finding(
+                "SL002", "error", where,
+                f"template {tpl!r} references subject {stype}#{srel}, "
+                f"but {stype!r} defines no relation or permission "
+                f"{srel!r}"))
+        elif srel and srel != "*":
+            referenced.add((stype, srel))
+
+    # -- footprints ----------------------------------------------------------
+    reachable: set = set()  # (type, relation) influencing some permission
+    for tname, d in sorted(schema.definitions.items()):
+        for pname in sorted(d.permissions):
+            fp = relation_footprint(schema, tname, pname)
+            reachable.update(fp)
+            if not fp and tname not in INTERNAL_TYPES:
+                findings.append(Finding(
+                    "SL003", "warn", f"{tname}#{pname}",
+                    f"permission {tname}#{pname} has an empty relation "
+                    f"footprint: no tuple can ever grant it (statically "
+                    f"DENY for every subject)"))
+
+    # a relation is also "used" when another relation's subject
+    # annotation names it (`viewer: group#member` keeps group#member live)
+    for tname, d in schema.definitions.items():
+        for refs in d.relations.values():
+            reachable.update((ref.type, ref.relation) for ref in refs
+                             if ref.relation)
+
+    for tname, d in sorted(schema.definitions.items()):
+        if tname in INTERNAL_TYPES:
+            continue
+        for rname in sorted(d.relations):
+            pair = (tname, rname)
+            if pair in reachable or pair in referenced:
+                continue
+            findings.append(Finding(
+                "SL004", "warn", f"{tname}#{rname}",
+                f"relation {tname}#{rname} is unreachable: no "
+                f"permission's footprint includes it and no proxy rule "
+                f"reads it — tuples written to it can never influence a "
+                f"decision"))
+
+    findings.sort(key=lambda f: (f.severity != "error", f.code, f.where))
+    return findings

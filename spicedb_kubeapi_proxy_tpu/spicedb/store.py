@@ -631,6 +631,54 @@ class TupleStore:
                     self._put(u.rel, rev)
             return rev
 
+    # -- replication (spicedb/replication) ----------------------------------
+
+    def apply_replica_batch(self, updates: Iterable[RelationshipUpdate]) -> int:
+        """Replica-apply one journaled committed batch: the exact-replay
+        semantics of apply_recovery_batch (no limits / preconditions /
+        CREATE validation — the batch already committed on the leader),
+        but applied to a LIVE store: watchers and delta listeners fire,
+        so the device graph, decision-cache epochs, and watch streams
+        follow the leader through the normal delta pipeline.  Commit
+        listeners do NOT fire — a follower must never re-journal the
+        leader's log."""
+        updates = tuple(updates)
+        with self._lock:
+            self._revision += 1
+            rev = self._revision
+            for u in updates:
+                if u.op == UpdateOp.DELETE:
+                    self._remove(u.rel)
+                else:
+                    self._put(u.rel, rev)
+            if updates:
+                self._broadcast(WatchUpdate(updates=updates, revision=rev))
+            return rev
+
+    def replica_reset(self, snap: Optional[ColumnarSnapshot],
+                      overlay: Iterable[Relationship],
+                      revision: int) -> None:
+        """Replica (re-)bootstrap: discard ALL current state and adopt a
+        leader checkpoint wholesale at EXACTLY `revision`.  Unlike
+        adopt_recovery_state this works on a non-empty store (a follower
+        re-bootstraps after losing the segment tail it was tailing) and
+        fires the reset listeners so live consumers rebuild their caches
+        from the adopted state.  The revision may move backwards — after
+        a leader crash that lost an unsynced WAL tail, the checkpoint is
+        the only truthful state left."""
+        if revision < 1:
+            raise ValueError(f"invalid replica reset revision {revision}")
+        with self._lock:
+            self._by_relation.clear()
+            self._base = None
+            if snap is not None and len(snap):
+                self._base = BaseLayer(snap, revision)
+            for rel in overlay:
+                self._put(rel, revision)
+            self._revision = revision
+            for fn in list(self._reset_listeners):
+                fn()
+
     # -- internals ----------------------------------------------------------
 
     def _present(self, rel: Relationship) -> bool:

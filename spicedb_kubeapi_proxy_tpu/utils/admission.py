@@ -26,7 +26,7 @@ module is the shared vocabulary for turning overload into *fast failure*:
    one).
 
 Metrics: `authz_admission_rejected_total{reason=}` counts every
-rejection (reasons: queue_limit, queue_depth, slo_burn) and
+rejection (reasons: queue_limit, queue_depth, slo_burn, replica_lag) and
 `authz_admission_queue_limit` exports the configured dispatcher bound
 (0 = unbounded).  The `AdmissionControl` feature gate is the killswitch:
 off, bounds and shedding are inert and overload queues exactly as
@@ -98,7 +98,8 @@ def is_exempt() -> bool:
 _REJECTED = m.REGISTRY.counter(
     "authz_admission_rejected_total",
     "Requests rejected by admission control, by reason (queue_limit = "
-    "dispatcher queue bound, queue_depth / slo_burn = load shedder)",
+    "dispatcher queue bound, queue_depth / slo_burn / replica_lag = "
+    "load shedder)",
     labels=("reason",))
 _QUEUE_LIMIT = m.REGISTRY.gauge(
     "authz_admission_queue_limit",
@@ -122,11 +123,15 @@ class LoadShedder:
     already saturated, so queue depth stays bounded and in-flight
     requests keep their latency.
 
-    Two independent signals, either sufficient:
+    Three independent signals, any sufficient:
     - `shed_queue_depth` > 0: total dispatcher queue depth (check + LR,
       read through `stats_fn`) at/over the threshold.
     - `shed_on_burn`: the flight recorder reports an SLO burning on both
       horizons (`burning_fn` non-empty) — the PR 5 burn-rate signal.
+    - `shed_lag_s` > 0: the replication follower's staleness (`lag_fn`,
+      seconds behind the leader) at/over the threshold — a stale
+      replica sheds reads before serving garbage
+      (spicedb/replication, docs/replication.md).
 
     `check(verb)` returns the rejection reason (or None to admit);
     callers build the 429 from `retry_after_s`.  `shedding_recently()`
@@ -139,12 +144,16 @@ class LoadShedder:
                  retry_after_s: float = 1.0,
                  stats_fn: Optional[Callable[[], dict]] = None,
                  burning_fn: Optional[Callable[[], list]] = None,
-                 depth_fn: Optional[Callable[[], int]] = None):
+                 depth_fn: Optional[Callable[[], int]] = None,
+                 shed_lag_s: float = 0.0,
+                 lag_fn: Optional[Callable[[], float]] = None):
         self.shed_queue_depth = shed_queue_depth
         self.shed_on_burn = shed_on_burn
+        self.shed_lag_s = shed_lag_s
         self.retry_after_s = max(retry_after_s, 0.001)
         self._stats_fn = stats_fn
         self._burning_fn = burning_fn
+        self._lag_fn = lag_fn
         # depth_fn (an O(1), allocation-free queue-depth accessor) is
         # preferred over stats_fn: the door check runs on EVERY
         # read-only request, before any authorization work — it must
@@ -156,7 +165,8 @@ class LoadShedder:
 
     @property
     def active(self) -> bool:
-        return self.shed_queue_depth > 0 or self.shed_on_burn
+        return (self.shed_queue_depth > 0 or self.shed_on_burn
+                or (self.shed_lag_s > 0 and self._lag_fn is not None))
 
     def _queue_depth(self) -> int:
         if self._depth_fn is not None:
@@ -188,6 +198,13 @@ class LoadShedder:
             try:
                 if self._burning_fn():
                     reason = "slo_burn"
+            except Exception:
+                reason = None
+        if (reason is None and self.shed_lag_s > 0
+                and self._lag_fn is not None):
+            try:
+                if self._lag_fn() >= self.shed_lag_s:
+                    reason = "replica_lag"
             except Exception:
                 reason = None
         if reason is not None:

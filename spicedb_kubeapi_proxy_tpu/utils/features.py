@@ -121,6 +121,12 @@ GATES.register("AsyncRebuild", stage=BETA, default=True)
 # bounds and shed thresholds are inert and overload queues unboundedly
 # as before.
 GATES.register("AdmissionControl", stage=BETA, default=True)
+# WAL-shipping read replicas (spicedb/replication, docs/replication.md):
+# leader-side replication API (/replication/*) + follower mode
+# (--replicate-from).  This gate is the killswitch: off, the replication
+# routes are not served and a configured --replicate-from is inert —
+# exactly today's single-node behavior.
+GATES.register("Replication", stage=ALPHA, default=True)
 
 
 def pipeline_enabled() -> bool:

@@ -162,11 +162,15 @@ def test_device_batches_do_not_block_event_loop(monkeypatch):
     The stall bound is CALIBRATED, not a wall-clock constant: the old
     fixed 0.3s tripped marginally (0.35-0.46s) in ~half of full-suite
     runs purely from gc/scheduler pauses unrelated to the device batch
-    (PR 5 known flake).  Now an ambient phase measures this box's tick
-    jitter with NO batch in flight, the bound scales from it, and a
-    single bad-luck gc burst gets one retry before the test fails —
-    a genuinely blocked loop (the 0.5s sleep landing ON the loop) still
-    fails both attempts deterministically."""
+    (PR 5 known flake).  An ambient phase measures this box's tick
+    jitter with NO batch in flight and the bound scales from it —
+    floored at 0.35s (in-suite gc bursts were measured at 0.35-0.46s
+    with a quiet calibration phase, so a quiet ambient must not lower
+    the bound into that noise band) and capped at 0.48s (still below
+    the 0.5s device window, so a genuinely blocked loop can never pass).
+    A bad-luck gc burst gets two retries before the test fails; a
+    blocked loop (the 0.5s sleep landing ON the loop) fails every
+    attempt deterministically."""
     import time as _time
 
     ep = create_endpoint("jax://", Bootstrap(schema_text=SCHEMA))
@@ -214,13 +218,15 @@ def test_device_batches_do_not_block_event_loop(monkeypatch):
         # is CAPPED below the 0.5s device window, so a gc burst landing
         # in the calibration phase can never inflate it past the very
         # signal this test exists to detect
-        return max_gap(ticks), min(max(0.3, 4 * ambient), 0.45)
+        return max_gap(ticks), min(max(0.35, 4 * ambient), 0.48)
 
     stall, bound = asyncio.run(go())
-    if stall >= bound:
-        # one retry: a single gen-2 gc burst inside the measured window
-        # is indistinguishable from a stall in one sample but cannot
-        # recur deterministically; a genuinely blocked loop can
+    for _retry in range(2):
+        if stall < bound:
+            break
+        # retries: a gen-2 gc burst inside the measured window is
+        # indistinguishable from a stall in one sample but cannot recur
+        # across attempts; a genuinely blocked loop fails all three
         stall, bound = asyncio.run(go())
     assert stall < bound, (
         f"loop stalled {stall:.3f}s (calibrated bound {bound:.3f}s)")

@@ -479,7 +479,8 @@ class TestDebugSurfaces:
             assert resp.status == 200
             surfaces = json.loads(resp.body)["surfaces"]
             assert set(surfaces) == {"/debug/traces", "/debug/decisions",
-                                     "/debug/flight", "/debug/timeline"}
+                                     "/debug/flight", "/debug/timeline",
+                                     "/debug/replication"}
             for desc in surfaces.values():
                 assert isinstance(desc, str) and desc
         run(go())

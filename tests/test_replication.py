@@ -1,0 +1,578 @@
+"""Replication correctness suite (ISSUE 9): WAL-shipping leader/follower
+over in-process transports, fully deterministic (the follower's
+`sync_once()` is the test-driven unit; `run()` just loops it).
+
+Covers:
+- leader/follower parity referee under write churn (every follower
+  answer identical to the leader oracle at the request's pinned
+  revision);
+- torn/missing segment handling (follower re-bootstraps from the
+  checkpoint instead of diverging);
+- leader restart mid-tail;
+- ZedToken wait-vs-forward paths (X-Authz-Min-Revision honored: wait,
+  forward, or 503 — never a stale answer below min-revision);
+- follower write rejection/forwarding;
+- the Replication gate-off tripwire (single-node behavior exactly);
+- frame-parser torn-tail tolerance (persist.wal.parse_frames).
+"""
+
+import asyncio
+import json
+import shutil
+import tempfile
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import FakeKubeApiServer
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
+from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+from spicedb_kubeapi_proxy_tpu.spicedb.persist.wal import (
+    SEGMENT_MAGIC,
+    TornFrameError,
+    parse_frames,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.replication import (
+    MIN_REVISION_HEADER,
+    REVISION_HEADER,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipUpdate,
+    UpdateOp,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+definition pod {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+"""
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [get]}]
+check: [{tpl: "namespace:{{name}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [list]}]
+prefilter:
+- fromObjectIDNameExpr: "{{resourceId}}"
+  lookupMatchingResources: {tpl: "namespace:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+lock: Optimistic
+check: [{tpl: "namespace:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+"""
+
+N_NS = 12
+
+
+@pytest.fixture(autouse=True)
+def reset_gates():
+    yield
+    GATES.reset()
+
+
+class LeaderLink:
+    """In-process leader transport resolving the proxy's CURRENT handler
+    on every call (enable_dual_writes rebuilds the chain) and swappable
+    to a new incarnation for the leader-restart tests."""
+
+    def __init__(self, proxy):
+        self.proxy = proxy
+
+    async def round_trip(self, req):
+        return await self.proxy.handler(req)
+
+    def set_leader(self, proxy):
+        self.proxy = proxy
+
+
+def make_leader(tmp, seed_ns=True, **opt_kw):
+    kube = FakeKubeApiServer()
+    for i in range(N_NS):
+        kube.seed("", "v1", "namespaces", {"metadata": {"name": f"ns{i}"}})
+    leader = ProxyServer(Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=HandlerTransport(kube),
+        data_dir=tmp, wal_fsync="never", **opt_kw))
+    if seed_ns:
+        leader.endpoint.store.bulk_load([
+            parse_relationship(f"namespace:ns{i}#creator@user:alice")
+            for i in range(0, N_NS, 2)])
+    return leader, kube
+
+
+def make_follower(leader, kube=None, **opt_kw):
+    transport = LeaderLink(leader)
+    follower = ProxyServer(Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=HandlerTransport(kube or FakeKubeApiServer()),
+        replicate_from="http://leader.test",
+        leader_transport=transport, **opt_kw))
+    return follower, transport
+
+
+def churn(leader, i):
+    op = UpdateOp.DELETE if i % 3 == 2 else UpdateOp.TOUCH
+    rel = parse_relationship(
+        f"namespace:ns{i % N_NS}#viewer@user:u{i % 5}")
+    return leader.endpoint.write_relationships(
+        [RelationshipUpdate(op, rel)])
+
+
+async def list_ns(proxy, user, headers=None):
+    client = proxy.get_embedded_client(user)
+    resp = await client.get("/api/v1/namespaces", headers=headers or [])
+    return resp, (sorted(i["metadata"]["name"]
+                         for i in json.loads(resp.body).get("items", []))
+                  if resp.status == 200 else None)
+
+
+@pytest.fixture
+def tmp():
+    d = tempfile.mkdtemp(prefix="repl-test-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def test_parity_referee_under_churn(tmp):
+    """At every quiescent point (leader pinned at revision R, follower
+    synced to exactly R), the follower's filtered list and check answers
+    are identical to the leader's for every user — zero divergences."""
+    leader, kube = make_leader(tmp)
+    follower, _ = make_follower(leader, kube)
+    repl = follower.replication
+    users = ["alice", "u0", "u1", "u2", "u3", "u4", "nobody"]
+
+    async def go():
+        await repl.sync_once()
+        for round_i in range(8):
+            for j in range(5):
+                await churn(leader, round_i * 5 + j)
+            pinned = leader.endpoint.store.revision
+            await repl.sync_once()
+            assert repl.store.revision == pinned
+            for user in users:
+                lr, l_items = await list_ns(leader, user)
+                fr, f_items = await list_ns(follower, user)
+                assert lr.status == fr.status == 200
+                assert f_items == l_items, (
+                    f"divergence at revision {pinned} for {user}: "
+                    f"follower {f_items} != leader {l_items}")
+                # the answer is stamped with the revision it reflects
+                assert int(fr.headers.get(REVISION_HEADER)) == pinned
+
+    asyncio.run(go())
+
+
+def test_bootstrap_from_checkpoint_plus_tail(tmp):
+    """A follower arriving late bootstraps from the newest checkpoint
+    and replays only the WAL tail past its watermark."""
+    leader, kube = make_leader(tmp)
+
+    async def go():
+        for i in range(6):
+            await churn(leader, i)
+        leader.persistence.checkpoint()
+        for i in range(6, 10):
+            await churn(leader, i)
+        follower, _ = make_follower(leader, kube)
+        repl = follower.replication
+        await repl.sync_once()
+        assert repl.store.revision == leader.endpoint.store.revision
+        assert repl.bootstrapped
+        _, l_items = await list_ns(leader, "u1")
+        _, f_items = await list_ns(follower, "u1")
+        assert f_items == l_items
+        # /readyz is 200 once bootstrapped
+        resp = await follower.get_embedded_client("alice").get("/readyz")
+        assert resp.status == 200
+
+    asyncio.run(go())
+
+
+def test_readyz_not_ready_before_bootstrap(tmp):
+    leader, kube = make_leader(tmp)
+    follower, _ = make_follower(leader, kube)
+
+    async def go():
+        resp = await follower.get_embedded_client("alice").get("/readyz")
+        assert resp.status == 503
+        assert b"bootstrapping" in resp.body
+        await follower.replication.sync_once()
+        resp = await follower.get_embedded_client("alice").get("/readyz")
+        assert resp.status == 200
+
+    asyncio.run(go())
+
+
+def test_reclaimed_segment_triggers_rebootstrap(tmp):
+    """A checkpoint on the leader reclaims segments out from under a
+    lagging follower: the follower re-bootstraps from the checkpoint
+    instead of diverging, and ends revision-identical."""
+    leader, kube = make_leader(tmp)
+    follower, _ = make_follower(leader, kube)
+    repl = follower.replication
+
+    async def go():
+        await repl.sync_once()
+        for i in range(10):
+            await churn(leader, i)
+        # checkpoint + reclaim while the follower is mid-tail in seg 1
+        leader.persistence.checkpoint()
+        for i in range(10, 14):
+            await churn(leader, i)
+        await repl.sync_once()
+        assert repl.stats["rebootstraps"] == 1
+        assert repl.store.revision == leader.endpoint.store.revision
+        _, l_items = await list_ns(leader, "u2")
+        _, f_items = await list_ns(follower, "u2")
+        assert f_items == l_items
+        # a re-bootstrap must never hard-fail readiness: with state
+        # already adopted, a mid-re-bootstrap follower reports
+        # degraded-but-200 (hard 503 is reserved for the FIRST
+        # adoption) — otherwise a leader restart ejects every replica
+        # from the load balancer at once
+        assert repl.ever_bootstrapped
+        repl.bootstrapped = False  # as during an in-flight re-bootstrap
+        resp = await follower.get_embedded_client("x").get("/readyz")
+        assert resp.status == 200 and b"re-bootstrapping" in resp.body
+        repl.bootstrapped = True
+
+    asyncio.run(go())
+
+
+def test_leader_restart_mid_tail(tmp):
+    """The leader restarts (same data dir) while the follower tails:
+    pointing the follower at the new incarnation catches it up with no
+    divergence — recovery + replication agree because both replay the
+    same log."""
+    leader, kube = make_leader(tmp)
+    follower, transport = make_follower(leader, kube)
+    repl = follower.replication
+
+    async def go():
+        for i in range(7):
+            await churn(leader, i)
+        await repl.sync_once()
+        # clean leader shutdown (final checkpoint), then a new incarnation
+        await leader.persistence.stop()
+        leader2, _ = make_leader(tmp, seed_ns=False)
+        transport.set_leader(leader2)
+        for i in range(7, 12):
+            await churn(leader2, i)
+        await repl.sync_once()
+        assert repl.store.revision == leader2.endpoint.store.revision
+        _, l_items = await list_ns(leader2, "u1")
+        _, f_items = await list_ns(follower, "u1")
+        assert f_items == l_items
+
+    asyncio.run(go())
+
+
+def test_zedtoken_wait_path(tmp):
+    """A read carrying a min-revision ahead of the tail WAITS for the
+    tail (when it arrives within --replica-wait-ms) and then serves
+    locally — no forward, no stale answer."""
+    leader, kube = make_leader(tmp)
+    follower, _ = make_follower(leader, kube)
+    repl = follower.replication
+
+    async def go():
+        await repl.sync_once()
+        rev = await leader.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns1#viewer@user:zed"))])
+
+        async def late_sync():
+            await asyncio.sleep(0.05)
+            await repl.sync_once()
+
+        sync_task = asyncio.ensure_future(late_sync())
+        resp, items = await list_ns(
+            follower, "zed", headers=[(MIN_REVISION_HEADER, str(rev))])
+        await sync_task
+        assert resp.status == 200
+        assert resp.headers.get("X-Authz-Forwarded-To") == ""
+        assert items == ["ns1"]  # the write is visible: never stale
+        assert int(resp.headers.get(REVISION_HEADER)) >= rev
+
+    asyncio.run(go())
+
+
+def test_zedtoken_forward_and_503_paths(tmp):
+    leader, kube = make_leader(tmp)
+
+    async def go():
+        # forwarding on: a token the replica cannot reach within the
+        # wait forwards to the leader and returns the fresh answer
+        follower, _ = make_follower(leader, kube, replica_wait_ms=30.0)
+        repl = follower.replication
+        await repl.sync_once()
+        rev = await leader.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns3#viewer@user:zed2"))])
+        resp, items = await list_ns(
+            follower, "zed2", headers=[(MIN_REVISION_HEADER, str(rev))])
+        assert resp.status == 200
+        assert resp.headers.get("X-Authz-Forwarded-To") == "leader"
+        assert items == ["ns3"]
+
+        # forwarding off: 503 Status naming the leader, never stale data
+        f2, _ = make_follower(leader, kube, replica_wait_ms=30.0,
+                              replica_forward=False)
+        await f2.replication.sync_once()
+        rev2 = await leader.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns4#viewer@user:zed2"))])
+        resp, _ = await list_ns(
+            f2, "zed2", headers=[(MIN_REVISION_HEADER, str(rev2))])
+        assert resp.status == 503
+        body = json.loads(resp.body)
+        assert body["reason"] == "ServiceUnavailable"
+        assert body["details"]["leader"] == "http://leader.test"
+
+        # malformed token: 400, not a stale 200
+        resp, _ = await list_ns(
+            follower, "zed2", headers=[(MIN_REVISION_HEADER, "banana")])
+        assert resp.status == 400
+
+    asyncio.run(go())
+
+
+def test_follower_write_forwarding_and_rejection(tmp):
+    leader, kube = make_leader(tmp)
+
+    async def go():
+        follower, _ = make_follower(leader, kube)
+        repl = follower.replication
+        await repl.sync_once()
+        leader.enable_dual_writes()
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p1", "namespace": "ns0"}}
+        client = follower.get_embedded_client("alice")
+        resp = await client.post("/api/v1/namespaces/ns0/pods", pod)
+        assert resp.status in (200, 201), resp.body
+        assert resp.headers.get("X-Authz-Forwarded-To") == "leader"
+        # the dual-write landed on the LEADER's store and kube...
+        assert leader.endpoint.store.has_exact(parse_relationship(
+            "pod:ns0/p1#creator@user:alice"))
+        # ...and replicates to the follower
+        await repl.sync_once()
+        assert follower.replication.store.has_exact(parse_relationship(
+            "pod:ns0/p1#creator@user:alice"))
+
+        # forwarding disabled: update verbs are rejected 503
+        f2, _ = make_follower(leader, kube, replica_forward=False)
+        await f2.replication.sync_once()
+        resp = await f2.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods", dict(
+                pod, metadata={"name": "p2", "namespace": "ns0"}))
+        assert resp.status == 503
+        assert json.loads(resp.body)["details"][
+            "leader"] == "http://leader.test"
+
+    asyncio.run(go())
+
+
+def test_leader_outage_degrades_but_serves(tmp):
+    """kill the leader link: the follower keeps serving bounded-staleness
+    reads, /readyz degrades (still 200), and forwarded paths 503."""
+    leader, kube = make_leader(tmp)
+    follower, transport = make_follower(leader, kube)
+    repl = follower.replication
+
+    class DeadTransport:
+        async def round_trip(self, req):
+            raise ConnectionError("leader is gone")
+
+    async def go():
+        for i in range(4):
+            await churn(leader, i)
+        await repl.sync_once()
+        pinned = repl.store.revision
+        _, before = await list_ns(follower, "u1")
+        # sever the link (both the tail and the forward path)
+        follower._leader_transport = DeadTransport()
+        repl.transport = follower._leader_transport
+        with pytest.raises(Exception):
+            await repl.sync_once()
+        repl.state = "degraded"  # run() would set this; sync_once raises
+        resp, after = await list_ns(follower, "u1")
+        assert resp.status == 200 and after == before
+        assert int(resp.headers.get(REVISION_HEADER)) == pinned
+        ready = await follower.get_embedded_client("x").get("/readyz")
+        assert ready.status == 200 and b"degraded" in ready.body
+        # updates now fail loudly instead of silently writing locally
+        resp = await follower.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods",
+            {"metadata": {"name": "px", "namespace": "ns0"}})
+        assert resp.status == 503
+
+    asyncio.run(go())
+
+
+def test_replica_lag_shedding(tmp):
+    """A stale replica sheds read-only traffic (429) before serving
+    garbage once --shed-replica-lag is crossed."""
+    leader, kube = make_leader(tmp)
+    follower, _ = make_follower(leader, kube, shed_replica_lag_s=0.05)
+    repl = follower.replication
+
+    async def go():
+        await repl.sync_once()
+        resp, _ = await list_ns(follower, "u1")
+        assert resp.status == 200  # caught up: no shedding
+        # fall behind: leader advances, follower does not sync
+        await churn(leader, 0)
+        await repl._fetch_manifest(wait=False)  # sees the lag
+        repl._caught_up_at -= 10.0  # stale for "10 seconds"
+        assert repl.lag_seconds() > 0.05
+        resp, _ = await list_ns(follower, "u1")
+        assert resp.status == 429
+        assert "replica_lag" in json.loads(resp.body)["message"]
+
+    asyncio.run(go())
+
+
+def test_gate_off_is_single_node_exactly(tmp):
+    """Replication killswitch tripwire: gate off, a configured
+    --replicate-from is inert (no follower objects, no interception) and
+    the leader's data dir is NOT served at /replication/*."""
+    GATES.set("Replication", False)
+    leader, kube = make_leader(tmp)
+    follower, _ = make_follower(leader, kube)
+    assert follower.replication is None
+    assert leader.replication_hub is None
+
+    async def go():
+        # /replication answers 503 "not served here", not leader data
+        resp = await leader.get_embedded_client("alice").get(
+            "/replication/manifest")
+        assert resp.status == 503
+        # no revision stamping anywhere (exact single-node responses)
+        resp, items = await list_ns(leader, "alice")
+        assert resp.status == 200
+        assert resp.headers.get(REVISION_HEADER) == ""
+        # the "follower" serves from its own (empty) store like any
+        # single-node proxy: nothing replicated, no forwarding
+        resp, items = await list_ns(follower, "alice")
+        assert resp.status == 200 and items == []
+
+    asyncio.run(go())
+
+
+def test_replication_api_requires_auth_and_safe_names(tmp):
+    leader, _ = make_leader(tmp)
+
+    async def go():
+        anon = leader.get_embedded_client("")  # no identity headers
+        resp = await anon.get("/replication/manifest")
+        assert resp.status == 401
+        client = leader.get_embedded_client("alice")
+        for name in ("../MANIFEST.json", "..%2fMANIFEST.json",
+                     "seg-1.wal", "ckpt-1.npz", "etc/passwd"):
+            resp = await client.get(f"/replication/segment/{name}")
+            assert resp.status == 400, name
+        man = json.loads((await client.get("/replication/manifest")).body)
+        assert man["revision"] == leader.endpoint.store.revision
+        assert man["segments"], "live segment should be listed"
+
+    asyncio.run(go())
+
+
+def test_longpoll_manifest_wakes_on_commit(tmp):
+    leader, _ = make_leader(tmp)
+    hub = leader.replication_hub
+
+    async def go():
+        rev = leader.endpoint.store.revision
+
+        async def poke():
+            await asyncio.sleep(0.05)
+            await churn(leader, 99)
+
+        task = asyncio.ensure_future(poke())
+        ok = await hub.wait_for_revision(rev, timeout_s=5.0)
+        await task
+        assert ok and leader.endpoint.store.revision > rev
+        # and an already-satisfied wait returns immediately
+        assert await hub.wait_for_revision(rev, timeout_s=0.0)
+
+    asyncio.run(go())
+
+
+def test_parse_frames_torn_and_damaged():
+    """The shared frame decoder tolerates a torn tail (partial frame)
+    and refuses a damaged mid-stream frame."""
+    import json as _json
+    import struct
+    import zlib
+
+    def frame(rec):
+        payload = _json.dumps(rec).encode()
+        return struct.pack("<II", len(payload),
+                           zlib.crc32(payload)) + payload
+
+    a, b = frame({"k": "d", "r": 1}), frame({"k": "d", "r": 2})
+    recs, consumed = parse_frames(a + b)
+    assert [r["r"] for r in recs] == [1, 2] and consumed == len(a + b)
+    # torn tail: second frame cut short -> first parses, rest waits
+    recs, consumed = parse_frames(a + b[:-3])
+    assert [r["r"] for r in recs] == [1] and consumed == len(a)
+    # damaged mid-stream frame (bad crc, more data follows) -> error
+    bad = bytearray(a)
+    bad[-1] ^= 0xFF
+    with pytest.raises(TornFrameError):
+        parse_frames(bytes(bad) + b)
+    # magic offset handling mirrors segment layout
+    recs, consumed = parse_frames(SEGMENT_MAGIC + a, len(SEGMENT_MAGIC))
+    assert [r["r"] for r in recs] == [1]
+    assert consumed == len(SEGMENT_MAGIC) + len(a)
+
+
+def test_follower_drives_watch_and_delta_pipeline(tmp):
+    """Replica applies flow through the normal delta pipeline: follower
+    watchers observe replicated writes exactly as local ones."""
+    leader, kube = make_leader(tmp)
+    follower, _ = make_follower(leader, kube)
+    repl = follower.replication
+
+    async def go():
+        await repl.sync_once()
+        watcher = follower.replication.store.subscribe(["namespace"])
+        rel = "namespace:ns7#viewer@user:watched"
+        await leader.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(rel))])
+        await repl.sync_once()
+        upd = await watcher.next(timeout=2.0)
+        assert upd is not None
+        assert [u.rel.rel_string() for u in upd.updates] == [rel]
+        assert upd.revision == repl.store.revision
+        watcher.close()
+
+    asyncio.run(go())

@@ -1,0 +1,162 @@
+"""Static schema/rule lint (spicedb/schema_lint.py, Cedar-inspired) —
+built on the `relation_footprint` closure: unreachable relations,
+statically-DENY permissions, and rule templates naming undefined
+relations all surface before a single request is served."""
+
+from spicedb_kubeapi_proxy_tpu.cli import main as cli_main
+from spicedb_kubeapi_proxy_tpu.config import proxyrule
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.schema_lint import lint_schema
+
+SCHEMA = """
+definition user {}
+definition group { relation member: user }
+definition doc {
+  relation viewer: user | group#member
+  relation orphan: user
+  relation banned: user
+  permission view = viewer - banned
+  permission nobody = nil
+}
+"""
+
+RULES_OK = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-docs}
+match: [{apiVersion: v1, resource: docs, verbs: [get]}]
+check: [{tpl: "doc:{{name}}#view@user:{{user.name}}"}]
+"""
+
+RULES_BAD = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: bad-rel}
+match: [{apiVersion: v1, resource: docs, verbs: [get]}]
+check: [{tpl: "doc:{{name}}#nonexistent@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: bad-type}
+match: [{apiVersion: v1, resource: widgets, verbs: [get]}]
+check: [{tpl: "widget:{{name}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: bad-subject-rel}
+match: [{apiVersion: v1, resource: docs, verbs: [list]}]
+prefilter:
+- fromObjectIDNameExpr: "{{resourceId}}"
+  lookupMatchingResources: {tpl: "doc:$#view@group:{{name}}#nosuch"}
+"""
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def test_clean_schema_and_rules():
+    schema = sch.parse_schema("""
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+""")
+    findings = lint_schema(schema, proxyrule.parse(RULES_OK.replace(
+        "#view@", "#view@")))
+    assert findings == []
+
+
+def test_empty_footprint_and_unreachable_relation():
+    schema = sch.parse_schema(SCHEMA)
+    findings = lint_schema(schema, proxyrule.parse(RULES_OK))
+    by_code = {f.code: f for f in findings}
+    # nobody = nil -> empty footprint warning
+    assert by_code["SL003"].where == "doc#nobody"
+    assert by_code["SL003"].severity == "warn"
+    # orphan feeds no permission and no rule -> unreachable
+    assert by_code["SL004"].where == "doc#orphan"
+    # viewer/banned (in view's footprint) and group#member (referenced
+    # by viewer's subject annotation) are NOT flagged
+    flagged = {f.where for f in findings}
+    assert "doc#viewer" not in flagged
+    assert "doc#banned" not in flagged
+    assert "group#member" not in flagged
+
+
+def test_rule_template_errors():
+    schema = sch.parse_schema(SCHEMA)
+    findings = lint_schema(schema, proxyrule.parse(RULES_BAD))
+    errors = [f for f in findings if f.severity == "error"]
+    msgs = "\n".join(f.message for f in errors)
+    assert any(f.code == "SL002" and "nonexistent" in f.message
+               for f in errors)
+    assert any(f.code == "SL001" and "widget" in f.message for f in errors)
+    assert any(f.code == "SL002" and "nosuch" in f.message
+               for f in errors), msgs
+    # errors sort before warnings
+    assert findings[0].severity == "error"
+
+
+def test_rule_reference_keeps_relation_reachable():
+    """A relation read directly by a rule template (not via any
+    permission) is not 'unreachable'."""
+    schema = sch.parse_schema("""
+definition user {}
+definition doc {
+  relation auditor: user
+  relation viewer: user
+  permission view = viewer
+}
+""")
+    rules = proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: audit}
+match: [{apiVersion: v1, resource: docs, verbs: [get]}]
+check: [{tpl: "doc:{{name}}#auditor@user:{{user.name}}"}]
+""")
+    assert lint_schema(schema, rules) == []
+    # without the rule, auditor IS unreachable
+    assert codes(lint_schema(schema, [])) == ["SL004"]
+
+
+def test_internal_types_exempt():
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+        INTERNAL_SCHEMA,
+        merge_internal_definitions,
+    )
+    schema = merge_internal_definitions(sch.parse_schema("""
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+"""))
+    # lock#workflow / workflow#idempotency_key feed no permission but
+    # belong to the dual-write engine: never flagged
+    assert lint_schema(schema, []) == []
+    assert "lock" in INTERNAL_SCHEMA
+
+
+def test_cli_lint_schema_verb(tmp_path, capsys):
+    bootstrap = tmp_path / "bootstrap.yaml"
+    bootstrap.write_text("schema: |\n" + "\n".join(
+        "  " + line for line in SCHEMA.splitlines()))
+    rules = tmp_path / "rules.yaml"
+    rules.write_text(RULES_BAD)
+    # errors -> exit 1
+    rc = cli_main(["--lint-schema", "--spicedb-bootstrap", str(bootstrap),
+                   "--rule-config", str(rules)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "SL001" in out and "SL002" in out
+    # warnings only -> exit 0 (non-strict), 1 with --lint-schema-strict
+    rc = cli_main(["--lint-schema", "--spicedb-bootstrap", str(bootstrap)])
+    assert rc == 0
+    rc = cli_main(["--lint-schema", "--spicedb-bootstrap", str(bootstrap),
+                   "--lint-schema-strict"])
+    assert rc == 1
+    # the built-in default schema lints clean of errors
+    assert cli_main(["--lint-schema"]) == 0
