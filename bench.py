@@ -1187,9 +1187,9 @@ def bench_replica_scale(args) -> dict:
         if path == "/replication/manifest":
             return await hub.serve_manifest(req)
         if path.startswith("/replication/segment/"):
-            return hub.serve_segment(req, path.rsplit("/", 1)[1])
+            return await hub.serve_segment(req, path.rsplit("/", 1)[1])
         if path.startswith("/replication/checkpoint/"):
-            return hub.serve_checkpoint(req, path.rsplit("/", 1)[1])
+            return await hub.serve_checkpoint(req, path.rsplit("/", 1)[1])
         return json_response(404, {"message": f"unknown {path}"})
 
     # leader HTTP serving + churn run on a dedicated thread's loop so

@@ -1,0 +1,24 @@
+"""Concurrency & hot-path static analyzer (docs/static-analysis.md).
+
+One rule engine, one driver (`scripts/analyze.py`), one suppression
+story for every static gate the repo has grown:
+
+  A-rules  (this package)        concurrency-shape + jit-purity rules,
+                                 each motivated by a bug this repo
+                                 actually shipped a fix for
+  M-rules  (legacy_lint.py)      the scripts/lint.py AST/lexical rules
+                                 (F401/E722/...  + M001/M002/M003)
+  SL-rules (spicedb/schema_lint) Cedar-style schema/rule lint, bridged
+                                 as a subprocess so the analyzer itself
+                                 never imports jax
+
+Suppressions: `# noqa: AXXX(reason)` on the finding line — the reason
+is REQUIRED (a bare `# noqa: AXXX` is itself finding A000).  Findings
+that predate the rule live in the checked-in baseline
+(scripts/analysis/baseline.json, `--update-baseline`); the gate fails
+only on NEW findings.
+"""
+
+from .core import Finding, SourceFile, Baseline, load_sources  # noqa: F401
+
+ANALYZER_VERSION = 1

@@ -1,0 +1,243 @@
+"""Rule-engine core: findings, parsed sources, noqa suppressions,
+baseline matching.
+
+A rule is a callable `rule(sources) -> list[Finding]` over the full
+parsed file set (rules that need a cross-file graph — lock order,
+jit reach — get it for free; per-file rules just loop).  The driver
+(scripts/analyze.py) applies suppression and baseline filtering
+uniformly AFTER the rules run, so every rule family (A/M/SL) shares
+one suppression story.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+# `# noqa: A001(reason)` — reason required; `# noqa: A001, M003(x)` is
+# two directives.  Bare flake8-style `# noqa` (no code) is ignored: it
+# belongs to external tools and must not silently swallow A-rules.
+_NOQA_RE = re.compile(r"#\s*noqa:\s*([A-Z]{1,2}\d{3})\s*(?:\(([^)]*)\))?")
+
+# directories never analyzed: bytecode, the intentionally-buggy rule
+# fixture corpus (tests/analysis_fixtures), VCS internals
+SKIP_DIRS = frozenset(("__pycache__", "analysis_fixtures", ".git"))
+
+
+def parse_noqa_lines(lines) -> dict:
+    """{lineno: [(code, reason-or-None)]} for every `# noqa: AXXX(...)`
+    directive — the ONE parser behind both SourceFile and the driver's
+    lookaside for files outside the A-rule source set."""
+    out: dict = {}
+    for i, line in enumerate(lines, 1):
+        if "noqa" not in line:
+            continue
+        for mm in _NOQA_RE.finditer(line):
+            out.setdefault(i, []).append((mm.group(1), mm.group(2)))
+    return out
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    symbol: str = ""   # enclosing function qualname ("" = module scope)
+
+    def key(self) -> tuple:
+        """Baseline identity: line numbers drift, (rule, path, symbol,
+        message) is stable until the code itself changes."""
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def text(self) -> str:
+        where = f" [in {self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{where}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+
+class SourceFile:
+    """One parsed file: AST + parent links + qualname index + noqa map."""
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=rel)
+        self.parents: dict = {}
+        self.qualnames: dict = {}   # id(def-node) -> qualname
+        self._index()
+        # line -> [(code, reason-or-None)]
+        self.noqa = parse_noqa_lines(self.lines)
+
+    def _index(self) -> None:
+        def walk(node, qual):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+                q = qual
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    q = f"{qual}.{child.name}" if qual else child.name
+                    if not isinstance(child, ast.ClassDef):
+                        self.qualnames[id(child)] = q
+                walk(child, q)
+        walk(self.tree, "")
+
+    def symbol_at(self, node: ast.AST) -> str:
+        """Qualname of the innermost function enclosing `node`."""
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self.qualnames.get(id(cur), cur.name)
+            cur = self.parents.get(cur)
+        return ""
+
+    def enclosing_class(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        if isinstance(node_or_line, int):
+            line, symbol = node_or_line, ""
+        else:
+            line = getattr(node_or_line, "lineno", 0)
+            symbol = self.symbol_at(node_or_line)
+        return Finding(rule, self.rel, line, message, symbol)
+
+
+def attr_chain(node) -> tuple:
+    """('self', 'store', 'lock') for `self.store.lock`; () when the
+    expression is not a plain name/attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def iter_py(paths) -> list:
+    out = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not SKIP_DIRS.intersection(f.parts):
+                    out.append(f)
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def load_sources(paths, root: Path) -> tuple:
+    """-> (sources, parse_error_findings).  Syntax errors become E999
+    findings instead of crashing the driver (same contract as lint.py)."""
+    sources, errors = [], []
+    for f in iter_py(paths):
+        try:
+            rel = str(f.resolve().relative_to(root.resolve()))
+        except ValueError:
+            rel = str(f)
+        try:
+            sources.append(SourceFile(f, rel))
+        except SyntaxError as e:
+            errors.append(Finding("E999", rel, e.lineno or 0,
+                                  f"syntax error: {e.msg}"))
+    return sources, errors
+
+
+@dataclass
+class Suppression:
+    finding: Finding
+    reason: str
+
+
+def apply_noqa(findings, sources) -> tuple:
+    """Split findings into (kept, suppressed) per the per-line noqa
+    directives; a directive with no reason emits an A000 finding in
+    place of the suppression (rationale is the whole point: six months
+    later the suppression must still explain itself)."""
+    by_rel = {s.rel: s for s in sources}
+    kept, suppressed = [], []
+    for f in findings:
+        src = by_rel.get(f.path)
+        directives = src.noqa.get(f.line, []) if src else []
+        matched = None
+        for code, reason in directives:
+            if code == f.rule:
+                matched = (code, reason)
+                break
+        if matched is None:
+            kept.append(f)
+        elif not (matched[1] or "").strip():
+            kept.append(Finding(
+                "A000", f.path, f.line,
+                f"noqa for {f.rule} has no reason — write "
+                f"`# noqa: {f.rule}(why this is safe)`", f.symbol))
+        else:
+            suppressed.append(Suppression(f, matched[1].strip()))
+    return kept, suppressed
+
+
+class Baseline:
+    """Checked-in grandfathered findings (scripts/analysis/baseline.json).
+
+    Matching consumes multiplicity: two identical findings need two
+    baseline entries, so fixing one instance shrinks the file."""
+
+    def __init__(self, path: Path):
+        self.path = path
+        self.entries: list = []
+        if path.exists():
+            data = json.loads(path.read_text())
+            self.entries = [
+                (e["rule"], e["path"], e.get("symbol", ""), e["message"])
+                for e in data.get("findings", ())]
+
+    def filter(self, findings) -> tuple:
+        """-> (new, baselined, stale_keys)."""
+        budget: dict = {}
+        for k in self.entries:
+            budget[k] = budget.get(k, 0) + 1
+        new, baselined = [], []
+        for f in findings:
+            k = f.key()
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                baselined.append(f)
+            else:
+                new.append(f)
+        stale = [k for k, n in budget.items() for _ in range(n)]
+        return new, baselined, stale
+
+    @staticmethod
+    def write(path: Path, findings, note: str = "") -> None:
+        data = {
+            "comment": note or (
+                "Grandfathered findings (docs/static-analysis.md). "
+                "Regenerate with scripts/analyze.py --update-baseline; "
+                "fix entries rather than adding new ones."),
+            "findings": [
+                {"rule": f.rule, "path": f.path, "symbol": f.symbol,
+                 "message": f.message}
+                for f in sorted(findings, key=Finding.key)],
+        }
+        path.write_text(json.dumps(data, indent=1) + "\n")
+
+
+@dataclass
+class RuleResult:
+    findings: list = field(default_factory=list)

@@ -1,0 +1,143 @@
+"""A001 (event-loop-blocking call in `async def`) and A002 (dropped
+asyncio task).
+
+A001 — every shipped event-loop stall in this repo was a synchronous
+call that looked innocent at the call site: `fsync` on the WAL, a
+checkpoint `np.load`, `block_until_ready` on a device result.  The rule
+flags calls from a known blocking table that are LEXICALLY inside an
+`async def` body — code inside a nested sync def/lambda is excluded,
+because that is exactly the `run_in_executor`/`to_thread` hop that makes
+the call legal.
+
+A002 — the PR 2 GC-hang class: `asyncio.create_task`/`ensure_future`
+whose result is dropped on the floor.  The event loop holds tasks only
+weakly; a gen-2 collection mid-flight destroys the pending task and the
+awaiting caller hangs.  Only a bare expression statement is a drop —
+assigning, awaiting, returning, or passing the task to any call keeps a
+reference (and shows intent).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import attr_chain
+
+# (dotted-prefix) calls that block the calling thread.  Matching is on
+# the trailing components of the attribute chain, so `self.wal.fsync`,
+# `os.fsync`, and `wal.fsync` all hit the `fsync` entry.
+_BLOCKING_TAILS = {
+    ("time", "sleep"): "time.sleep() blocks the loop — use asyncio.sleep",
+    ("os", "fsync"): "os.fsync() is a disk barrier on the event loop",
+    ("os", "fdatasync"): "os.fdatasync() is a disk barrier on the event loop",
+    ("os", "fdopen"): "sync file I/O on the event loop "
+                      "(hop via run_in_executor)",
+    ("subprocess", "run"): "subprocess.run() blocks until the child exits",
+    ("subprocess", "call"): "subprocess.call() blocks until the child exits",
+    ("subprocess", "check_call"): "subprocess.check_call() blocks",
+    ("subprocess", "check_output"): "subprocess.check_output() blocks",
+    ("np", "asarray"):
+        "np.asarray() on the loop materializes (blocking D2H when the "
+        "operand is a device array)",
+    ("np", "array"):
+        "np.array() on the loop materializes (blocking D2H when the "
+        "operand is a device array)",
+}
+# single-name method tails that block regardless of the receiver
+_BLOCKING_METHODS = {
+    "fsync": "fsync is a disk barrier on the event loop",
+    "fdatasync": "fdatasync is a disk barrier on the event loop",
+    "block_until_ready":
+        "block_until_ready() parks the loop for the whole device window",
+    "fsync_if_dirty": "WAL fsync is a disk barrier on the event loop",
+}
+# builtins that are sync file I/O when called in an async body
+_BLOCKING_BUILTINS = {
+    "open": "sync file open() on the event loop (hop via run_in_executor)",
+}
+
+_SPAWN_METHODS = ("create_task", "ensure_future")
+
+
+def _blocking_reason(call: ast.Call):
+    chain = attr_chain(call.func)
+    if not chain:
+        return None
+    if len(chain) == 1:
+        return _BLOCKING_BUILTINS.get(chain[0])
+    tail2 = chain[-2:]
+    if tail2 in _BLOCKING_TAILS:
+        return _BLOCKING_TAILS[tail2]
+    return _BLOCKING_METHODS.get(chain[-1])
+
+
+def _is_task_spawn(call: ast.Call) -> bool:
+    # attr-based so `asyncio.get_running_loop().create_task(...)` —
+    # whose receiver is a Call, not a name chain — still matches
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _SPAWN_METHODS):
+        return True
+    # bare `create_task(...)` / `ensure_future(...)` via from-import
+    return (isinstance(call.func, ast.Name)
+            and call.func.id in _SPAWN_METHODS)
+
+
+class _AsyncBodyWalker(ast.NodeVisitor):
+    """Visit one async def's body without descending into nested
+    function scopes (a nested sync def/lambda runs elsewhere — usually
+    on an executor — so its calls are not loop-blocking here)."""
+
+    def __init__(self, src, func, findings):
+        self.src = src
+        self.func = func
+        self.findings = findings
+
+    def visit_FunctionDef(self, node):   # do not descend
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Call(self, node):
+        reason = _blocking_reason(node)
+        if reason is not None:
+            self.findings.append(self.src.finding(
+                "A001", node,
+                f"blocking call in async def `{self.func.name}`: {reason}"))
+        self.generic_visit(node)
+
+
+def rule_a001(sources) -> list:
+    findings: list = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            walker = _AsyncBodyWalker(src, node, findings)
+            for stmt in node.body:
+                walker.visit(stmt)
+    return findings
+
+
+def rule_a002(sources) -> list:
+    findings: list = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and _is_task_spawn(node.value)):
+                continue
+            fn = ".".join(attr_chain(node.value.func)) or (
+                node.value.func.attr
+                if isinstance(node.value.func, ast.Attribute)
+                else node.value.func.id)
+            findings.append(src.finding(
+                "A002", node.value,
+                f"task from `{fn}(...)` is dropped — the loop holds "
+                f"tasks weakly, so gc can destroy it mid-flight "
+                f"(store it and await/cancel on shutdown, or chain a "
+                f"done-callback)"))
+    return findings

@@ -1,0 +1,234 @@
+"""A005 — jit purity by call-graph reach (supersedes M003's comment
+fences).
+
+M003 could only see host work inside `# hotpath:` fenced regions; the
+first-use compile stalls PR 8 chased lived in UNfenced helpers reached
+from jitted entry points.  This rule finds every `jax.jit(...)` site in
+`ops/`, resolves the jitted function, and walks the lexical call graph
+from it — including the factory idiom this codebase uses everywhere
+(`evaluate = make_ell_evaluate(...)` where `make_ell_evaluate` is a
+module-level factory returning a locally-defined closure): calls
+through the bound name reach the factory's returned defs.  Inside any
+reached ("traced") function it flags:
+
+  * host `np.` array construction (anything that MAKES an array; dtype
+    descriptors stay legal — same whitelist as M003) — a silent
+    device->host->device round trip on every call;
+  * `time.*` / `random.*` / `datetime.now` — trace-time constants
+    frozen into the compiled kernel, a classic silent-staleness bug;
+  * `.item()` / `np.asarray` — forced materialization that blocks on
+    the device inside the traced region;
+  * Python `for`/`while` whose trip condition reads a traced PARAMETER
+    — either a TracerConversionError at first call or a per-shape
+    retrace storm (the PR 8 compile-stall class); loops over closure
+    constants (static unroll, e.g. staged sweeps) are legal and not
+    flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import attr_chain
+
+_NP_DTYPE_WHITELIST = frozenset((
+    "ndarray", "dtype", "int32", "int64", "uint32", "uint8", "float32",
+    "float64", "bool_", "uint64", "int8", "int16", "uint16", "integer",
+    "floating", "generic",
+))
+# trace-time clock/randomness calls: any `time.*` / `random.*` call,
+# plus `.now()` through a datetime chain (`datetime.now`,
+# `datetime.datetime.now`)
+_CLOCK_ROOTS = ("time", "random")
+
+
+class _Scope:
+    """One module's lexical function index: qualname -> def node, plus
+    factory returns and jit roots."""
+
+    def __init__(self, src):
+        self.src = src
+        self.defs: dict = {}        # qualname -> node
+        self.children: dict = {}    # qualname -> {bare name -> qualname}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = src.qualnames[id(node)]
+                self.defs[qual] = node
+        for qual in self.defs:
+            parent = qual.rsplit(".", 1)[0] if "." in qual else ""
+            self.children.setdefault(parent, {})[
+                qual.rsplit(".", 1)[-1]] = qual
+        # module-level factories: def F(): ... return <local def name>
+        self.factory_returns: dict = {}   # func qualname -> [qualnames]
+        for qual, node in self.defs.items():
+            returned = []
+            local = self.children.get(qual, {})
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Return)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id in local):
+                    returned.append(local[sub.value.id])
+            if returned:
+                self.factory_returns[qual] = returned
+
+    def resolve(self, name: str, from_qual: str):
+        """Bare callee name -> qualname, walking enclosing scopes."""
+        scope = from_qual
+        while True:
+            hit = self.children.get(scope, {}).get(name)
+            if hit is not None:
+                return hit
+            if not scope:
+                return None
+            scope = scope.rsplit(".", 1)[0] if "." in scope else ""
+
+
+def _is_jit_ref(node) -> bool:
+    chain = attr_chain(node)
+    return chain[-2:] == ("jax", "jit") or chain[-1:] == ("jit",)
+
+
+def _jit_roots(scope) -> list:
+    """Function qualnames jitted anywhere in the file: the call form
+    `jax.jit(fn, ...)`, the decorator forms `@jax.jit` /
+    `@jax.jit(...)`, and `@partial(jax.jit, ...)`."""
+    roots = []
+    for node in ast.walk(scope.src.tree):
+        if isinstance(node, ast.Call) and _is_jit_ref(node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                enclosing = scope.src.symbol_at(node)
+                target = scope.resolve(node.args[0].id, enclosing)
+                if target is not None:
+                    roots.append(target)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                jitted = (
+                    _is_jit_ref(dec)                       # @jax.jit
+                    or (isinstance(dec, ast.Call)
+                        and (_is_jit_ref(dec.func)         # @jax.jit(...)
+                             or (attr_chain(dec.func)[-1:]
+                                 == ("partial",)           # @partial(jax.jit)
+                                 and any(_is_jit_ref(a)
+                                         for a in dec.args)))))
+                if jitted:
+                    roots.append(scope.src.qualnames[id(node)])
+                    break
+    return roots
+
+
+def _reach(scope, roots) -> set:
+    """Traced set: closure over bare-name calls, factory-bound names
+    (`x = factory(...)` -> factory's returned defs), and factory
+    returns themselves."""
+    # per-function: names bound from factory calls
+    traced: set = set()
+    work = list(roots)
+    while work:
+        qual = work.pop()
+        if qual in traced or qual not in scope.defs:
+            continue
+        traced.add(qual)
+        node = scope.defs[qual]
+        bound: dict = {}   # local name -> [callee qualnames]
+        # include bindings made in ENCLOSING defs (closures see them)
+        for enc_qual, enc_node in scope.defs.items():
+            if not (qual == enc_qual or qual.startswith(enc_qual + ".")):
+                continue
+            for sub in ast.walk(enc_node):
+                if (isinstance(sub, ast.Assign)
+                        and isinstance(sub.value, ast.Call)
+                        and isinstance(sub.value.func, ast.Name)):
+                    factory = scope.resolve(sub.value.func.id, enc_qual)
+                    rets = scope.factory_returns.get(factory or "", ())
+                    if not rets:
+                        continue
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            bound.setdefault(tgt.id, []).extend(rets)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                callee = scope.resolve(sub.func.id, qual)
+                if callee is not None:
+                    work.append(callee)
+                work.extend(bound.get(sub.func.id, ()))
+    return traced
+
+
+def _check_traced(src, qual, node, findings) -> None:
+    params = {a.arg for a in (node.args.args + node.args.posonlyargs
+                              + node.args.kwonlyargs)}
+    nested = {id(n) for n in ast.walk(node)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and n is not node}
+
+    def in_nested(n):
+        cur = src.parents.get(n)
+        while cur is not None and cur is not node:
+            if id(cur) in nested:
+                return True
+            cur = src.parents.get(cur)
+        return False
+
+    for sub in ast.walk(node):
+        if in_nested(sub):
+            continue   # nested defs are traced separately if reached
+        if isinstance(sub, ast.Call):
+            chain = attr_chain(sub.func)
+            if (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "item"):
+                # attr-based, not chain-based: `x.sum().item()` has no
+                # resolvable name chain but blocks all the same
+                findings.append(src.finding(
+                    "A005", sub,
+                    f"`.item()` inside jit-reached `{qual}` forces a "
+                    f"blocking device materialization at trace time"))
+            elif (len(chain) >= 2 and chain[-2] == "np"
+                    and chain[-1] not in _NP_DTYPE_WHITELIST):
+                findings.append(src.finding(
+                    "A005", sub,
+                    f"host `np.{chain[-1]}(...)` inside jit-reached "
+                    f"`{qual}` — host work in a traced function runs "
+                    f"per call on the device round trip"))
+            elif (len(chain) >= 2 and chain[0] in _CLOCK_ROOTS) or (
+                    len(chain) >= 2 and chain[-1] == "now"
+                    and chain[0] == "datetime"):
+                findings.append(src.finding(
+                    "A005", sub,
+                    f"`{'.'.join(chain)}(...)` inside jit-reached "
+                    f"`{qual}` is frozen at trace time — the compiled "
+                    f"kernel replays the first call's value forever"))
+        elif isinstance(sub, (ast.For, ast.While)):
+            test = sub.iter if isinstance(sub, ast.For) else sub.test
+            # a param used through an attribute access is static at
+            # trace time (`range(1, idx.shape[1])` unrolls over a shape
+            # constant, `expr.children` is static pytree structure);
+            # only a DIRECT use of the param drives the loop by a
+            # traced value
+            hot = set()
+            for n in ast.walk(test):
+                if (isinstance(n, ast.Name) and n.id in params
+                        and not isinstance(src.parents.get(n),
+                                           ast.Attribute)):
+                    hot.add(n.id)
+            if hot:
+                kind = "for" if isinstance(sub, ast.For) else "while"
+                findings.append(src.finding(
+                    "A005", sub,
+                    f"Python `{kind}` over traced parameter(s) "
+                    f"{sorted(hot)} in jit-reached `{qual}` — use "
+                    f"lax.scan/while_loop (a Python loop either fails "
+                    f"tracing or retraces per shape)"))
+
+
+def rule_a005(sources) -> list:
+    findings: list = []
+    for src in sources:
+        if "/ops/" not in "/" + src.rel.replace("\\", "/"):
+            continue
+        scope = _Scope(src)
+        roots = _jit_roots(scope)
+        if not roots:
+            continue
+        for qual in sorted(_reach(scope, roots)):
+            _check_traced(src, qual, scope.defs[qual], findings)
+    return findings

@@ -1,0 +1,253 @@
+"""A003 — lock-order inversion, await-under-sync-lock, and sync-lock
+self-deadlock.
+
+The PR 5 finalizer bug is the template: a `weakref.finalize` callback
+ran inside gc on a thread already holding the ledger/gauge lock and
+re-acquired it — a self-deadlock no test provoked for four PRs.  The
+PR 8 shedder snapshot deadlock was the two-lock variant.  This rule
+builds the acquisition graph of every NAMED lock (`with self._lock:`
+style sites — any name/attr chain whose last component contains "lock")
+plus a one-level inter-procedural closure (calls made while holding a
+lock contribute the callee's direct acquisitions), then flags:
+
+  * cycles in the acquisition order (ABBA deadlocks waiting to happen);
+  * `await` lexically under a SYNC lock — the loop parks inside the
+    critical section, so every other coroutine needing the lock (or the
+    loop) stalls behind an arbitrary-length await;
+  * re-acquiring a lock created as `threading.Lock()` (not RLock) while
+    already holding it, via the same one-level closure — the
+    finalizer-class self-deadlock.
+
+Lock identity: `self.X` -> "<ClassName>.X" (per-class), a longer
+`self.a.b` chain -> "a.b" (shared object, e.g. every holder of
+`store.lock` means THE TupleStore lock), a bare module global ->
+"<module>.X".
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import attr_chain
+
+
+def _lock_id(chain: tuple, class_name: str, module: str):
+    if not chain or "lock" not in chain[-1].lower():
+        return None
+    if chain[0] == "self":
+        rest = chain[1:]
+        if len(rest) == 1:
+            return f"{class_name or module}.{rest[0]}"
+        return ".".join(rest)
+    if len(chain) == 1:
+        return f"{module}.{chain[0]}"
+    return ".".join(chain)
+
+
+class _FuncInfo:
+    def __init__(self, qual):
+        self.qual = qual
+        self.direct_locks: set = set()       # lock ids acquired anywhere
+        self.nested: list = []               # (holder, acquired, line)
+        self.calls_under: list = []          # (holder, callee_quals, line)
+        self.sync_await: list = []           # (holder, line, node)
+        self.reacquire: list = []            # (lock, line) same-lock nesting
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Walk one function body tracking the held-lock stack; nested
+    function defs are separate execution contexts and are not entered."""
+
+    def __init__(self, src, info, class_name, module, self_methods,
+                 module_funcs):
+        self.src = src
+        self.info = info
+        self.class_name = class_name
+        self.module = module
+        self.self_methods = self_methods
+        self.module_funcs = module_funcs
+        self.stack: list = []     # (lock_id, is_sync)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    def _with(self, node, is_sync):
+        # items acquire LEFT TO RIGHT: each is pushed before the next is
+        # checked, so `with a, b:` records the a->b edge (and a
+        # re-acquire of a lock earlier in the SAME statement) exactly
+        # like the nested form
+        n_acquired = 0
+        for item in node.items:
+            lid = _lock_id(attr_chain(item.context_expr),
+                           self.class_name, self.module)
+            if lid is None:
+                continue
+            self.info.direct_locks.add(lid)
+            for held, _hs in self.stack:
+                if held == lid:
+                    self.info.reacquire.append((lid, node.lineno))
+                else:
+                    self.info.nested.append((held, lid, node.lineno))
+            self.stack.append((lid, is_sync))
+            n_acquired += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if n_acquired:
+            del self.stack[-n_acquired:]
+        # visit the context expressions too (call args may hide spawns)
+        for item in node.items:
+            self.visit(item.context_expr)
+
+    def visit_With(self, node):
+        self._with(node, True)
+
+    def visit_AsyncWith(self, node):
+        self._with(node, False)
+
+    def visit_Await(self, node):
+        sync_held = [lid for lid, is_sync in self.stack if is_sync]
+        if sync_held:
+            self.info.sync_await.append((sync_held[-1], node.lineno, node))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if self.stack:
+            callees = self._resolve(node)
+            if callees:
+                holders = [lid for lid, _s in self.stack]
+                self.info.calls_under.append(
+                    (holders, callees, node.lineno))
+        self.generic_visit(node)
+
+    def _resolve(self, call: ast.Call) -> list:
+        chain = attr_chain(call.func)
+        if len(chain) == 2 and chain[0] == "self" and self.class_name:
+            qual = f"{self.class_name}.{chain[1]}"
+            if qual in self.self_methods:
+                return [qual]
+        elif len(chain) == 1 and chain[0] in self.module_funcs:
+            return [chain[0]]
+        return []
+
+
+def _collect(src):
+    module = src.rel.rsplit("/", 1)[-1].removesuffix(".py")
+    infos: dict = {}
+    lock_kinds: dict = {}
+    # lock construction sites: self._x = threading.Lock() / RLock()
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = attr_chain(node.value.func)
+            if ctor[-1:] not in (("Lock",), ("RLock",)):
+                continue
+            for tgt in node.targets:
+                chain = attr_chain(tgt)
+                cls = src.enclosing_class(node)
+                lid = _lock_id(chain, cls.name if cls else "", module)
+                if lid is not None:
+                    lock_kinds[lid] = ctor[-1]
+    quals = set(src.qualnames.values())
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = src.qualnames.get(id(node), node.name)
+        cls = src.enclosing_class(node)
+        info = _FuncInfo(qual)
+        walker = _LockWalker(
+            src, info, cls.name if cls else "", module,
+            self_methods=quals, module_funcs=quals)
+        for stmt in node.body:
+            walker.visit(stmt)
+        infos[qual] = info
+    return module, infos, lock_kinds
+
+
+def rule_a003(sources) -> list:
+    findings: list = []
+    edges: dict = {}      # (a, b) -> (src, line, via)
+    lock_kinds: dict = {}
+    per_file = []
+    for src in sources:
+        module, infos, kinds = _collect(src)
+        lock_kinds.update(kinds)
+        per_file.append((src, infos))
+
+    for src, infos in per_file:
+        for info in infos.values():
+            for held, acq, line in info.nested:
+                edges.setdefault((held, acq), (src, line, "direct"))
+            for lid, line in info.reacquire:
+                if lock_kinds.get(lid, "RLock") != "RLock":
+                    findings.append(src.finding(
+                        "A003", line,
+                        f"self-deadlock: re-acquiring non-reentrant lock "
+                        f"`{lid}` while already holding it"))
+            for lid, line, node in info.sync_await:
+                findings.append(src.finding(
+                    "A003", node,
+                    f"`await` while holding sync lock `{lid}` — the "
+                    f"critical section spans an arbitrary suspension; "
+                    f"every thread needing the lock stalls behind it"))
+    # one-level call closure: calls under a lock contribute the callee's
+    # direct acquisitions (callee resolved within the same file)
+    for src, infos in per_file:
+        for info in infos.values():
+            for holders, callees, line in info.calls_under:
+                for callee in callees:
+                    ci = infos.get(callee)
+                    if ci is None:
+                        continue
+                    for acq in ci.direct_locks:
+                        for held in holders:
+                            if held == acq:
+                                if lock_kinds.get(acq, "RLock") != "RLock":
+                                    findings.append(src.finding(
+                                        "A003", line,
+                                        f"self-deadlock: `{callee}` "
+                                        f"re-acquires non-reentrant "
+                                        f"`{acq}` already held here"))
+                            else:
+                                edges.setdefault(
+                                    (held, acq),
+                                    (src, line, f"via call to {callee}"))
+
+    findings.extend(_cycles(edges))
+    return findings
+
+
+def _cycles(edges) -> list:
+    """Every elementary cycle in the (small) lock graph, each reported
+    once at its lexically-first edge site."""
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    seen_cycles = set()
+    findings = []
+
+    def dfs(start, node, path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 1:
+                canon = tuple(sorted(path))
+                if canon in seen_cycles:
+                    continue
+                seen_cycles.add(canon)
+                ordered = path + [start]
+                src, line, via = edges[(path[0], path[1])]
+                findings.append(src.finding(
+                    "A003", line,
+                    f"lock-order cycle: "
+                    f"{' -> '.join(ordered)} ({via}; an ABBA deadlock "
+                    f"needs only two threads taking these in opposite "
+                    f"order)"))
+            elif nxt not in path and nxt in graph:
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(graph):
+        dfs(start, start, [start])
+    return findings

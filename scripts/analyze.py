@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Unified static-analysis driver (docs/static-analysis.md).
+
+ONE entry point for every static gate in the repo:
+
+  A001-A005  concurrency & hot-path rules (scripts/analysis/rules_*)
+  M-rules    the historical scripts/lint.py families (legacy_lint)
+  SL-rules   schema/rule lint, bridged via
+             `python -m spicedb_kubeapi_proxy_tpu --lint-schema --lint-schema-json`
+             as a SUBPROCESS so this driver never imports jax
+
+Usage:
+  scripts/analyze.py                 # A-rules over the package
+  scripts/analyze.py --all           # A + M + SL (the check.sh gate)
+  scripts/analyze.py --rules A003    # one rule
+  scripts/analyze.py --json          # machine-readable findings
+  scripts/analyze.py --update-baseline   # grandfather current findings
+
+Suppression: `# noqa: AXXX(reason)` on the finding line — reason
+required (a bare code is finding A000).  Works for M-rules too when run
+through this driver.  Pre-existing findings live in
+scripts/analysis/baseline.json; the gate fails only on NEW findings.
+Exit codes: 0 clean, 1 findings, 2 driver/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT / "scripts"))
+
+from analysis import core  # noqa: E402
+from analysis.legacy_lint import run_legacy  # noqa: E402
+from analysis.rules_async import rule_a001, rule_a002  # noqa: E402
+from analysis.rules_gates import rule_a004  # noqa: E402
+from analysis.rules_jit import rule_a005  # noqa: E402
+from analysis.rules_locks import rule_a003  # noqa: E402
+
+RULES = {
+    "A001": rule_a001,
+    "A002": rule_a002,
+    "A003": rule_a003,
+    "A004": rule_a004,
+    "A005": rule_a005,
+}
+DEFAULT_PATHS = ["spicedb_kubeapi_proxy_tpu"]
+BASELINE = ROOT / "scripts" / "analysis" / "baseline.json"
+
+
+class _NoqaOnly:
+    """Noqa directives for files outside the A-rule source set (legacy
+    findings in tests/, scripts/, ...)."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        p = Path(rel)
+        self.noqa = (core.parse_noqa_lines(p.read_text().splitlines())
+                     if p.exists() else {})
+
+
+def start_schema_lint():
+    """SL-rules in a subprocess (the package import pulls jax; the
+    analyzer itself must stay import-light).  Started BEFORE the A/M
+    scan so the child's interpreter+jax startup overlaps it — that
+    overlap is what keeps `--all` inside its <10s check.sh budget."""
+    cmd = [sys.executable, "-m", "spicedb_kubeapi_proxy_tpu",
+           "--lint-schema", "--lint-schema-json"]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, cwd=ROOT,
+                            env=env)
+
+
+def finish_schema_lint(proc) -> tuple:
+    """-> (exit_code, findings, raw payload) from --lint-schema-json.
+    On failure the child's diagnostics must surface — a gate that says
+    only 'schema exit 2' sends the operator off to reproduce it by
+    hand."""
+    out, err = proc.communicate()
+    try:
+        payload = json.loads(out or "{}")
+    except json.JSONDecodeError:
+        payload = {"findings": [], "error": out[-2000:]}
+    if proc.returncode:
+        for line in (err or "").strip().splitlines()[-10:]:
+            print(f"schema-lint: {line}", file=sys.stderr)
+        if payload.get("error"):
+            print(f"schema-lint: {payload['error']}", file=sys.stderr)
+    return proc.returncode, payload.get("findings", []), payload
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="unified static analyzer (see docs/static-analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs for the A-rules "
+                         "(default: the package tree)")
+    ap.add_argument("--all", action="store_true",
+                    help="run A-rules + legacy M-rules + schema SL-rules "
+                         "(the check.sh gate)")
+    ap.add_argument("--legacy", action="store_true",
+                    help="also run the legacy lint.py M-rule families")
+    ap.add_argument("--schema", action="store_true",
+                    help="also run the schema/rule lint (subprocess)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule subset (e.g. A001,A003)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=str(BASELINE),
+                    help=f"baseline file (default {BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "(A/M rules; A000 is never grandfathered)")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline and args.rules:
+        # regenerating the baseline from a rule subset would silently
+        # delete every grandfathered finding of the other rules; an
+        # explicit PATH scope stays allowed (tests regenerate fixture
+        # baselines that way) — a bare --update-baseline is always the
+        # full default-scope universe the --all gate checks against
+        print("error: --update-baseline cannot be combined with a "
+              "--rules subset (it would drop the other rules' "
+              "grandfathered findings)", file=sys.stderr)
+        return 2
+
+    # absolute-ize user paths BEFORE pinning cwd to the repo root (the
+    # M002 doc path and baseline paths are root-relative)
+    paths = [str(Path(p).resolve()) for p in args.paths] or DEFAULT_PATHS
+    os.chdir(ROOT)
+
+    selected = ([r.strip().upper() for r in args.rules.split(",") if r.strip()]
+                or sorted(RULES))
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        print(f"error: unknown rule(s) {unknown}; known: {sorted(RULES)}",
+              file=sys.stderr)
+        return 2
+
+    sl_proc = (start_schema_lint()
+               if (args.all or args.schema) and not args.update_baseline
+               else None)
+
+    sources, findings = core.load_sources(paths, ROOT)
+    for rule in selected:
+        findings.extend(RULES[rule](sources))
+
+    # a baseline rewrite must see the SAME finding universe the --all
+    # gate checks against, or it drops the legacy entries on the floor
+    run_m = args.all or args.legacy or args.update_baseline
+    n_files = len(sources)
+    if run_m:
+        legacy_findings, n_legacy = run_legacy()
+        findings.extend(legacy_findings)
+        n_files = max(n_files, n_legacy)
+
+    findings, suppressed = core.apply_noqa(
+        findings,
+        list(sources) + [_NoqaOnly(p) for p in
+                         {f.path for f in findings}
+                         - {s.rel for s in sources}])
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    baseline_path = Path(args.baseline)
+    if args.update_baseline:
+        keep = [f for f in findings if f.rule != "A000"]
+        core.Baseline.write(baseline_path, keep)
+        print(f"analyze: baseline rewritten with {len(keep)} findings "
+              f"-> {baseline_path}")
+        return 0
+
+    baselined, stale = [], []
+    if not args.no_baseline:
+        bl = core.Baseline(baseline_path)
+        findings, baselined, stale = bl.filter(findings)
+
+    sl_exit, sl_findings = 0, []
+    if sl_proc is not None:
+        sl_exit, sl_findings, _payload = finish_schema_lint(sl_proc)
+
+    if args.as_json:
+        print(json.dumps({
+            "version": 1,
+            "findings": [f.as_dict() for f in findings],
+            "suppressed": [{**s.finding.as_dict(), "reason": s.reason}
+                           for s in suppressed],
+            "baselined": len(baselined),
+            "stale_baseline": [list(k) for k in stale],
+            "schema": {"exit": sl_exit, "findings": sl_findings},
+            "summary": {"files": n_files, "new": len(findings)},
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f.text())
+        for f in sl_findings:
+            sev = f.get("severity", "warn").upper()
+            print(f"schema: {sev} {f.get('code')} [{f.get('where')}] "
+                  f"{f.get('message')}")
+        for k in stale:
+            print(f"note: stale baseline entry (fixed? run "
+                  f"--update-baseline): {k[0]} {k[1]} {k[3][:60]}")
+        bits = [f"{n_files} files", f"{len(findings)} new findings"]
+        if baselined:
+            bits.append(f"{len(baselined)} baselined")
+        if suppressed:
+            bits.append(f"{len(suppressed)} noqa-suppressed")
+        if args.all or args.schema:
+            bits.append(f"schema exit {sl_exit}")
+        print(f"analyze: {', '.join(bits)}")
+
+    if sl_exit == 2:
+        return 2
+    return 1 if (findings or sl_exit) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

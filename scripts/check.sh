@@ -13,24 +13,24 @@ cd "$(dirname "$0")/.."
 echo "== syntax gate (compileall)"
 python -m compileall -q spicedb_kubeapi_proxy_tpu tests bench.py __graft_entry__.py
 
-echo "== lint gate (scripts/lint.py; CI additionally runs ruff)"
-# the default paths cover the whole package tree — including the tracing
-# module (spicedb_kubeapi_proxy_tpu/utils/tracing.py) — and enforce the
-# metrics-cardinality allowlist (M001: identities live in audit events,
-# never in metric labels) plus the docs-vs-registry metric drift gate
-# (M002: every authz_* family in code is documented in
-# docs/observability.md and vice versa) and the device hot-path fence
-# gate (M003: no host numpy / per-item loops inside the marked
-# per-batch dispatch regions of ops/*.py — the device-resident
-# pipeline's win must not silently reserialize)
-python scripts/lint.py
-
-echo "== static schema/rule lint (--lint-schema, Cedar-inspired)"
-# unreachable relations, statically-DENY permissions, rule templates
-# naming undefined relations — all from the relation_footprint closure,
-# before a single request is served (spicedb/schema_lint.py; errors
-# fail the gate, warnings are informational)
-JAX_PLATFORMS=cpu python -m spicedb_kubeapi_proxy_tpu --lint-schema
+echo "== static analysis gate (scripts/analyze.py --all)"
+# ONE driver for every static gate (docs/static-analysis.md):
+#   A001-A005  concurrency & hot-path rules — event-loop-blocking calls
+#              in async defs, dropped asyncio tasks (the PR 2 GC-hang
+#              class), lock-order cycles / await-under-sync-lock (the
+#              PR 5 finalizer-deadlock class), feature-gate hygiene
+#              ("killswitch off must mean inert"), and jit purity by
+#              call-graph reach (supersedes the M003 fence for
+#              unfenced helpers)
+#   M-rules    the historical lint.py families (F401/... + M001 metric
+#              cardinality, M002 docs-vs-registry drift, M003 hotpath
+#              fences) — scripts/lint.py still works standalone
+#   SL-rules   schema/rule lint via --lint-schema --lint-schema-json in
+#              a subprocess (overlapped with the scan; errors fail)
+# Fails on any NEW finding (not noqa'd with a reason, not in
+# scripts/analysis/baseline.json).  Runs even with --fast; no jax
+# import in the driver itself.
+JAX_PLATFORMS=cpu python scripts/analyze.py --all
 
 if [[ "${1:-}" != "--fast" ]]; then
   echo "== unit + e2e suites with enforced-minimum line coverage"

@@ -1,360 +1,31 @@
-"""Zero-dependency lint gate (reference runs golangci-lint in CI,
-/root/reference/.github/workflows/build-test.yaml:56-92 and
-magefiles/lint.go; this sandbox has no ruff/flake8 baked in, so the
-local gate is an AST pass over the same high-signal rule families —
-CI additionally runs real ruff, see .github/workflows/build-test.yaml).
+"""Zero-dependency lint gate — THIN WRAPPER.
 
-Checks:
-  F401  unused import (module scope; `__future__` exempt)
-  E722  bare `except:`
-  B006  mutable default argument
-  E711  comparison to None with ==/!=
-  F811  redefinition of a top-level def/class in the same scope
-  W291  trailing whitespace
-  E501  line longer than 100 characters
-  TAB   hard tab in indentation
-  M001  metric label name outside the bounded-cardinality allowlist
-        (package code only): audit EVENTS carry identities (usernames,
-        object names); metric LABELS must never — a `user=` label is an
-        unbounded time-series explosion and an identity leak in every
-        scrape.  Extend ALLOWED_METRIC_LABELS only with label names
-        whose value set is bounded by config/schema, not by traffic.
-  M003  host work inside a marked device hot path (ops/*.py only):
-        regions fenced by `# hotpath: begin` / `# hotpath: end` are the
-        per-batch dispatch paths the device-resident pipeline moved off
-        the host (docs/performance.md "Device-resident pipeline") —
-        reintroducing host numpy (`np.`) or a per-item Python loop
-        there silently reverts the PR 7 win while every test still
-        passes.  Device work (`jnp.`) is fine; if host staging is
-        genuinely needed, move it out of the fenced region.
-  M002  docs-vs-registry metric drift (default-path runs only): every
-        `authz_*` metric family registered in package code must appear
-        in docs/observability.md, and every `authz_*` family the doc
-        names must exist in code — a metric that ships undocumented is
-        invisible to operators, and a documented one that was renamed
-        away is a dashboard silently reading zeros.  Dynamically named
-        families (`authz_backend_<stat>_total`, scrape-time stats
-        gauges) are exempt by prefix.
+The rule implementations moved into scripts/analysis/legacy_lint.py
+behind the unified analyzer driver (scripts/analyze.py, see
+docs/static-analysis.md for the full catalog: F401/E722/B006/E711/
+F811/W291/E501/TAB/E999 plus M001 metric-label cardinality, M002
+docs-vs-registry metric drift, M003 hotpath fences).  This wrapper
+keeps the historical CLI contract byte-compatible:
 
-(E712 `== True` is deliberately NOT enforced: the codebase compares
-numpy bools where `is True` would silently change semantics.)
+    python scripts/lint.py [paths...]     # exit 1 on any finding
 
-Exit 1 on any finding.  Usage: python scripts/lint.py [paths...]
+Prefer `scripts/analyze.py --all` (adds the A-rules, noqa suppressions
+and the baseline); this entry point applies neither — it reports raw
+findings exactly as it always did.
 """
 
-import ast
-import re
 import sys
 from pathlib import Path
 
-DEFAULT_PATHS = ["spicedb_kubeapi_proxy_tpu", "tests", "scripts",
-                 "bench.py", "__graft_entry__.py"]
-MAX_LINE = 100
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-# bounded-cardinality metric label names (M001).  Everything here has a
-# value set bounded by configuration or schema: verbs, status codes,
-# tracing phases, backend schemes, kube resource names, drop reasons,
-# audit stages/decisions, gc generations, WAL record kinds, device-
-# telemetry buffer kinds / pow-2 batch buckets / SLO names / burn
-# horizons (utils/devtel.py), histogram `le`.
-ALLOWED_METRIC_LABELS = frozenset((
-    "verb", "code", "phase", "backend", "resource", "reason", "stage",
-    "decision", "generation", "kind", "le", "bucket", "slo", "window",
-    "cause", "mode",
-))
-_METRIC_FACTORIES = ("counter", "gauge", "histogram")
-# the cardinality contract applies to shipping code; tests/scripts mint
-# throwaway registries with synthetic labels
-_M001_PREFIX = "spicedb_kubeapi_proxy_tpu"
-
-# M003 hot-path fences: per-batch device-dispatch regions in ops/*.py
-# (and the endpoint's dispatch sites) marked by these comments
-_HOTPATH_BEGIN = "hotpath: begin"
-_HOTPATH_END = "hotpath: end"
-# host numpy as its own token (`np.`), NOT `jnp.`; plus per-item Python
-# loops — the two regressions that quietly reserialize the pipeline.
-# Type/dtype descriptors (`np.ndarray` annotations, bare dtype names)
-# do no host work and stay legal; anything that MAKES an array
-# (np.zeros / np.asarray / np.nonzero / ...) is the regression.
-_M003_NP = re.compile(
-    r"(?<![A-Za-z_0-9])np\."
-    r"(?!(ndarray|dtype|int32|int64|uint32|uint8|float32|bool_)\b)")
-_M003_LOOP = re.compile(r"^\s*(async\s+)?(for|while)\b")
-
-# M002 docs-vs-registry drift: the one place the metric catalog lives
-_METRICS_DOC = Path("docs/observability.md")
-# families whose NAMES are minted at runtime (scrape-time stats gauges)
-# — the AST scan cannot see them and the doc documents them as a
-# pattern, so both directions exempt anything under these prefixes
-_DYNAMIC_METRIC_PREFIXES = ("authz_backend",)
-
-
-def iter_py(paths):
-    for p in paths:
-        p = Path(p)
-        if p.is_dir():
-            yield from sorted(p.rglob("*.py"))
-        elif p.suffix == ".py":
-            yield p
-
-
-class Visitor(ast.NodeVisitor):
-    def __init__(self, findings, path, metric_families=None):
-        self.findings = findings
-        self.path = path
-        self.imports: dict = {}   # name -> (lineno, import stmt text)
-        self.used: set = set()
-        self.toplevel_defs: dict = {}
-        # authz_* family names registered by package code (M002 input);
-        # None when the caller is not collecting
-        self.metric_families = metric_families
-
-    def visit_Import(self, node):
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imports[name] = node.lineno
-        self.generic_visit(node)
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":
-            return
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imports[a.asname or a.name] = node.lineno
-        self.generic_visit(node)
-
-    def visit_Name(self, node):
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-    def visit_ExceptHandler(self, node):
-        if node.type is None:
-            self.findings.append(
-                (self.path, node.lineno, "E722", "bare `except:`"))
-        self.generic_visit(node)
-
-    def _check_defaults(self, node):
-        for d in list(node.args.defaults) + [
-                d for d in node.args.kw_defaults if d is not None]:
-            if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                self.findings.append(
-                    (self.path, d.lineno, "B006",
-                     "mutable default argument"))
-
-    def visit_FunctionDef(self, node):
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def visit_AsyncFunctionDef(self, node):
-        self._check_defaults(node)
-        self.generic_visit(node)
-
-    def visit_Compare(self, node):
-        for op, cmp in zip(node.ops, node.comparators):
-            if isinstance(op, (ast.Eq, ast.NotEq)):
-                if isinstance(cmp, ast.Constant) and cmp.value is None:
-                    self.findings.append(
-                        (self.path, node.lineno, "E711",
-                         "comparison to None with ==/!= (use is/is not)"))
-        self.generic_visit(node)
-
-    def visit_Call(self, node):
-        self._check_metric_labels(node)
-        self.generic_visit(node)
-
-    def _check_metric_labels(self, node):
-        """M001: registry.counter/gauge/histogram(labels=(...)) label
-        names must come from the bounded-cardinality allowlist."""
-        # package-path test by parts, so absolute paths (pre-commit
-        # hooks, IDEs) don't silently disable the gate
-        if _M001_PREFIX not in Path(self.path).parts:
-            return
-        fn = node.func
-        if not (isinstance(fn, ast.Attribute)
-                and fn.attr in _METRIC_FACTORIES):
-            return
-        # M002 side channel: record the family name (literal first arg)
-        if (self.metric_families is not None and node.args
-                and isinstance(node.args[0], ast.Constant)
-                and isinstance(node.args[0].value, str)
-                and node.args[0].value.startswith("authz_")):
-            self.metric_families[node.args[0].value] = (
-                self.path, node.lineno)
-        label_values = [kw.value for kw in node.keywords
-                        if kw.arg == "labels"]
-        # labels is also the third positional parameter of
-        # counter/gauge/histogram — positional call sites must not
-        # bypass the gate
-        if len(node.args) >= 3:
-            label_values.append(node.args[2])
-        for value in label_values:
-            if not isinstance(value, (ast.Tuple, ast.List)):
-                self.findings.append(
-                    (self.path, node.lineno, "M001",
-                     "metric labels must be a literal tuple/list so the "
-                     "cardinality gate can verify the names"))
-                continue
-            for el in value.elts:
-                if not (isinstance(el, ast.Constant)
-                        and isinstance(el.value, str)):
-                    self.findings.append(
-                        (self.path, el.lineno, "M001",
-                         "metric label name must be a string literal"))
-                    continue
-                if el.value not in ALLOWED_METRIC_LABELS:
-                    self.findings.append(
-                        (self.path, el.lineno, "M001",
-                         f"metric label {el.value!r} is not in the "
-                         f"bounded-cardinality allowlist "
-                         f"(identities belong in audit events, not "
-                         f"metric labels)"))
-
-
-def lint_file(path, findings, metric_families=None):
-    text = path.read_text()
-    try:
-        tree = ast.parse(text, filename=str(path))
-    except SyntaxError as e:
-        findings.append((path, e.lineno or 0, "E999", f"syntax error: {e}"))
-        return
-    v = Visitor(findings, path, metric_families=metric_families)
-    v.visit(tree)
-
-    # unused imports: names imported at module scope and never loaded
-    # anywhere in the file (conservative: attribute/string uses of the
-    # name are caught by the Load-name scan; __all__ and re-exports in
-    # __init__.py are exempt)
-    src_names = v.used
-    exempt = path.name == "__init__.py" or "__all__" in text
-    if not exempt:
-        for name, lineno in v.imports.items():
-            if name not in src_names and f"{name}." not in text:
-                findings.append((path, lineno, "F401",
-                                 f"unused import `{name}`"))
-
-    # top-level redefinitions
-    seen: dict = {}
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.ClassDef)):
-            if node.name in seen:
-                findings.append((path, node.lineno, "F811",
-                                 f"redefinition of `{node.name}` "
-                                 f"(first at line {seen[node.name]})"))
-            seen[node.name] = node.lineno
-
-    # M003 applies to the kernel/dispatch layer (ops/ inside the
-    # package) — the only files that carry hotpath fences today; the
-    # parts-based test keeps absolute-path invocations honest
-    m003 = ("ops" in Path(path).parts
-            and _M001_PREFIX in Path(path).parts)
-    in_hotpath = False
-    hotpath_open_line = 0
-    for i, line in enumerate(text.splitlines(), 1):
-        if line != line.rstrip():
-            findings.append((path, i, "W291", "trailing whitespace"))
-        if len(line) > MAX_LINE:
-            findings.append((path, i, "E501",
-                             f"line too long ({len(line)} > {MAX_LINE})"))
-        stripped = line.lstrip(" ")
-        if stripped.startswith("\t"):
-            findings.append((path, i, "TAB", "hard tab in indentation"))
-        if not m003:
-            continue
-        if _HOTPATH_BEGIN in line:
-            if in_hotpath:
-                findings.append((path, i, "M003",
-                                 f"nested hotpath fence (previous begin "
-                                 f"at line {hotpath_open_line} never "
-                                 f"ended)"))
-            in_hotpath, hotpath_open_line = True, i
-            continue
-        if _HOTPATH_END in line:
-            in_hotpath = False
-            continue
-        if not in_hotpath:
-            continue
-        code_part = line.split("#", 1)[0]
-        if _M003_NP.search(code_part):
-            findings.append((path, i, "M003",
-                             "host numpy (`np.`) inside a device hot-path "
-                             "fence — per-batch staging belongs on device "
-                             "(jnp) or outside the fence; this is the "
-                             "host-pack regression the device-resident "
-                             "pipeline removed"))
-        if _M003_LOOP.match(code_part):
-            findings.append((path, i, "M003",
-                             "per-item Python loop inside a device "
-                             "hot-path fence — batch it on device or move "
-                             "it outside the fence"))
-    if m003 and in_hotpath:
-        findings.append((path, hotpath_open_line, "M003",
-                         "hotpath fence never closed "
-                         "(`# hotpath: end` missing)"))
-
-
-def _is_dynamic_family(name):
-    return any(name == p or name.startswith(p + "_")
-               for p in _DYNAMIC_METRIC_PREFIXES)
-
-
-def check_metric_drift(metric_families, findings):
-    """M002: the docs/observability.md metric catalog and the families
-    package code actually registers must agree, both directions."""
-    if not _METRICS_DOC.exists():
-        findings.append((_METRICS_DOC, 0, "M002",
-                         "metrics doc missing (docs/observability.md)"))
-        return
-    text = _METRICS_DOC.read_text()
-    doc_names: dict = {}  # name -> first line number
-    for i, line in enumerate(text.splitlines(), 1):
-        for match in re.finditer(r"authz_[a-z0-9][a-z0-9_]*", line):
-            doc_names.setdefault(match.group(0).rstrip("_"), i)
-    for name, (path, lineno) in sorted(metric_families.items()):
-        if _is_dynamic_family(name):
-            continue
-        if name not in doc_names:
-            findings.append((path, lineno, "M002",
-                             f"metric family {name!r} is registered here "
-                             f"but absent from {_METRICS_DOC} — document "
-                             f"it (operators cannot use what the catalog "
-                             f"does not name)"))
-    code_names = set(metric_families)
-    for name, lineno in sorted(doc_names.items()):
-        if _is_dynamic_family(name):
-            continue
-        # histogram exposition suffixes in doc prose refer to a real
-        # family (authz_foo_seconds_bucket -> authz_foo_seconds)
-        base = re.sub(r"_(bucket|sum|count)$", "", name)
-        if name not in code_names and base not in code_names:
-            findings.append((_METRICS_DOC, lineno, "M002",
-                             f"doc names metric family {name!r} but no "
-                             f"package code registers it — a renamed or "
-                             f"removed metric leaves dashboards reading "
-                             f"zeros"))
+from analysis.legacy_lint import run_legacy  # noqa: E402
 
 
 def main():
-    paths = sys.argv[1:] or DEFAULT_PATHS
-    default_run = not sys.argv[1:]
-    findings: list = []
-    metric_families: dict = {}
-    n = 0
-    for f in iter_py(paths):
-        n += 1
-        lint_file(f, findings, metric_families=metric_families)
-    # M002 needs the FULL package scan to know every registered family;
-    # partial-path invocations (pre-commit on one file) skip it
-    if default_run:
-        check_metric_drift(metric_families, findings)
-    for path, lineno, code, msg in sorted(findings,
-                                          key=lambda x: (str(x[0]), x[1])):
-        print(f"{path}:{lineno}: {code} {msg}")
+    findings, n = run_legacy(sys.argv[1:] or None)
+    for f in findings:
+        print(f"{f.path}:{f.line}: {f.rule} {f.message}")
     print(f"lint: {n} files, {len(findings)} findings")
     return 1 if findings else 0
 

@@ -30,6 +30,8 @@ import time
 import urllib.error
 import urllib.request
 
+import yaml
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCHEMA = """
@@ -207,7 +209,38 @@ def main() -> int:
             [sys.executable, os.path.abspath(__file__), "--role", "leader",
              "--port", str(lp), "--data-dir", os.path.join(tmp, "leader"),
              "--kube", kube_url], env=env))
+
+        # the leader's schema + rules must pass the Cedar-style static
+        # lint (docs/static-analysis.md SL-rules): a leader shipping a
+        # statically-broken schema would replicate that brokenness to
+        # every follower.  Run it overlapped with leader startup.
+        boot_path = os.path.join(tmp, "lint-bootstrap.yaml")
+        rules_path = os.path.join(tmp, "lint-rules.yaml")
+        with open(boot_path, "w") as f:
+            yaml.safe_dump({"schema": SCHEMA}, f)
+        with open(rules_path, "w") as f:
+            f.write(RULES)
+        lint_proc = subprocess.Popen(
+            [sys.executable, "-m", "spicedb_kubeapi_proxy_tpu",
+             "--lint-schema", "--lint-schema-json",
+             "--spicedb-bootstrap", boot_path, "--rule-config", rules_path],
+            env=env, stdout=subprocess.PIPE, text=True)
+        # in procs so the finally reaper gets it if wait_ready or the
+        # communicate timeout below raises first (kill on an already-
+        # exited child is a caught OSError)
+        procs.append(lint_proc)
+
         wait_ready(leader_url, 30.0)
+
+        print("== leader schema/rules pass --lint-schema")
+        lint_out, _ = lint_proc.communicate(timeout=60)
+        assert lint_proc.returncode == 0, (
+            f"leader schema failed --lint-schema "
+            f"(exit {lint_proc.returncode}):\n{lint_out}")
+        lint = json.loads(lint_out)
+        assert lint["summary"]["errors"] == 0, lint
+        print(f"   lint clean: {lint['summary']['warnings']} warnings, "
+              f"0 errors")
         procs.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--role", "follower",
              "--port", str(fp), "--leader", leader_url, "--kube", kube_url],

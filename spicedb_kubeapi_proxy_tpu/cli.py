@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
 import signal
 import ssl
@@ -176,6 +177,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "fails on warnings")
     p.add_argument("--lint-schema-strict", action="store_true",
                    help="with --lint-schema, exit 1 on warnings too")
+    p.add_argument("--lint-schema-json", action="store_true",
+                   help="with --lint-schema, emit machine-readable JSON "
+                        "(the scripts/analyze.py driver consumes this); "
+                        "same exit-code contract: 0 clean, 1 findings, "
+                        "2 inputs would not boot")
 
     # upstream cluster (options.go:203-206)
     p.add_argument("--backend-kubeconfig", default="",
@@ -678,17 +684,33 @@ def run_schema_lint(args: argparse.Namespace) -> int:
         rule_configs = (proxyrule.parse_file(args.rule_config)
                         if args.rule_config else [])
     except Exception as e:
+        if args.lint_schema_json:
+            print(json.dumps({"version": 1, "error": str(e),
+                              "findings": []}))
         print(f"error: {e}", file=sys.stderr)
         return 2
     findings = schema_lint.lint_schema(schema, rule_configs)
-    for f in findings:
-        print(f"{f.severity.upper()} {f.code} [{f.where}] {f.message}")
     errors = [f for f in findings if f.severity == "error"]
     warnings = [f for f in findings if f.severity != "error"]
-    print(f"schema lint: {len(errors)} errors, {len(warnings)} warnings")
-    if errors or (warnings and args.lint_schema_strict):
-        return 1
-    return 0
+    failed = bool(errors or (warnings and args.lint_schema_strict))
+    if args.lint_schema_json:
+        # the exact shape scripts/analyze.py --all consumes; exit-code
+        # contract shared with the driver (0 clean, 1 findings, 2 boot
+        # failure)
+        print(json.dumps({
+            "version": 1,
+            "findings": [{"code": f.code, "severity": f.severity,
+                          "where": f.where, "message": f.message}
+                         for f in findings],
+            "summary": {"errors": len(errors), "warnings": len(warnings),
+                        "strict": bool(args.lint_schema_strict)},
+        }, indent=1))
+    else:
+        for f in findings:
+            print(f"{f.severity.upper()} {f.code} [{f.where}] {f.message}")
+        print(f"schema lint: {len(errors)} errors, "
+              f"{len(warnings)} warnings")
+    return 1 if failed else 0
 
 
 def main(argv: Optional[list] = None) -> int:

@@ -2722,11 +2722,19 @@ class JaxEndpoint(PermissionsEndpoint):
 
     async def write_relationships(self, updates: Iterable[RelationshipUpdate],
                                   preconditions: Iterable[Precondition] = ()) -> int:
-        return self.store.write(self._validate_updates(updates), preconditions)
+        # commits journal synchronously (WAL append + fsync) before
+        # visibility — a disk barrier that must never park the event
+        # loop (analyzer A001 class).  _off_loop carries the request
+        # context across the hop like every other store-touching verb;
+        # the store lock keeps commit semantics identical.
+        ups = self._validate_updates(updates)
+        return await self._off_loop(self.store.write, ups,
+                                    list(preconditions))
 
     async def delete_relationships(self, flt: RelationshipFilter,
                                    preconditions: Iterable[Precondition] = ()) -> int:
-        rev, _ = self.store.delete_by_filter(flt, preconditions)
+        rev, _ = await self._off_loop(self.store.delete_by_filter, flt,
+                                      list(preconditions))
         return rev
 
     def watch(self, object_types: Optional[Iterable[str]] = None) -> Watcher:

@@ -55,10 +55,19 @@ async def run(args, ready_cb=None) -> None:
     endpoint = create_endpoint(args.spicedb_endpoint, bootstrap=bootstrap)
     tls_cert = tls_key = None
     if args.tls_cert_file and args.tls_key_file:
-        with open(args.tls_cert_file, "rb") as f:
-            tls_cert = f.read()
-        with open(args.tls_key_file, "rb") as f:
-            tls_key = f.read()
+        # key material loads off-loop (analyzer A001): startup shares
+        # this loop with ready_cb-driven embedders, so even here sync
+        # file I/O is hopped rather than excused
+        loop = asyncio.get_running_loop()
+
+        def _read_bytes(path):
+            with open(path, "rb") as f:
+                return f.read()
+
+        tls_cert = await loop.run_in_executor(
+            None, _read_bytes, args.tls_cert_file)
+        tls_key = await loop.run_in_executor(
+            None, _read_bytes, args.tls_key_file)
     server = PermissionsGrpcServer(endpoint, token=args.spicedb_token,
                                    tls_cert=tls_cert, tls_key=tls_key)
     port = await server.start(args.listen_address)

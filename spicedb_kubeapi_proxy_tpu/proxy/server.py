@@ -562,9 +562,9 @@ class ProxyServer:
         if path == "/replication/manifest":
             return await hub.serve_manifest(req)
         if path.startswith("/replication/segment/"):
-            return hub.serve_segment(req, path.rsplit("/", 1)[1])
+            return await hub.serve_segment(req, path.rsplit("/", 1)[1])
         if path.startswith("/replication/checkpoint/"):
-            return hub.serve_checkpoint(req, path.rsplit("/", 1)[1])
+            return await hub.serve_checkpoint(req, path.rsplit("/", 1)[1])
         return json_response(404, {
             "kind": "Status", "apiVersion": "v1", "metadata": {},
             "status": "Failure", "reason": "NotFound", "code": 404,

@@ -210,7 +210,9 @@ class DecisionCache:
                             for rk, v in e.epochs))
 
 
-class DecisionCacheEndpoint(PermissionsEndpoint):
+# gate-off = this wrapper is never constructed (create_endpoint checks
+# the DecisionCache gate/flag), so its call sites need no re-check
+class DecisionCacheEndpoint(PermissionsEndpoint):  # noqa: A004(built behind gate)
     """Decision-cache layer wrapping a store-backed endpoint (the wrapper
     sits ABOVE the cross-request dispatcher: a hit never enqueues, so a
     warm repeat-list skips device dispatch entirely; misses flow through

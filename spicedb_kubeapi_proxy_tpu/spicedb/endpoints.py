@@ -15,6 +15,7 @@ consumes (SURVEY.md §5) — and never know which backend ran.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from dataclasses import dataclass
 from typing import Any, Iterable, Optional
@@ -273,37 +274,79 @@ class EmbeddedEndpoint(PermissionsEndpoint):
                  2: Permissionship.HAS_PERMISSION}
 
     def _check_sync(self, req: CheckRequest) -> CheckResult:
-        value = self.evaluator.check3(req.resource, req.permission,
-                                      req.subject)
-        return CheckResult(
-            permissionship=self._TRISTATE[value],
-            checked_at=self.store.revision,
-            source="oracle",
-        )
+        # evaluation + the checked_at revision read are ONE atomic unit
+        # under the store lock (reentrant, so the bulk wrapper's outer
+        # hold still gives one revision per bulk): writes commit from
+        # executor threads now, and an unlocked revision read could
+        # stamp a verdict with a revision the evaluation never saw —
+        # a replica honoring that ZedToken would serve it as fresh
+        with self.store.lock:
+            value = self.evaluator.check3(req.resource, req.permission,
+                                          req.subject)
+            return CheckResult(
+                permissionship=self._TRISTATE[value],
+                checked_at=self.store.revision,
+                source="oracle",
+            )
+
+    def _check_bulk_sync(self, reqs: list) -> list:
+        # one revision per bulk: writes commit from executor threads
+        # (see write_relationships below), so the bulk snapshots under
+        # the store lock — the same no-torn-bulk contract the jax
+        # endpoint keeps with its capture lock
+        with self.store.lock:
+            return [self._check_sync(r) for r in reqs]
+
+    def _lookup_sync(self, resource_type: str, permission: str,
+                     subject: SubjectRef) -> list:
+        # the oracle lookup enumerates candidates and checks each; a
+        # write landing mid-enumeration would yield a result correct at
+        # NO single revision — hold the lock for the whole pass (the
+        # pre-executor behavior, where loop serialization implied it)
+        with self.store.lock:
+            return self.evaluator.lookup_resources(resource_type,
+                                                   permission, subject)
+
+    # Store-touching verbs hop to an executor: the evaluator's reads
+    # contend on the store lock, which a concurrent committing writer
+    # holds ACROSS the WAL append + fsync — a loop-side acquire would
+    # park the whole loop for that disk barrier (analyzer A001 class).
 
     async def check_permission(self, req: CheckRequest) -> CheckResult:
-        return self._check_sync(req)
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._check_sync, req)
 
     async def check_bulk_permissions(self, reqs: list) -> list:
-        return [self._check_sync(r) for r in reqs]
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._check_bulk_sync, reqs)
 
     async def lookup_resources(self, resource_type: str, permission: str,
                                subject: SubjectRef) -> list:
-        return AnnotatedIds(
-            self.evaluator.lookup_resources(resource_type, permission,
-                                            subject),
-            source="oracle")
+        ids = await asyncio.get_running_loop().run_in_executor(
+            None, self._lookup_sync, resource_type, permission, subject)
+        return AnnotatedIds(ids, source="oracle")
 
     async def read_relationships(self, flt: RelationshipFilter) -> list:
-        return self.store.read(flt)
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.store.read, flt)
 
     async def write_relationships(self, updates: Iterable[RelationshipUpdate],
                                   preconditions: Iterable[Precondition] = ()) -> int:
-        return self.store.write(self._validate_updates(updates), preconditions)
+        # the commit path journals synchronously (WAL append + fsync
+        # under the durable store's policy) before becoming visible —
+        # a disk barrier that must never park the event loop (analyzer
+        # A001 class); the store lock serializes against every reader,
+        # so the hop changes where the write blocks, not what it means
+        ups = self._validate_updates(updates)
+        pres = list(preconditions)
+        return await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.store.write(ups, pres))
 
     async def delete_relationships(self, flt: RelationshipFilter,
                                    preconditions: Iterable[Precondition] = ()) -> int:
-        rev, _ = self.store.delete_by_filter(flt, preconditions)
+        pres = list(preconditions)
+        rev, _ = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.store.delete_by_filter(flt, pres))
         return rev
 
     def watch(self, object_types: Optional[Iterable[str]] = None) -> Watcher:

@@ -91,7 +91,9 @@ class PersistenceUnavailableError(RuntimeError):
     restart re-derives the truth from the log."""
 
 
-class PersistenceManager:
+# gate-off = no manager exists (the server requires --data-dir AND the
+# DurableStore gate before constructing one): nothing journals or counts
+class PersistenceManager:  # noqa: A004(built behind gate)
     """Segmented WAL + periodic columnar checkpoints over one data dir."""
 
     def __init__(self, data_dir: str,

@@ -56,7 +56,9 @@ class ReplicationProtocolError(Exception):
     (revision gap, damaged frame, reclaimed artifact): re-bootstrap."""
 
 
-class ReplicaFollower:
+# gate-off = no follower exists (the server requires --replicate-from
+# AND the Replication gate before constructing one)
+class ReplicaFollower:  # noqa: A004(built behind gate)
     """Tails one leader's replication API into a live TupleStore."""
 
     def __init__(self, store: TupleStore, transport,
@@ -192,10 +194,35 @@ class ReplicaFollower:
                 f"{kind} {name!r} fetch failed: HTTP {resp.status}")
         return resp.body
 
+    async def _spool_npz(self, body: bytes, prefix: str):
+        """Spool fetched artifact bytes to a temp file and parse the
+        columnar npz OFF the event loop (analyzer A001): a 1M-tuple
+        checkpoint or bulk-load sidecar is tens of MB, and this loop is
+        also serving every read on the replica — only the store
+        adoption (already serialized by the store lock) stays on it.
+        Returns (snap, overlay, meta) from load_columnar_file."""
+        from ..persist import checkpoint as ckpt
+
+        def _spool_and_parse():
+            import tempfile
+            import os
+            fd, path = tempfile.mkstemp(suffix=".npz", prefix=prefix)
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(body)
+                return ckpt.load_columnar_file(path)
+            finally:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, _spool_and_parse)
+
     # -- bootstrap -----------------------------------------------------------
 
     async def _bootstrap(self, man: dict) -> None:
-        from ..persist import checkpoint as ckpt
         cp = man.get("checkpoint")
         if cp is None:
             if self.store.revision > 0:
@@ -208,18 +235,8 @@ class ReplicaFollower:
         else:
             body = await self._fetch_artifact("checkpoint", cp["checkpoint"])
             self._applied_bytes.inc(len(body), kind="checkpoint")
-            import tempfile
-            import os
-            fd, path = tempfile.mkstemp(suffix=".npz", prefix="replica-ckpt-")
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    f.write(body)
-                snap, overlay, meta = ckpt.load_columnar_file(path)
-            finally:
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+            snap, overlay, _meta = await self._spool_npz(body,
+                                                         "replica-ckpt-")
             self.store.replica_reset(snap if len(snap) else None, overlay,
                                      int(cp["revision"]))
             watermark = int(cp.get("watermark", 0))
@@ -266,22 +283,10 @@ class ReplicaFollower:
             self.store.apply_replica_batch(updates)
             self.stats["applied_updates"] += len(updates)
         elif kind == "s":
-            from ..persist import checkpoint as ckpt
-            import tempfile
-            import os
             body = await self._fetch_artifact("segment", rec["f"])
             self._applied_bytes.inc(len(body), kind="sidecar")
-            fd, path = tempfile.mkstemp(suffix=".npz",
-                                        prefix="replica-snap-")
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    f.write(body)
-                snap, _overlay, _meta = ckpt.load_columnar_file(path)
-            finally:
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
+            snap, _overlay, _meta = await self._spool_npz(body,
+                                                          "replica-snap-")
             self.store.bulk_load_snapshot(snap)
         elif kind == "b":
             self.store.bulk_load(

@@ -54,7 +54,9 @@ def safe_artifact_name(name: str) -> bool:
     return bool(_SAFE_NAME.match(name))
 
 
-class ReplicationHub:
+# gate-off = no hub exists (the server 503s /replication/* without
+# constructing/attaching one), so nothing here can tick
+class ReplicationHub:  # noqa: A004(built behind gate)
     """Publishes one PersistenceManager's data dir to followers."""
 
     def __init__(self, store: TupleStore, persistence,
@@ -208,7 +210,7 @@ class ReplicationHub:
 
     # -- artifact bytes ------------------------------------------------------
 
-    def _serve_file(self, req, path: str, kind: str) -> "Response":
+    async def _serve_file(self, req, path: str, kind: str) -> "Response":
         from ...proxy.httpcore import Response, json_response
         params = parse_qs(urlsplit(req.target).query)
         offset = 0
@@ -225,12 +227,22 @@ class ReplicationHub:
             return json_response(400, {
                 "kind": "Status", "apiVersion": "v1", "metadata": {},
                 "status": "Failure", "code": 400, "message": str(e)})
-        try:
+
+        def _read():
+            # a sealed segment is up to segment_bytes and a checkpoint
+            # tens of MB — reading it synchronously would park the
+            # leader's event loop (which is also serving live traffic)
+            # for the whole disk read, once per follower fetch
+            # (analyzer A001 class); the read runs on an executor thread
             size = os.path.getsize(path)
             with open(path, "rb") as f:
                 if offset:
                     f.seek(offset)
-                body = f.read()
+                return size, f.read()
+
+        try:
+            size, body = await asyncio.get_running_loop().run_in_executor(
+                None, _read)
         except OSError:
             return json_response(404, {
                 "kind": "Status", "apiVersion": "v1", "metadata": {},
@@ -246,24 +258,24 @@ class ReplicationHub:
         resp.headers.set("X-Replication-Size", str(size))
         return resp
 
-    def serve_segment(self, req, name: str) -> "Response":
+    async def serve_segment(self, req, name: str) -> "Response":
         from ...proxy.httpcore import json_response
         if not safe_artifact_name(name) or name.startswith("ckpt-"):
             return json_response(400, {
                 "kind": "Status", "apiVersion": "v1", "metadata": {},
                 "status": "Failure", "code": 400,
                 "message": f"invalid segment name {name!r}"})
-        return self._serve_file(
+        return await self._serve_file(
             req, os.path.join(self.persistence.wal.dir, name), "segment")
 
-    def serve_checkpoint(self, req, name: str) -> "Response":
+    async def serve_checkpoint(self, req, name: str) -> "Response":
         from ...proxy.httpcore import json_response
         if not safe_artifact_name(name) or not name.startswith("ckpt-"):
             return json_response(400, {
                 "kind": "Status", "apiVersion": "v1", "metadata": {},
                 "status": "Failure", "code": 400,
                 "message": f"invalid checkpoint name {name!r}"})
-        return self._serve_file(
+        return await self._serve_file(
             req, os.path.join(self.persistence.ckpt_dir, name), "checkpoint")
 
     def snapshot(self) -> dict:

@@ -108,6 +108,12 @@ _QUEUE_LIMIT.set(0.0)
 
 
 def note_rejected(reason: str) -> None:
+    """Count one admission rejection.  Gate-guarded here as well as at
+    every caller: with the AdmissionControl killswitch off NOTHING may
+    reject, so a counter tick from a stale caller would be a lie to the
+    operator reading the overload dashboard (analyzer rule A004)."""
+    if not enabled():
+        return
     _REJECTED.inc(reason=reason)
 
 

@@ -1,0 +1,34 @@
+"""A001 near-misses: the same calls, correctly hopped or out of scope."""
+import asyncio
+import time
+
+
+async def hops_via_executor(loop, wal):
+    # the blocking call sits in a NESTED sync scope handed to the
+    # executor — exactly the legal pattern
+    def _flush():
+        time.sleep(0.1)
+        wal.fsync()
+
+    await loop.run_in_executor(None, _flush)
+
+
+async def hops_via_lambda(loop, fd):
+    import os
+    await loop.run_in_executor(None, lambda: os.fsync(fd))
+
+
+async def passes_reference(loop, wal):
+    # a bare reference is not a call
+    await loop.run_in_executor(None, wal.fsync_if_dirty)
+
+
+def sync_helper_can_block(path):
+    # not an async def: blocking here is the executor's business
+    time.sleep(0.1)
+    with open(path) as f:
+        return f.read()
+
+
+async def to_thread_hop(wal):
+    await asyncio.to_thread(wal.fsync)

@@ -1,0 +1,40 @@
+"""A001 true positives: blocking calls lexically inside async defs."""
+import asyncio
+import os
+import subprocess
+import time
+
+import numpy as np
+
+
+async def sleeps_on_loop():
+    time.sleep(0.5)                      # A001
+
+
+async def fsyncs_on_loop(fd):
+    os.fsync(fd)                         # A001
+
+
+async def shells_on_loop():
+    subprocess.run(["true"])             # A001
+
+
+async def materializes_on_loop(device_result):
+    return np.asarray(device_result)     # A001
+
+
+async def syncs_device(result):
+    result.block_until_ready()           # A001
+
+
+async def opens_on_loop(path):
+    with open(path) as f:                # A001
+        return f.read()
+
+
+async def wal_flush(self):
+    self.wal.fsync_if_dirty()            # A001 (method tail)
+
+
+async def legit_async_sleep():
+    await asyncio.sleep(0.1)             # fine

@@ -1,0 +1,30 @@
+"""A002 near-misses: the task reference is kept (or consumed)."""
+import asyncio
+
+
+async def work():
+    pass
+
+
+async def stored(self):
+    self._task = asyncio.create_task(work())
+
+
+async def awaited():
+    await asyncio.create_task(work())
+
+
+async def tracked(tasks):
+    tasks.append(asyncio.ensure_future(work()))
+
+
+async def gathered():
+    return await asyncio.gather(asyncio.create_task(work()))
+
+
+async def returned():
+    return asyncio.ensure_future(work())
+
+
+async def chained_receiver_stored(self):
+    self._t = asyncio.get_running_loop().create_task(work())

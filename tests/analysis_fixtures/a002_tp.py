@@ -1,0 +1,24 @@
+"""A002 true positives: spawned tasks dropped on the floor (the PR 2
+GC-hang class — the loop holds tasks weakly)."""
+import asyncio
+
+
+async def work():
+    pass
+
+
+async def fire_and_forget():
+    asyncio.create_task(work())          # A002
+
+
+async def fire_and_forget_ensure():
+    asyncio.ensure_future(work())        # A002
+
+
+async def loop_spawn_dropped():
+    loop = asyncio.get_running_loop()
+    loop.create_task(work())             # A002
+
+
+async def chained_receiver_dropped():
+    asyncio.get_running_loop().create_task(work())   # A002

@@ -1,0 +1,14 @@
+"""A003 true positive: await while holding a SYNC lock — the critical
+section spans an arbitrary suspension (the shedder-snapshot deadlock
+shape)."""
+import asyncio
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._gauge_lock = threading.Lock()
+
+    async def flush(self):
+        with self._gauge_lock:
+            await asyncio.sleep(0.1)      # A003: await under sync lock

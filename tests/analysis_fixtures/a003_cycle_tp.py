@@ -1,0 +1,33 @@
+"""A003 true positive: ABBA lock-order cycle across two methods."""
+import threading
+
+
+class Shedder:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+        self._window_lock = threading.Lock()
+
+    def snapshot(self):
+        with self._stats_lock:
+            with self._window_lock:       # stats -> window
+                return 1
+
+    def rotate(self):
+        with self._window_lock:
+            with self._stats_lock:        # window -> stats: A003 cycle
+                return 2
+
+
+class MultiItem:
+    def __init__(self):
+        self._ledger_lock = threading.Lock()
+        self._gauge_lock = threading.Lock()
+
+    def both_at_once(self):
+        with self._ledger_lock, self._gauge_lock:   # ledger -> gauge
+            return 1
+
+    def nested_reversed(self):
+        with self._gauge_lock:
+            with self._ledger_lock:                 # gauge -> ledger: cycle
+                return 2

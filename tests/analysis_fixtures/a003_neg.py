@@ -1,0 +1,36 @@
+"""A003 near-misses: consistent order, reentrant re-entry, async locks."""
+import asyncio
+import threading
+
+
+class Consistent:
+    def __init__(self):
+        self._store_lock = threading.RLock()
+        self._gauge_lock = threading.Lock()
+
+    def commit(self):
+        with self._store_lock:
+            with self._gauge_lock:        # store -> gauge everywhere
+                return 1
+
+    def checkpoint(self):
+        with self._store_lock:
+            with self._gauge_lock:        # same order: no cycle
+                return 2
+
+    def reenter(self):
+        with self._store_lock:
+            self._inner()                  # RLock re-entry is legal
+
+    def _inner(self):
+        with self._store_lock:
+            pass
+
+
+class AsyncSide:
+    def __init__(self):
+        self._alock = asyncio.Lock()
+
+    async def guarded(self):
+        async with self._alock:
+            await asyncio.sleep(0)        # async lock: awaiting is fine

@@ -1,0 +1,17 @@
+"""A003 true positive: non-reentrant lock re-acquired through a
+one-level call while already held (the PR 5 finalizer-under-ledger-lock
+shape)."""
+import threading
+
+
+class Pool:
+    def __init__(self):
+        self._pool_lock = threading.Lock()   # NOT an RLock
+
+    def retire(self):
+        with self._pool_lock:
+            self._compact()                  # A003: callee re-locks
+
+    def _compact(self):
+        with self._pool_lock:
+            pass

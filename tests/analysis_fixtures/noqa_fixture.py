@@ -1,0 +1,15 @@
+"""Suppression fixture: reasons are REQUIRED — a bare code is itself a
+finding (A000)."""
+import time
+
+
+async def suppressed_with_reason():
+    time.sleep(0.01)  # noqa: A001(startup-only path, loop not serving yet)
+
+
+async def suppressed_without_reason():
+    time.sleep(0.01)  # noqa: A001
+
+
+async def wrong_code_suppression():
+    time.sleep(0.01)  # noqa: A002(wrong rule named, finding survives)

@@ -1,0 +1,32 @@
+"""A005 near-misses: static unrolls, dtype descriptors, device work,
+and host helpers NOT reachable from any jit site."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_staging(batch):
+    # never reached from a jit root: host numpy here is the normal
+    # encode path, not a traced-function regression
+    return np.zeros((len(batch),), np.int32)
+
+
+def build():
+    def run(q, idx, expr):
+        acc = q
+        for k in range(1, idx.shape[1]):  # shape: trace-time constant
+            acc = acc | idx[:, k]
+        for child in expr.children:       # static pytree structure
+            acc = acc | child
+        seed = jnp.uint32(0)
+        mask = np.uint32(7)               # dtype scalar: whitelisted
+        return acc + seed + mask
+
+    return jax.jit(run)
+
+
+def plain_helper(batch):
+    # undecorated and unreached: free to loop on the host
+    while len(batch):
+        batch = batch[1:]
+    return batch

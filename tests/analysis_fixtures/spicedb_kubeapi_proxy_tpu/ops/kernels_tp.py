@@ -1,0 +1,41 @@
+"""A005 true positives (fixture mirrors an ops/ module): host work and
+traced-dim loops inside functions REACHED from jax.jit sites —
+including through the factory idiom (`evaluate = make_evaluate(...)`)
+that defeats fence-based linting."""
+import datetime
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_evaluate(n):
+    def evaluate(x):
+        host = np.zeros((n,))             # A005: host np in traced fn
+        return x + jnp.asarray(host)
+
+    return evaluate
+
+
+def build(n):
+    evaluate = make_evaluate(n)
+
+    def run(q, width):
+        x = evaluate(q)                   # factory-resolved reach
+        stamp = time.time()               # A005: trace-time clock
+        when = datetime.datetime.now()    # A005: trace-time clock
+        total = x.sum().item()            # A005: forced materialization
+        i = 0
+        while i < width:                  # A005: while over traced param
+            i += 1
+        for _ in q:                       # A005: for over traced param
+            pass
+        return total, stamp
+
+    return jax.jit(run)
+
+
+@jax.jit
+def decorated_kernel(x):
+    return x + np.arange(4)               # A005: host np, decorator root

@@ -1,0 +1,24 @@
+"""A004 true positives (fixture mirrors a gated-module path: this file
+"is" utils/admission.py, an AdmissionControl-gated module)."""
+
+_REJECTED = object()
+_WINDOW = []
+_LIMIT = 0
+
+
+def note_rejected(reason):
+    _REJECTED.inc(reason=reason)          # A004: no gate check
+
+
+def remember(decision):
+    _WINDOW.append(decision)              # A004: module registry append
+
+
+def set_limit(n):
+    global _LIMIT
+    _LIMIT = n                            # A004: module global rebound
+
+
+def bump():
+    global _LIMIT
+    _LIMIT += 1                           # A004: augmented rebind

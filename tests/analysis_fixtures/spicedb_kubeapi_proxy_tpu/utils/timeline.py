@@ -1,0 +1,39 @@
+"""A004 near-misses (fixture mirrors utils/timeline.py, a
+Timeline-gated module): every mutation is dominated by a gate check,
+reached only from gated callers, or belongs to a declared
+constructed-behind-gate class."""
+
+_EVENTS = []
+
+
+def enabled():
+    return True
+
+
+def record(stage):
+    if not enabled():
+        return
+    _EVENTS.append(stage)                 # gated: early-return guard
+
+
+def observe(hist, v):
+    if enabled():
+        hist.observe(v)                   # gated: if-wrapped
+
+
+def flush():
+    if not enabled():
+        return
+    _drain()
+
+
+def _drain():
+    # private helper: every same-module caller (flush) gate-checks
+    # before calling, so the one-level closure clears it
+    _EVENTS.append("drain")
+
+
+# wrapper only constructed when its gate is on (see create_endpoint)
+class GatedRecorder:  # noqa: A004(built behind gate)
+    def tick(self, counter):
+        counter.inc()

@@ -1,0 +1,325 @@
+"""Unified static analyzer (scripts/analysis, scripts/analyze.py;
+docs/static-analysis.md).
+
+Every rule is tested against the fixture corpus in
+tests/analysis_fixtures/ — one true-positive and one near-miss negative
+per rule — plus the driver-level machinery: `# noqa: AXXX(reason)`
+suppression (reason REQUIRED), the checked-in baseline round-trip
+(add -> grandfather -> fix -> baseline shrinks), exit codes, JSON
+output, the thin scripts/lint.py wrapper, and a self-run asserting the
+package itself is clean modulo the checked-in baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPTS = REPO / "scripts"
+FIXTURES = REPO / "tests" / "analysis_fixtures"
+sys.path.insert(0, str(SCRIPTS))
+
+from analysis import core  # noqa: E402
+from analysis.rules_async import rule_a001, rule_a002  # noqa: E402
+from analysis.rules_gates import rule_a004  # noqa: E402
+from analysis.rules_jit import rule_a005  # noqa: E402
+from analysis.rules_locks import rule_a003  # noqa: E402
+
+
+def load(*names):
+    files = [FIXTURES / n for n in names]
+    sources, errors = core.load_sources(files, REPO)
+    assert not errors, errors
+    assert len(sources) == len(files)
+    return sources
+
+
+def lines(findings, rule=None):
+    return sorted(f.line for f in findings
+                  if rule is None or f.rule == rule)
+
+
+class TestA001:
+    def test_true_positives(self):
+        findings = rule_a001(load("a001_tp.py"))
+        assert lines(findings) == [11, 15, 19, 23, 27, 31, 36]
+        assert all(f.rule == "A001" for f in findings)
+        # each distinct blocking family is named in its message
+        msgs = " | ".join(f.message for f in findings)
+        for needle in ("time.sleep", "os.fsync", "subprocess.run",
+                       "np.asarray", "block_until_ready", "open()",
+                       "fsync"):
+            assert needle in msgs, needle
+
+    def test_near_misses(self):
+        # executor/to_thread hops, bare references, and sync helpers
+        # are all legal
+        assert rule_a001(load("a001_neg.py")) == []
+
+
+class TestA002:
+    def test_true_positives(self):
+        findings = rule_a002(load("a002_tp.py"))
+        assert lines(findings) == [11, 15, 20, 24]
+        assert all(f.rule == "A002" for f in findings)
+        # the chained-receiver form has no resolvable name chain but
+        # names the method in its message all the same
+        assert any("create_task" in f.message
+                   for f in findings if f.line == 24)
+
+    def test_near_misses(self):
+        # stored / awaited / appended / gathered / returned all keep a
+        # reference
+        assert rule_a002(load("a002_neg.py")) == []
+
+
+class TestA003:
+    def test_abba_cycle(self):
+        findings = rule_a003(load("a003_cycle_tp.py"))
+        assert len(findings) == 2
+        assert all("lock-order cycle" in f.message for f in findings)
+        msgs = " | ".join(f.message for f in findings)
+        assert "_stats_lock" in msgs and "_window_lock" in msgs
+        # the second cycle's first leg is a MULTI-ITEM `with a, b:` —
+        # items must edge left-to-right like the nested form
+        assert "_ledger_lock" in msgs and "_gauge_lock" in msgs
+
+    def test_await_under_sync_lock(self):
+        findings = rule_a003(load("a003_await_tp.py"))
+        assert len(findings) == 1
+        assert "`await` while holding sync lock" in findings[0].message
+        assert findings[0].symbol == "Ledger.flush"
+
+    def test_self_deadlock_via_call_closure(self):
+        findings = rule_a003(load("a003_selfdeadlock_tp.py"))
+        assert len(findings) == 1
+        assert "self-deadlock" in findings[0].message
+        assert "non-reentrant" in findings[0].message
+
+    def test_near_misses(self):
+        # consistent order, RLock re-entry, async-lock awaits
+        assert rule_a003(load("a003_neg.py")) == []
+
+
+class TestA004:
+    TP = "spicedb_kubeapi_proxy_tpu/utils/admission.py"
+    NEG = "spicedb_kubeapi_proxy_tpu/utils/timeline.py"
+
+    def test_true_positives(self):
+        findings = rule_a004(load(self.TP))
+        # 24 = `_LIMIT += 1` (AugAssign counter idiom)
+        assert lines(findings) == [10, 14, 19, 24]
+        kinds = " | ".join(f.message for f in findings)
+        assert "metric mutation" in kinds
+        assert "module registry" in kinds
+        assert "module global" in kinds
+        assert all("AdmissionControl" in f.message for f in findings)
+
+    def test_near_misses(self):
+        # early-return guard, if-wrapped, gated-caller closure, and the
+        # class-level `# noqa: A004(...)` constructed-behind-gate
+        # declaration
+        assert rule_a004(load(self.NEG)) == []
+
+    def test_ungated_module_ignored(self):
+        # the same shapes outside a gated module are not A004's business
+        assert rule_a004(load("a001_tp.py")) == []
+
+
+class TestA005:
+    TP = "spicedb_kubeapi_proxy_tpu/ops/kernels_tp.py"
+    NEG = "spicedb_kubeapi_proxy_tpu/ops/kernels_neg.py"
+
+    def test_true_positives(self):
+        findings = rule_a005(load(self.TP))
+        assert lines(findings) == [15, 26, 27, 28, 30, 32, 41]
+        msgs = " | ".join(f.message for f in findings)
+        assert "np.zeros" in msgs          # via factory-returned closure
+        assert "time.time" in msgs
+        assert "datetime.datetime.now" in msgs
+        assert ".item()" in msgs
+        assert "while" in msgs and "for" in msgs
+        # the @jax.jit DECORATOR form is a root too, not just the
+        # jax.jit(fn) call form
+        assert any(f.symbol == "decorated_kernel" for f in findings)
+
+    def test_factory_reach(self):
+        # the host-np finding sits inside the closure the factory
+        # returned — reached through `evaluate = make_evaluate(...)`,
+        # which no comment fence could see
+        findings = rule_a005(load(self.TP))
+        assert any(f.symbol == "make_evaluate.evaluate" for f in findings)
+
+    def test_near_misses(self):
+        # shape-range unrolls, static pytree iteration, dtype scalars,
+        # and unreached host helpers
+        assert rule_a005(load(self.NEG)) == []
+
+
+class TestSuppression:
+    def test_noqa_reason_required(self):
+        sources = load("noqa_fixture.py")
+        kept, suppressed = core.apply_noqa(rule_a001(sources), sources)
+        # line 7: suppressed with reason; line 11: bare noqa -> A000;
+        # line 15: wrong code named -> original finding survives
+        assert [s.finding.line for s in suppressed] == [7]
+        assert suppressed[0].reason.startswith("startup-only")
+        assert sorted((f.rule, f.line) for f in kept) == [
+            ("A000", 11), ("A001", 15)]
+
+    def test_a000_names_the_rule(self):
+        sources = load("noqa_fixture.py")
+        kept, _ = core.apply_noqa(rule_a001(sources), sources)
+        a000 = [f for f in kept if f.rule == "A000"][0]
+        assert "A001" in a000.message
+
+
+class TestBaseline:
+    def _findings(self):
+        return rule_a001(load("a001_tp.py"))
+
+    def test_round_trip_and_shrink(self, tmp_path):
+        findings = self._findings()
+        bl_path = tmp_path / "baseline.json"
+        core.Baseline.write(bl_path, findings)
+        bl = core.Baseline(bl_path)
+        new, baselined, stale = bl.filter(findings)
+        assert new == [] and len(baselined) == len(findings)
+        assert stale == []
+        # "fix" two findings: they surface as stale entries, and a
+        # rewrite shrinks the file
+        fixed = findings[2:]
+        new, baselined, stale = core.Baseline(bl_path).filter(fixed)
+        assert new == [] and len(stale) == 2
+        core.Baseline.write(bl_path, fixed)
+        assert len(json.loads(bl_path.read_text())["findings"]) == \
+            len(findings) - 2
+
+    def test_multiplicity_consumed(self, tmp_path):
+        findings = self._findings()
+        # baseline knows ONE instance; a duplicate finding stays new
+        bl_path = tmp_path / "baseline.json"
+        core.Baseline.write(bl_path, findings[:1])
+        dup = core.Finding(findings[0].rule, findings[0].path,
+                           findings[0].line + 50, findings[0].message,
+                           findings[0].symbol)
+        new, baselined, _ = core.Baseline(bl_path).filter(
+            [findings[0], dup])
+        assert len(baselined) == 1 and len(new) == 1
+
+    def test_line_drift_does_not_invalidate(self, tmp_path):
+        findings = self._findings()
+        bl_path = tmp_path / "baseline.json"
+        core.Baseline.write(bl_path, findings)
+        drifted = [core.Finding(f.rule, f.path, f.line + 7, f.message,
+                                f.symbol) for f in findings]
+        new, baselined, stale = core.Baseline(bl_path).filter(drifted)
+        assert new == [] and stale == []
+
+
+def run_driver(*args):
+    return subprocess.run(
+        [sys.executable, str(SCRIPTS / "analyze.py"), *args],
+        capture_output=True, text=True, cwd=REPO)
+
+
+class TestDriverCli:
+    def test_findings_fail(self, tmp_path):
+        out = run_driver(str(FIXTURES / "a001_tp.py"),
+                         "--baseline", str(tmp_path / "b.json"))
+        assert out.returncode == 1, out.stdout
+        assert "A001" in out.stdout
+
+    def test_clean_file_passes(self, tmp_path):
+        out = run_driver(str(FIXTURES / "a001_neg.py"),
+                         "--baseline", str(tmp_path / "b.json"))
+        assert out.returncode == 0, out.stdout
+
+    def test_update_baseline_then_clean(self, tmp_path):
+        bl = tmp_path / "b.json"
+        out = run_driver(str(FIXTURES / "a001_tp.py"),
+                         "--baseline", str(bl), "--update-baseline")
+        assert out.returncode == 0, out.stdout
+        assert len(json.loads(bl.read_text())["findings"]) == 7
+        out = run_driver(str(FIXTURES / "a001_tp.py"), "--baseline",
+                         str(bl))
+        assert out.returncode == 0, out.stdout
+        assert "7 baselined" in out.stdout
+
+    def test_json_output_shape(self, tmp_path):
+        out = run_driver(str(FIXTURES / "a002_tp.py"), "--json",
+                         "--baseline", str(tmp_path / "b.json"))
+        assert out.returncode == 1
+        payload = json.loads(out.stdout)
+        assert payload["version"] == 1
+        assert {f["rule"] for f in payload["findings"]} == {"A002"}
+        assert {"rule", "path", "line", "symbol", "message"} <= set(
+            payload["findings"][0])
+
+    def test_rule_subset(self, tmp_path):
+        out = run_driver(str(FIXTURES / "a001_tp.py"), "--rules", "A002",
+                         "--baseline", str(tmp_path / "b.json"))
+        assert out.returncode == 0, out.stdout  # A001 bugs, A002 lens
+
+    def test_unknown_rule_is_usage_error(self):
+        out = run_driver("--rules", "A999")
+        assert out.returncode == 2
+
+    def test_noqa_without_reason_fails_driver(self, tmp_path):
+        out = run_driver(str(FIXTURES / "noqa_fixture.py"),
+                         "--baseline", str(tmp_path / "b.json"))
+        assert out.returncode == 1
+        assert "A000" in out.stdout
+        assert "no reason" in out.stdout
+
+    def test_self_run_package_clean_modulo_baseline(self):
+        """The acceptance gate: the package analyzes clean against the
+        CHECKED-IN baseline (the same invocation check.sh runs, minus
+        the schema subprocess)."""
+        out = run_driver("--legacy")
+        assert out.returncode == 0, out.stdout
+        assert "0 new findings" in out.stdout
+
+
+class TestLegacyWrapper:
+    def test_lint_py_contract_preserved(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("x = 1 \n")  # trailing whitespace -> W291
+        out = subprocess.run(
+            [sys.executable, str(SCRIPTS / "lint.py"), str(bad)],
+            capture_output=True, text=True, cwd=tmp_path)
+        assert out.returncode == 1
+        assert "W291" in out.stdout
+        assert "lint: 1 files, 1 findings" in out.stdout
+
+    def test_lint_py_clean_exit(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("x = 1\n")
+        out = subprocess.run(
+            [sys.executable, str(SCRIPTS / "lint.py"), str(ok)],
+            capture_output=True, text=True, cwd=tmp_path)
+        assert out.returncode == 0, out.stdout
+
+    def test_fixture_corpus_quarantined(self):
+        # the intentionally-buggy corpus must never leak into a
+        # default-path lint/analyze run
+        from analysis.legacy_lint import DEFAULT_PATHS, iter_py
+        scanned = {str(p) for p in iter_py(DEFAULT_PATHS)}
+        assert not any("analysis_fixtures" in p for p in scanned)
+
+
+class TestSchemaLintJson:
+    def test_json_contract(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "spicedb_kubeapi_proxy_tpu",
+             "--lint-schema", "--lint-schema-json"],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert payload["version"] == 1
+        assert {"errors", "warnings", "strict"} <= set(payload["summary"])
+        for f in payload["findings"]:
+            assert {"code", "severity", "where", "message"} <= set(f)

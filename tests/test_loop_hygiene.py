@@ -1,0 +1,296 @@
+"""Regression tests for the event-loop-hygiene fixes the static
+analyzer (docs/static-analysis.md) drove in this round:
+
+- A001 follower._bootstrap/_apply_record: checkpoint / bulk-sidecar
+  bytes are spooled + npz-parsed OFF the serving loop (_spool_npz);
+- A001 class, leader._serve_file: segment/checkpoint bytes are read off
+  the loop (one disk read per follower fetch used to park the leader);
+- A001 class, write path: store.write / delete_by_filter — which
+  journal through the WAL (append + fsync) BEFORE becoming visible —
+  run on an executor for both embedded:// and jax://, so a durable
+  store's disk barrier never stalls the loop;
+- embedded bulk checks snapshot under the store lock (writes now land
+  from executor threads, and a bulk must never span two revisions);
+- A004 admission.note_rejected: inert when the AdmissionControl
+  killswitch is off.
+"""
+
+import asyncio
+import threading
+
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+    Bootstrap,
+    create_endpoint,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+
+SCHEMA = """
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+"""
+
+
+def _seed():
+    return [parse_relationship(f"doc:d{i}#viewer@user:u{i % 3}")
+            for i in range(12)]
+
+
+class TestWritesOffLoop:
+    """store.write/delete_by_filter carry the WAL fsync; they must run
+    on an executor thread for every store-backed endpoint scheme."""
+
+    def _assert_write_thread(self, url):
+        ep = create_endpoint(url, Bootstrap(schema_text=SCHEMA))
+        ep.store.bulk_load(_seed())
+        inner_write = ep.store.write
+        seen = []
+
+        def spy(updates, preconditions=()):
+            seen.append(threading.current_thread())
+            return inner_write(updates, preconditions)
+
+        ep.store.write = spy
+        try:
+            async def go():
+                loop_thread = threading.current_thread()
+                rev = await ep.write_relationships([RelationshipUpdate(
+                    UpdateOp.TOUCH,
+                    parse_relationship("doc:d0#viewer@user:w"))])
+                assert rev == ep.store.revision
+                assert seen and all(t is not loop_thread for t in seen), (
+                    "store.write (WAL append + fsync) ran ON the event "
+                    "loop")
+                # read-your-writes still holds through the hop
+                res = await ep.check_permission(CheckRequest(
+                    ObjectRef("doc", "d0"), "view",
+                    SubjectRef("user", "w")))
+                assert res.allowed
+
+            asyncio.run(go())
+        finally:
+            ep.store.write = inner_write
+
+    def test_embedded_write_off_loop(self):
+        self._assert_write_thread("embedded://")
+
+    def test_jax_write_off_loop(self):
+        self._assert_write_thread("jax://")
+
+    def test_embedded_delete_off_loop(self):
+        ep = create_endpoint("embedded://", Bootstrap(schema_text=SCHEMA))
+        ep.store.bulk_load(_seed())
+        inner = ep.store.delete_by_filter
+        seen = []
+
+        def spy(flt, preconditions=()):
+            seen.append(threading.current_thread())
+            return inner(flt, preconditions)
+
+        ep.store.delete_by_filter = spy
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipFilter,
+        )
+
+        async def go():
+            loop_thread = threading.current_thread()
+            await ep.delete_relationships(
+                RelationshipFilter(resource_type="doc", resource_id="d1"))
+            assert seen and seen[0] is not loop_thread
+
+        asyncio.run(go())
+
+    def test_embedded_eval_holds_store_lock(self):
+        """With writes committing from executor threads, the single
+        check (evaluation + checked_at read) and the lookup enumeration
+        must each run UNDER the store lock — an unlocked revision read
+        could stamp a verdict with a revision the evaluation never saw,
+        and a mid-enumeration write yields a lookup correct at no
+        single revision."""
+        ep = create_endpoint("embedded://", Bootstrap(schema_text=SCHEMA))
+        ep.store.bulk_load(_seed())
+        seen = {}
+        real_check3 = ep.evaluator.check3
+        real_lookup = ep.evaluator.lookup_resources
+
+        def spy_check(*a, **k):
+            seen["check_locked"] = ep.store.lock._is_owned()
+            return real_check3(*a, **k)
+
+        def spy_lookup(*a, **k):
+            seen["lookup_locked"] = ep.store.lock._is_owned()
+            return real_lookup(*a, **k)
+
+        ep.evaluator.check3 = spy_check
+        ep.evaluator.lookup_resources = spy_lookup
+
+        async def go():
+            res = await ep.check_permission(CheckRequest(
+                ObjectRef("doc", "d0"), "view",
+                SubjectRef("user", "u0")))
+            assert res.checked_at == ep.store.revision
+            ids = await ep.lookup_resources(
+                "doc", "view", SubjectRef("user", "u0"))
+            assert "d0" in set(ids)
+
+        asyncio.run(go())
+        assert seen["check_locked"], (
+            "check3 + checked_at read ran without the store lock")
+        assert seen["lookup_locked"], (
+            "oracle lookup enumeration ran without the store lock")
+
+    def test_embedded_bulk_check_never_spans_revisions(self):
+        """Writes land from executor threads now; a bulk check must
+        still answer at ONE revision (the store-lock snapshot)."""
+        ep = create_endpoint("embedded://", Bootstrap(schema_text=SCHEMA))
+        ep.store.bulk_load(_seed())
+        stop = threading.Event()
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                ep.store.write([RelationshipUpdate(
+                    UpdateOp.TOUCH,
+                    parse_relationship(f"doc:d{i % 12}#viewer@user:c"))])
+                i += 1
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            async def go():
+                for _ in range(50):
+                    res = await ep.check_bulk_permissions([
+                        CheckRequest(ObjectRef("doc", f"d{k}"), "view",
+                                     SubjectRef("user", f"u{k % 3}"))
+                        for k in range(8)])
+                    revs = {r.checked_at for r in res}
+                    assert len(revs) == 1, (
+                        f"torn bulk check across revisions {revs}")
+                    await asyncio.sleep(0)
+
+            asyncio.run(go())
+        finally:
+            stop.set()
+            t.join()
+
+
+class TestReplicationOffLoop:
+    def test_follower_spools_npz_off_loop(self, monkeypatch, tmp_path):
+        """_spool_npz (checkpoint bootstrap + bulk-sidecar apply) must
+        write and parse the artifact on an executor thread, hand back
+        the parse result, and leave no temp file behind."""
+        import glob
+        import tempfile
+
+        from spicedb_kubeapi_proxy_tpu.spicedb.persist import (
+            checkpoint as ckpt,
+        )
+        from spicedb_kubeapi_proxy_tpu.spicedb.replication.follower import (
+            ReplicaFollower,
+        )
+        from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+        from spicedb_kubeapi_proxy_tpu.utils import metrics as m
+
+        monkeypatch.setattr(tempfile, "tempdir", str(tmp_path))
+        seen = {}
+
+        def fake_load(path):
+            seen["thread"] = threading.current_thread()
+            with open(path, "rb") as f:
+                seen["bytes"] = f.read()
+            return "SNAP", "OVERLAY", {"revision": 7}
+
+        monkeypatch.setattr(ckpt, "load_columnar_file", fake_load)
+        follower = ReplicaFollower(TupleStore(), transport=None,
+                                   registry=m.Registry())
+
+        async def go():
+            loop_thread = threading.current_thread()
+            out = await follower._spool_npz(b"artifact-bytes", "t-")
+            assert out == ("SNAP", "OVERLAY", {"revision": 7})
+            assert seen["bytes"] == b"artifact-bytes"
+            assert seen["thread"] is not loop_thread, (
+                "checkpoint spool+parse ran ON the replica's serving "
+                "loop")
+
+        asyncio.run(go())
+        assert glob.glob(str(tmp_path / "t-*")) == [], (
+            "temp spool file leaked")
+
+    def test_leader_serves_artifact_bytes_off_loop(self, monkeypatch,
+                                                   tmp_path):
+        import os
+
+        from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+            Headers,
+            Request,
+        )
+        from spicedb_kubeapi_proxy_tpu.spicedb.replication.leader import (
+            ReplicationHub,
+        )
+        from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+        from spicedb_kubeapi_proxy_tpu.utils import metrics as m
+
+        seg = tmp_path / "seg-00000001.wal"
+        seg.write_bytes(b"0123456789abcdef")
+        seen = {}
+        real_getsize = os.path.getsize
+
+        def spy_getsize(path):
+            if str(path) == str(seg):
+                seen["thread"] = threading.current_thread()
+            return real_getsize(path)
+
+        monkeypatch.setattr(os.path, "getsize", spy_getsize)
+        hub = ReplicationHub(TupleStore(), persistence=None,
+                             registry=m.Registry())
+
+        async def go():
+            loop_thread = threading.current_thread()
+            req = Request(method="GET",
+                          target="/replication/segment/seg-00000001.wal",
+                          headers=Headers())
+            resp = await hub._serve_file(req, str(seg), "segment")
+            assert resp.status == 200
+            assert resp.body == b"0123456789abcdef"
+            assert seen["thread"] is not loop_thread, (
+                "artifact disk read ran ON the leader's serving loop")
+            # offset serving still works through the executor hop
+            req2 = Request(
+                method="GET",
+                target="/replication/segment/seg-00000001.wal?offset=10",
+                headers=Headers())
+            resp2 = await hub._serve_file(req2, str(seg), "segment")
+            assert resp2.status == 206
+            assert resp2.body == b"abcdef"
+
+        asyncio.run(go())
+
+
+class TestAdmissionGateHygiene:
+    def test_note_rejected_inert_when_gate_off(self):
+        from spicedb_kubeapi_proxy_tpu.utils import admission
+        from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+
+        before = admission._REJECTED.value(reason="queue_limit")
+        GATES.set("AdmissionControl", False)
+        try:
+            admission.note_rejected("queue_limit")
+            assert admission._REJECTED.value(
+                reason="queue_limit") == before, (
+                "killswitch off must mean inert: no rejection counter "
+                "ticks (analyzer A004)")
+        finally:
+            GATES.set("AdmissionControl", True)
+        admission.note_rejected("queue_limit")
+        assert admission._REJECTED.value(
+            reason="queue_limit") == before + 1
