@@ -25,6 +25,9 @@ from .core import attr_chain
 
 # package-relative path fragment -> gate name (utils/features.py)
 GATED_MODULES = (
+    # the directory fragment covers the whole replication subsystem:
+    # leader.py, follower.py, AND the failover layer (failover.py —
+    # promotion/fencing/fan-out) all ride the `Replication` gate
     ("spicedb/replication/", "Replication"),
     ("utils/admission.py", "AdmissionControl"),
     ("utils/timeline.py", "Timeline"),

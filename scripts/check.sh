@@ -55,12 +55,16 @@ echo "== crash-recovery smoke (kill -9 mid write-churn, restart, parity)"
 # uninterrupted host-oracle replay (fast, deterministic, no jax import)
 python scripts/crash_smoke.py
 
-echo "== replication smoke (leader + follower over localhost, kill -9)"
-# WAL-shipping read replicas (docs/replication.md): write through the
-# leader, assert the follower serves the filtered list within the lag
-# bound, kill -9 the leader, assert bounded-staleness reads keep
-# flowing with a degraded-but-200 /readyz (fast, embedded endpoint,
-# no jax on the serving path)
+echo "== replication smoke (leader + follower over localhost, kill -9,"
+echo "   promote, old leader rejoins as follower)"
+# WAL-shipping read replicas + failover (docs/replication.md): write
+# through the leader, assert the follower serves the filtered list
+# within the lag bound, kill -9 the leader, assert bounded-staleness
+# reads keep flowing with a degraded-but-200 /readyz; then promote the
+# follower (new incarnation), land a write locally with the pre-kill
+# write still readable (zero lost), resurrect the old leader and
+# assert the startup fence probe demotes it into a forwarding follower
+# (fast, embedded endpoint, no jax on the serving path)
 JAX_PLATFORMS=cpu python scripts/replication_smoke.py
 
 echo "== device-telemetry smoke (/metrics + /debug/flight + /debug/timeline)"

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Replication smoke for scripts/check.sh (ISSUE 9).
+"""Replication smoke for scripts/check.sh (ISSUE 9 + ISSUE 11 failover).
 
 Three REAL processes over localhost HTTP:
 
@@ -12,7 +12,15 @@ Three REAL processes over localhost HTTP:
      the lag bound — replicated, not forwarded;
   4. kill -9 the leader;
   5. assert the follower keeps serving bounded-staleness reads, reports
-     degraded (still 200) /readyz, and rejects writes 503.
+     degraded (still 200) /readyz, and rejects writes 503;
+  6. POST /replication/promote: the follower becomes the leader (new
+     incarnation), takes a dual-write LOCALLY, and the pre-kill write
+     is still readable (zero lost acknowledged writes);
+  7. resurrect the OLD leader over its old data dir with the new
+     leader as a peer: the startup fence probe demotes it into a
+     follower — it serves both writes (replicated from the new leader)
+     and forwards new writes to the new leader.  Exactly one writable
+     leader after the partition heals.
 
 No jax import on the serving path (embedded endpoint): runs in seconds.
 """
@@ -71,7 +79,7 @@ LAG_BOUND_S = 8.0
 
 
 def serve(role: str, port: int, data_dir: str, leader_url: str,
-          kube_url: str) -> None:
+          kube_url: str, peers: str = "") -> None:
     """Child process: the shared fake kube-apiserver, or one proxy
     serving plain HTTP with header authn in front of it."""
     import asyncio
@@ -118,16 +126,26 @@ def serve(role: str, port: int, data_dir: str, leader_url: str,
     if role == "leader":
         opts.data_dir = data_dir
         opts.wal_fsync = "never"
+        if peers:
+            # a (possibly resurrected) leader probes its peers for a
+            # newer incarnation at startup and demotes itself instead
+            # of split-braining (docs/replication.md "Failover runbook")
+            opts.replica_peers = [p for p in peers.split(",") if p]
     else:
         opts.replicate_from = leader_url
         opts.replica_user = "system:replica"
+        if data_dir:
+            # the data dir this follower will own if promoted
+            opts.promote_data_dir = data_dir
 
     async def run():
         proxy = ProxyServer(opts)
-        if role == "leader":
+        if role == "leader" and proxy.endpoint.store.revision == 0:
             proxy.endpoint.store.bulk_load([parse_relationship(
                 "namespace:team-a#creator@user:alice")])
-            proxy.enable_dual_writes()
+        # dual writes on every role: a follower forwards them until it
+        # is promoted, then serves them locally
+        proxy.enable_dual_writes()
         await proxy.start("127.0.0.1", port)
         print(f"{role} serving on {port}", flush=True)
         await asyncio.Event().wait()
@@ -146,10 +164,13 @@ def free_port() -> int:
     return port
 
 
-def http(method: str, url: str, user: str = "", body=None, timeout=5.0):
+def http(method: str, url: str, user: str = "", body=None, timeout=5.0,
+         groups=()):
     headers = {"Accept": "application/json"}
     if user:
         headers["X-Remote-User"] = user
+    for g in groups:
+        headers["X-Remote-Group"] = g
     data = None
     if body is not None:
         data = json.dumps(body).encode()
@@ -188,9 +209,11 @@ def main() -> int:
     ap.add_argument("--data-dir", default="")
     ap.add_argument("--leader", default="")
     ap.add_argument("--kube", default="")
+    ap.add_argument("--peers", default="")
     args = ap.parse_args()
     if args.role:
-        serve(args.role, args.port, args.data_dir, args.leader, args.kube)
+        serve(args.role, args.port, args.data_dir, args.leader, args.kube,
+              peers=args.peers)
         return 0
 
     tmp = tempfile.mkdtemp(prefix="repl-smoke-")
@@ -243,7 +266,8 @@ def main() -> int:
               f"0 errors")
         procs.append(subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--role", "follower",
-             "--port", str(fp), "--leader", leader_url, "--kube", kube_url],
+             "--port", str(fp), "--leader", leader_url, "--kube", kube_url,
+             "--data-dir", os.path.join(tmp, "follower-promote")],
             env=env))
         wait_ready(follower_url, 30.0)  # 503 until checkpoint adoption
 
@@ -298,6 +322,98 @@ def main() -> int:
             "POST", follower_url + "/api/v1/namespaces/team-a/pods", "alice",
             body={"metadata": {"name": "p2", "namespace": "team-a"}})
         assert status == 503, (status, body)
+
+        print("== promote the follower (POST /replication/promote)")
+        # promotion is privileged: plain principals get 403
+        status, _, body = http(
+            "POST", follower_url + "/replication/promote", "mallory",
+            body={})
+        assert status == 403, (status, body)
+        status, _, body = http(
+            "POST", follower_url + "/replication/promote", "admin",
+            body={}, groups=["system:masters"])
+        assert status == 200, (status, body)
+        promo = json.loads(body)
+        assert promo["incarnation"] >= 3, promo  # promotion mint
+        status, _, body = http(
+            "GET", follower_url + "/replication/status", "admin")
+        assert status == 200 and json.loads(body)["role"] == "leader", body
+        print(f"   promoted: incarnation {promo['incarnation']} at "
+              f"revision {promo['revision']}")
+
+        print("== dual-write lands LOCALLY on the promoted leader")
+        status, headers, body = http(
+            "POST", follower_url + "/api/v1/namespaces/team-a/pods", "alice",
+            body={"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "post-failover-pod",
+                               "namespace": "team-a"}})
+        assert status in (200, 201), (status, body)
+        assert headers.get("X-Authz-Forwarded-To") != "leader", \
+            "promoted leader must serve writes itself"
+        assert int(headers.get("X-Authz-Revision", "0")) > promo["revision"]
+
+        print("== zero lost: the pre-kill write is readable post-failover")
+        status, _, body = http(
+            "GET", follower_url + "/api/v1/namespaces/team-a/pods", "alice")
+        names = [i["metadata"]["name"]
+                 for i in json.loads(body).get("items", [])]
+        assert status == 200 and "smoke-pod" in names, (status, names)
+        assert "post-failover-pod" in names, names
+
+        print("== resurrect the old leader; fence probe demotes it")
+        olp = free_port()
+        old_leader_url = f"http://127.0.0.1:{olp}"
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role", "leader",
+             "--port", str(olp), "--data-dir", os.path.join(tmp, "leader"),
+             "--kube", kube_url, "--peers", follower_url], env=env))
+        t0 = time.time()
+        while True:
+            try:
+                status, _, body = http(
+                    "GET", old_leader_url + "/replication/status", "admin",
+                    timeout=2.0)
+                if status == 200 and json.loads(body)["role"] == "follower":
+                    break
+            except OSError:
+                pass
+            if time.time() - t0 > 45.0:
+                raise AssertionError(
+                    f"old leader did not rejoin as follower (last: "
+                    f"{body!r})")
+            time.sleep(0.2)
+        print(f"   rejoined as follower in {time.time() - t0:.2f}s")
+
+        print("== the rejoined ex-leader serves BOTH writes (replicated)")
+        t0 = time.time()
+        while True:
+            status, headers, body = http(
+                "GET", old_leader_url + "/api/v1/namespaces/team-a/pods",
+                "alice")
+            names = [i["metadata"]["name"]
+                     for i in json.loads(body).get("items", [])]
+            if (status == 200 and "smoke-pod" in names
+                    and "post-failover-pod" in names):
+                assert headers.get("X-Authz-Forwarded-To") != "leader"
+                break
+            if time.time() - t0 > LAG_BOUND_S:
+                raise AssertionError(
+                    f"rejoined follower missing writes: {status} {names}")
+            time.sleep(0.1)
+
+        print("== exactly one writable leader: ex-leader forwards writes")
+        status, headers, body = http(
+            "POST", old_leader_url + "/api/v1/namespaces/team-a/pods",
+            "alice",
+            body={"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "healed-pod",
+                               "namespace": "team-a"}})
+        assert status in (200, 201), (status, body)
+        assert headers.get("X-Authz-Forwarded-To") == "leader", headers
+        status, _, body = http(
+            "GET", follower_url + "/api/v1/namespaces/team-a/pods", "alice")
+        assert "healed-pod" in [i["metadata"]["name"]
+                                for i in json.loads(body)["items"]]
 
         print("replication_smoke: ALL GREEN")
         return 0

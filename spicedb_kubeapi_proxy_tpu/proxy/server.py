@@ -17,6 +17,7 @@ import contextvars
 import dataclasses
 import json
 import logging
+import os
 import ssl
 import time
 from dataclasses import dataclass, field
@@ -218,6 +219,30 @@ class Options:
     # transport seam to the leader (tests inject an in-process
     # HandlerTransport); None = H11Transport(replicate_from)
     leader_transport: Optional[Transport] = None
+    # replication fault tolerance (spicedb/replication/failover.py,
+    # docs/replication.md "Failover runbook").  serve_replication: this
+    # FOLLOWER also serves /replication/* from a byte mirror of what it
+    # applies, so further followers chain off it (fan-out trees)
+    # instead of NIC-saturating the leader.  promote_data_dir: the data
+    # dir this follower will own if promoted to leader (required for
+    # /replication/promote and --promote-on-leader-loss).
+    # promote_on_leader_loss: watchdog that detects a dead upstream and
+    # runs the election (highest adopted revision wins, ties break on
+    # smallest replica id) against replica_peers.  replica_peers: base
+    # URLs of the other proxies in the fleet — election candidates for
+    # a follower, fence probes for a (possibly resurrected) leader.
+    serve_replication: bool = False
+    mirror_dir: str = ""  # "" with serve_replication => private tempdir
+    promote_data_dir: str = ""
+    promote_on_leader_loss: bool = False
+    leader_loss_grace_s: float = 5.0
+    replica_peers: list = field(default_factory=list)
+    # test seam: url -> Transport used for peer status probes and
+    # repoints; unlisted peers dial real HTTP
+    peer_transports: Optional[dict] = None
+    # stable identity in elections and /replication/status (minted per
+    # process when empty); the election tie-break orders on it
+    replica_id: str = ""
 
 
 class ProxyServer:
@@ -256,7 +281,17 @@ class ProxyServer:
         # proxy is exactly single-node.
         self.replication = None        # ReplicaFollower (follower mode)
         self.replication_hub = None    # ReplicationHub (leader mode)
+        self.fanout_hub = None         # FanoutHub (follower fan-out)
         self._leader_transport: Optional[Transport] = None
+        # failover machinery (spicedb/replication/failover.py)
+        self._watchdog = None          # LeaderLossWatchdog (follower)
+        self._fence_monitor = None     # FenceMonitor (leader)
+        self._promote_lock = asyncio.Lock()
+        self._peer_transport_cache: dict = {}
+        import uuid as _uuid
+        self.replica_id = (opts.replica_id
+                           or f"replica-{os.getpid()}"
+                              f"-{_uuid.uuid4().hex[:8]}")
         if self.persistence is not None and repl.enabled():
             # leader: publish the data dir; attach AFTER the persistence
             # manager so the WAL append precedes every long-poll wakeup
@@ -287,7 +322,19 @@ class ProxyServer:
                                       or H11Transport(opts.replicate_from))
             self.replication = repl.ReplicaFollower(
                 store, self._leader_transport,
-                identity=opts.replica_user)
+                identity=opts.replica_user,
+                replica_id=self.replica_id,
+                upstream_url=opts.replicate_from)
+            if opts.serve_replication:
+                # fan-out tree: this follower also serves /replication/*
+                # from a byte mirror of what it applies, so further
+                # followers chain off it (docs/replication.md)
+                import tempfile
+                from ..spicedb.replication import failover as replfo
+                mirror = (opts.mirror_dir or tempfile.mkdtemp(
+                    prefix="authz-replication-mirror-"))
+                self.fanout_hub = replfo.FanoutHub(self.replication,
+                                                   mirror)
         elif opts.replicate_from:
             logger.info("--replicate-from %r set but the Replication gate "
                         "is disabled; running single-node",
@@ -381,10 +428,13 @@ class ProxyServer:
             burning_fn=(lambda: self.flight.burning()
                         if self.flight is not None else []),
             # a stale replica sheds reads before serving garbage
-            # (docs/replication.md "Staleness contract")
+            # (docs/replication.md "Staleness contract"); routed through
+            # self.replication at call time so a promoted follower
+            # (replication -> None) stops shedding on a frozen lag
             shed_lag_s=(opts.shed_replica_lag_s
                         if self.replication is not None else 0.0),
-            lag_fn=(self.replication.lag_seconds
+            lag_fn=((lambda: self.replication.lag_seconds()
+                     if self.replication is not None else 0.0)
                     if self.replication is not None else None))
         # off-loop rebuilds prewarm their candidate generations when
         # compile prewarm is on, so a post-swap first request recompiles
@@ -535,9 +585,18 @@ class ProxyServer:
 
     def _debug_replication(self) -> dict:
         if self.replication_hub is not None:
-            return self.replication_hub.snapshot()
+            out = self.replication_hub.snapshot()
+            if self._fence_monitor is not None:
+                out["fence_monitor"] = dict(self._fence_monitor.stats)
+            return out
         if self.replication is not None:
-            return self.replication.snapshot()
+            out = self.replication.snapshot()
+            if self.fanout_hub is not None:
+                out["fanout"] = self.fanout_hub.snapshot()
+            if self._watchdog is not None:
+                out["watchdog"] = dict(self._watchdog.stats,
+                                       grace_s=self._watchdog.grace_s)
+            return out
         from ..spicedb import replication as repl
         return {"enabled": False,
                 "reason": ("Replication feature gate disabled"
@@ -548,8 +607,15 @@ class ProxyServer:
     # -- replication serving (spicedb/replication) ---------------------------
 
     async def _serve_replication(self, req: Request) -> Response:
-        """Leader-side replication API (authenticated, like /metrics)."""
-        if self.replication_hub is None:
+        """Replication API (authenticated, like /metrics): manifest /
+        segment / checkpoint bytes from the leader hub or a follower's
+        fan-out hub, plus the failover control surface (status /
+        promote / rejoin).  A proxy with no replication role at all —
+        including the Replication gate off — answers 503 exactly as a
+        single-node proxy always has."""
+        path = req.path
+        if (self.replication_hub is None and self.fanout_hub is None
+                and self.replication is None):
             return json_response(503, {
                 "kind": "Status", "apiVersion": "v1", "metadata": {},
                 "status": "Failure", "code": 503,
@@ -557,8 +623,21 @@ class ProxyServer:
                 "message": "replication is not served here: this proxy "
                            "has no durable data dir (--data-dir) or is "
                            "itself a follower"})
-        hub = self.replication_hub
-        path = req.path
+        if path == "/replication/status":
+            return json_response(200, self._replication_status())
+        if path == "/replication/promote":
+            return await self._serve_promote(req)
+        if path == "/replication/rejoin":
+            return await self._serve_rejoin(req)
+        hub = self.replication_hub or self.fanout_hub
+        if hub is None:
+            return json_response(503, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 503,
+                "reason": "ServiceUnavailable",
+                "message": "replication artifacts are not served here: "
+                           "this follower runs without "
+                           "--serve-replication"})
         if path == "/replication/manifest":
             return await hub.serve_manifest(req)
         if path.startswith("/replication/segment/"):
@@ -570,7 +649,161 @@ class ProxyServer:
             "status": "Failure", "reason": "NotFound", "code": 404,
             "message": f"unknown replication endpoint {path!r}; use "
                        f"/replication/manifest, /replication/segment/"
-                       f"<name>, /replication/checkpoint/<name>"})
+                       f"<name>, /replication/checkpoint/<name>, "
+                       f"/replication/status, /replication/promote, "
+                       f"/replication/rejoin"})
+
+    def _replication_status(self) -> dict:
+        """Election / fence-probe surface: role, incarnation, revision."""
+        if self.replication_hub is not None:
+            hub = self.replication_hub
+            return {"role": "leader", "replica_id": self.replica_id,
+                    "leader_id": hub.leader_id,
+                    "incarnation": hub.incarnation,
+                    "revision": hub.store.revision,
+                    "fenced_by": hub.fenced_by}
+        r = self.replication
+        if r is not None:
+            return {"role": "follower", "replica_id": r.replica_id,
+                    "leader_id": r.max_leader_id or r.leader_id,
+                    "incarnation": r.max_incarnation,
+                    "revision": r.store.revision,
+                    "state": r.state,
+                    "upstream": self.opts.replicate_from,
+                    "serves_replication": self.fanout_hub is not None,
+                    "fenced_by": None}
+        return {"role": "single"}  # pragma: no cover - guarded above
+
+    def _replication_privileged(self, req: Request) -> Optional[Response]:
+        """The mutating failover control endpoints (promote / rejoin)
+        change who takes writes or write tuples directly — unlike the
+        read-only artifact/status surfaces (any authenticated principal,
+        same trust level as /metrics), they require the replication
+        identity (--replica-user) or system:masters.  None = allowed."""
+        user = req.context.get("user")
+        if (user is not None
+                and (user.name == self.opts.replica_user
+                     or "system:masters" in (user.groups or ()))):
+            return None
+        return json_response(403, {
+            "kind": "Status", "apiVersion": "v1", "metadata": {},
+            "status": "Failure", "reason": "Forbidden", "code": 403,
+            "message": f"replication control endpoints require the "
+                       f"replication identity "
+                       f"({self.opts.replica_user!r}) or membership in "
+                       f"system:masters"})
+
+    async def _serve_promote(self, req: Request) -> Response:
+        """POST /replication/promote: promote this follower to leader
+        (spicedb/replication/failover.py)."""
+        denied = self._replication_privileged(req)
+        if denied is not None:
+            return denied
+        if req.method != "POST":
+            return json_response(405, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 405,
+                "message": "promotion is POST /replication/promote"})
+        from ..spicedb.replication import failover as replfo
+        try:
+            info = await replfo.promote_follower(self)
+        except replfo.PromotionError as e:
+            return json_response(e.status, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": e.status,
+                "reason": ("Conflict" if e.status == 409
+                           else "ServiceUnavailable"),
+                "message": str(e)})
+        return json_response(200, info)
+
+    async def _serve_rejoin(self, req: Request) -> Response:
+        """POST /replication/rejoin: a re-joining ex-leader replays its
+        unshipped WAL tail as a batch of TOUCH/DELETE updates.  Applied
+        through the normal store write path: journaled, watched, and
+        shipped onward to this leader's own followers."""
+        denied = self._replication_privileged(req)
+        if denied is not None:
+            return denied
+        hub = self.replication_hub
+        if hub is None:
+            return json_response(503, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 503,
+                "reason": "ServiceUnavailable",
+                "message": "rejoin is served by the leader"})
+        if hub.fenced_by is not None:
+            return json_response(409, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 409, "reason": "Conflict",
+                "message": "this leader is itself fenced by incarnation "
+                           f"{hub.fenced_by['incarnation']}; rejoin "
+                           f"against the newer leader"})
+        if req.method != "POST":
+            return json_response(405, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 405,
+                "message": "rejoin is POST /replication/rejoin"})
+        from ..spicedb.store import WriteLimitExceededError
+        from ..spicedb.types import (
+            RelationshipUpdate,
+            UpdateOp,
+            parse_relationship,
+        )
+        try:
+            body = json.loads(req.body or b"{}")
+            updates = [
+                RelationshipUpdate(
+                    UpdateOp.DELETE if op == "d" else UpdateOp.TOUCH,
+                    parse_relationship(s))
+                for op, s in body["updates"]]
+        except (KeyError, TypeError, ValueError) as e:
+            return json_response(400, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 400,
+                "message": f"invalid rejoin payload: {e}"})
+        if not updates:
+            return json_response(200, {"applied": 0,
+                                       "revision": hub.store.revision})
+        try:
+            # the store write journals (WAL append + fsync policy): off
+            # the serving loop like every other store-touching write
+            rev = await asyncio.get_running_loop().run_in_executor(
+                None, hub.store.write, updates)
+        except WriteLimitExceededError as e:
+            return json_response(400, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 400, "message": str(e)})
+        logger.info("rejoin replay from %s: %d update(s) at revision %d",
+                    body.get("from_leader_id", "?"), len(updates), rev)
+        return json_response(200, {"applied": len(updates),
+                                   "revision": rev})
+
+    def peer_transports(self) -> dict:
+        """url -> Transport for each replica_peers entry (tests inject
+        via Options.peer_transports; real deployments dial HTTP)."""
+        out = {}
+        for url in self.opts.replica_peers:
+            tr = self._peer_transport_cache.get(url)
+            if tr is None:
+                tr = (self.opts.peer_transports or {}).get(url)
+                if tr is None:
+                    from .httpcore import H11Transport
+                    tr = H11Transport(url)
+                self._peer_transport_cache[url] = tr
+            out[url] = tr
+        return out
+
+    def repoint_leader(self, url: str) -> None:
+        """Point this follower (tail + write forwarding) at a different
+        leader — the election loser's path once the winner shows up."""
+        tr = self.peer_transports().get(url)
+        if tr is None:
+            from .httpcore import H11Transport
+            tr = H11Transport(url)
+        self._leader_transport = tr
+        self.opts.replicate_from = url
+        if self.replication is not None:
+            self.replication.repoint(tr, url)
 
     def _leader_unavailable(self, message: str) -> Response:
         return json_response(503, {
@@ -620,6 +853,57 @@ class ProxyServer:
                 self.replication.stats.get("forwarded", 0) + 1)
         resp.headers.set("X-Authz-Forwarded-To", "leader")
         return resp
+
+    async def _leader_gate(self, req: Request,
+                           verb: str) -> Optional[Response]:
+        """Leader-side admission.  (1) Fencing tripwire: an ex-leader
+        that has observed a newer incarnation refuses every update verb
+        — a healed partition must converge to exactly ONE writable
+        leader; reads keep serving degraded-but-200 (bounded staleness,
+        same contract as a cut-off follower).  (2) ZedToken honoring: a
+        read carrying X-Authz-Min-Revision ahead of this leader's
+        revision — possible right after a failover adopted a lower
+        shipped revision, or on a forwarded read-after-write racing the
+        dual-write — waits like a follower would, then 503s rather than
+        answer below the token.  None = serve."""
+        from ..spicedb import replication as repl
+        from ..utils.admission import READ_ONLY_VERBS
+        hub = self.replication_hub
+        fen = hub.fenced_by
+        if fen is not None and verb not in READ_ONLY_VERBS:
+            return json_response(503, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 503,
+                "reason": "ServiceUnavailable",
+                "message": f"this leader (incarnation {hub.incarnation})"
+                           f" has been superseded by incarnation "
+                           f"{fen['incarnation']}; update verbs are "
+                           f"fenced — retry against the new leader",
+                "details": {"fencedBy": fen}})
+        raw = req.headers.get(repl.MIN_REVISION_HEADER)
+        if raw:
+            try:
+                min_rev = int(raw)
+            except ValueError:
+                return json_response(400, {
+                    "kind": "Status", "apiVersion": "v1", "metadata": {},
+                    "status": "Failure", "code": 400,
+                    "message": f"invalid {repl.MIN_REVISION_HEADER} "
+                               f"header {raw!r}: want an integer "
+                               f"revision"})
+            if min_rev > hub.store.revision:
+                if not await hub.wait_for_revision(
+                        min_rev - 1, self.opts.replica_wait_ms / 1e3):
+                    return json_response(503, {
+                        "kind": "Status", "apiVersion": "v1",
+                        "metadata": {},
+                        "status": "Failure", "code": 503,
+                        "reason": "ServiceUnavailable",
+                        "message": f"revision {min_rev} is not "
+                                   f"available on this leader (at "
+                                   f"{hub.store.revision}); the token "
+                                   f"may predate a failover"})
+        return None
 
     async def _replica_gate(self, req: Request,
                             verb: str) -> Optional[Response]:
@@ -723,6 +1007,13 @@ class ProxyServer:
                     self.shedder.retry_after_s,
                     f"request shed by admission control ({reason}); "
                     f"retry after {self.shedder.retry_after_s:.0f}s")
+            # leader mode: fenced ex-leaders refuse update verbs, and a
+            # ZedToken ahead of this leader's revision waits-or-503s
+            # instead of serving below the token
+            if self.replication_hub is not None:
+                gated = await self._leader_gate(req, verb)
+                if gated is not None:
+                    return gated
             # follower mode: update verbs forward to the leader, a read
             # whose ZedToken is ahead of the tail waits or forwards —
             # never a stale answer below its min-revision
@@ -758,6 +1049,18 @@ class ProxyServer:
                             body=b"[-] replication: bootstrapping from "
                                  b"leader (no checkpoint adopted yet)")
                     lines = ["ok"]
+                    if (self.replication_hub is not None
+                            and self.replication_hub.fenced_by
+                            is not None):
+                        # a fenced ex-leader keeps serving reads
+                        # (bounded staleness, like a cut-off follower)
+                        # but refuses every update verb: degraded, not
+                        # down
+                        fen = self.replication_hub.fenced_by
+                        lines.append(
+                            "[!] replication fenced: superseded by "
+                            f"incarnation {fen['incarnation']}; update "
+                            "verbs are refused")
                     if self.replication is not None:
                         # degraded-but-200 while catching up or cut off
                         # from the leader: bounded-staleness reads are
@@ -948,15 +1251,44 @@ class ProxyServer:
                         await loop.run_in_executor(
                             None, lambda: ctx.run(warm, prewarm=prewarm))
                 tracing.RECORDER.record(tr)
+        from ..spicedb import replication as repl_pkg
+        if (self.replication_hub is not None and self.opts.replica_peers
+                and repl_pkg.enabled()):
+            # startup fence probe BEFORE the listener opens: a
+            # resurrected ex-leader must not accept a single write the
+            # fleet won't see.  A newer incarnation among the peers
+            # demotes this process into a follower of it (with its
+            # unshipped WAL tail replayed) right here.
+            from ..spicedb.replication import failover as replfo
+            if self._fence_monitor is None:
+                self._fence_monitor = replfo.FenceMonitor(self)
+            try:
+                await self._fence_monitor.check_once()
+            except Exception:
+                logger.exception("startup fence probe failed; serving "
+                                 "anyway (header-exchange fencing still "
+                                 "guards writes)")
         self._http = HttpServer(self.handler, ssl_context=self.opts.ssl_context)
         bound = await self._http.start(host, port)
         if self.persistence is not None:
             await self.persistence.start()
+        if self._fence_monitor is not None and self.replication_hub is not None:
+            self._fence_monitor.start()
         if self.replication is not None:
             # follower tail task: bootstrap happens inside the loop so
             # serving starts immediately (/readyz stays 503 until the
             # first checkpoint adoption)
             self.replication.start()
+        if (self.replication is not None
+                and self.opts.promote_on_leader_loss
+                and repl_pkg.enabled()):
+            # leader-loss watchdog: election + self-promotion
+            # (spicedb/replication/failover.py)
+            from ..spicedb.replication import failover as replfo
+            if self._watchdog is None:
+                self._watchdog = replfo.LeaderLossWatchdog(
+                    self, grace_s=self.opts.leader_loss_grace_s)
+            self._watchdog.start()
         if self._worker is not None:
             # the worker's first drain replays dual-write instances left
             # pending by a crash — AFTER the store above was recovered,
@@ -990,8 +1322,14 @@ class ProxyServer:
             await self._lag_probe.stop()
         if self.flight is not None:
             await self.flight.stop()
+        if self._watchdog is not None:
+            await self._watchdog.stop()
+        if self._fence_monitor is not None:
+            await self._fence_monitor.stop()
         if self.replication is not None:
             await self.replication.stop()
+        if self.fanout_hub is not None:
+            self.fanout_hub.close()
         if self.replication_hub is not None:
             self.replication_hub.detach()
         if self.persistence is not None:

@@ -27,8 +27,13 @@ reads wait up to `--replica-wait-ms` and then forward to the leader
 mode are inert and the proxy is exactly single-node.
 """
 
-from .follower import ReplicaFollower
-from .leader import ReplicationHub, safe_artifact_name
+from .follower import ReplicaFollower, StaleLeaderError
+from .leader import (
+    INCARNATION_HEADER,
+    LEADER_ID_HEADER,
+    ReplicationHub,
+    safe_artifact_name,
+)
 
 MIN_REVISION_HEADER = "X-Authz-Min-Revision"
 REVISION_HEADER = "X-Authz-Revision"
@@ -45,10 +50,13 @@ def enabled() -> bool:
 
 
 __all__ = [
+    "INCARNATION_HEADER",
+    "LEADER_ID_HEADER",
     "MIN_REVISION_HEADER",
     "REVISION_HEADER",
     "ReplicaFollower",
     "ReplicationHub",
+    "StaleLeaderError",
     "enabled",
     "safe_artifact_name",
 ]

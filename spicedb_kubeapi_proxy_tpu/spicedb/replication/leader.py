@@ -6,7 +6,9 @@ surface (routes wired in proxy/server.py):
     GET /replication/manifest
         {"revision": N, "checkpoint": {...MANIFEST.json...} | null,
          "segments": [{"name", "seq", "size", "sealed"}...],
-         "sidecars": ["snap-*.npz"...], "leader_id": "..."}
+         "sidecars": ["snap-*.npz"...], "leader_id": "...",
+         "incarnation": E, "fenced": {...} | null,
+         "chain": {"path": [...], "lag_revisions": 0, "lag_seconds": 0}}
         ?wait_revision=R&timeout_ms=T long-polls until the store's
         revision EXCEEDS R (or the timeout lapses — the caller gets the
         current manifest either way and decides from `revision`).
@@ -25,11 +27,24 @@ the filesystem (no traversal).  The long-poll is fed by the store's
 commit-listener hook: the hub attaches AFTER the PersistenceManager, so
 by WAL-before-visibility ordering every revision a waiter is woken for
 is already on disk and replayable.
+
+Incarnation fencing (docs/replication.md "Failover runbook"): every hub
+owns a monotonic integer **incarnation epoch**, persisted in the data
+dir's INCARNATION file.  A restart-in-place mints `persisted + 1`; a
+promotion (failover.py) mints `max(persisted, observed) + 2` so it
+strictly dominates any later resurrection mint of the dead leader
+(which can only reach `observed + 1`).  Followers reject manifests from
+a lower epoch than the highest they have seen, and echo that highest
+epoch back on every poll (`X-Replication-Incarnation`): a resurrected
+ex-leader that receives a poll carrying a newer epoch marks itself
+`fenced_by` — the server then rejects its update verbs 503 and (with
+peers configured) demotes it into a follower of the new leader.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import os
 import re
 import threading
@@ -39,6 +54,7 @@ from typing import Optional
 from urllib.parse import parse_qs, urlsplit
 
 from ...utils import metrics as m
+from ...utils.failpoints import fail_point
 from ..store import TupleStore
 
 _SAFE_NAME = re.compile(
@@ -47,11 +63,151 @@ _SAFE_NAME = re.compile(
 DEFAULT_LONGPOLL_S = 25.0
 MAX_LONGPOLL_S = 60.0
 
+# fencing exchange headers: followers echo the highest incarnation (and
+# its leader id) they have ever observed on every /replication request
+INCARNATION_HEADER = "X-Replication-Incarnation"
+LEADER_ID_HEADER = "X-Replication-Leader-Id"
+
+INCARNATION_FILE = "INCARNATION"
+
 
 def safe_artifact_name(name: str) -> bool:
     """True when `name` is exactly one WAL segment / sidecar / checkpoint
     file name — the only paths the hub will ever read."""
     return bool(_SAFE_NAME.match(name))
+
+
+# -- incarnation epoch persistence -------------------------------------------
+
+
+def read_incarnation_state(data_dir: str) -> dict:
+    """{"epoch": int, "fenced": {...}|None, "leader_ids": [...]} from
+    the data dir's INCARNATION file; zeros when absent/damaged (a
+    damaged epoch file only costs an extra re-bootstrap downstream —
+    epochs restart conservatively low and fencing rejects them).
+    `leader_ids` is the lineage of hub ids this data dir has served
+    under — a rejoining ex-leader recognizes "the promotion superseded
+    MY log" by the new leader's `fenced.leader_id` appearing here, even
+    across its own restarts (each of which mints a fresh id)."""
+    try:
+        with open(os.path.join(data_dir, INCARNATION_FILE), "rb") as f:
+            data = json.loads(f.read())
+        if isinstance(data, dict) and isinstance(data.get("epoch"), int):
+            return {"epoch": data["epoch"], "fenced": data.get("fenced"),
+                    "leader_ids": list(data.get("leader_ids") or ())}
+    except (OSError, ValueError):
+        pass
+    return {"epoch": 0, "fenced": None, "leader_ids": []}
+
+
+def write_incarnation_state(data_dir: str, epoch: int,
+                            fenced: Optional[dict],
+                            leader_ids: Optional[list] = None) -> None:
+    path = os.path.join(data_dir, INCARNATION_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"epoch": int(epoch), "fenced": fenced,
+                   # bounded lineage: old entries can only matter while
+                   # a promotion that superseded them is still live
+                   "leader_ids": list(leader_ids or ())[-16:]}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def mint_restart_incarnation(data_dir: str, leader_id: str) -> tuple:
+    """Restart-in-place mint: persisted + 1.  Returns (epoch, fenced)
+    with any previously-recorded fenced info preserved, so a restarted
+    promoted leader keeps advertising which log it superseded (rejoining
+    ex-leaders bound their tail replay from it)."""
+    state = read_incarnation_state(data_dir)
+    epoch = state["epoch"] + 1
+    write_incarnation_state(data_dir, epoch, state["fenced"],
+                            state["leader_ids"] + [leader_id])
+    return epoch, state["fenced"]
+
+
+def mint_promotion_incarnation(data_dir: str, observed: int,
+                               fenced: Optional[dict]) -> int:
+    """Promotion mint: max(persisted, observed) + 2.  The +2 (vs the
+    restart path's +1) makes a promotion epoch strictly dominate the
+    epoch a later resurrection of the dead leader can mint (its
+    persisted value is what this follower `observed`, so it resurrects
+    at observed + 1 < observed + 2) — no tie, no split-brain."""
+    state = read_incarnation_state(data_dir)
+    epoch = max(state["epoch"], int(observed)) + 2
+    write_incarnation_state(data_dir, epoch, fenced,
+                            state["leader_ids"])
+    return epoch
+
+
+def append_leader_lineage(data_dir: str, leader_id: str) -> None:
+    """Record `leader_id` in the data dir's hub-id lineage (promotion
+    constructs its hub after minting the epoch)."""
+    state = read_incarnation_state(data_dir)
+    write_incarnation_state(data_dir, state["epoch"], state["fenced"],
+                            state["leader_ids"] + [leader_id])
+
+
+def leader_lineage(data_dir: str) -> list:
+    return read_incarnation_state(data_dir)["leader_ids"]
+
+
+# -- shared artifact byte serving --------------------------------------------
+
+
+async def serve_artifact_file(req, path: str, kind: str,
+                              shipped_counter, stats: dict) -> "Response":
+    """Serve one artifact file's bytes with offset/Range semantics —
+    shared by the leader hub and the follower fan-out hub (failover.py),
+    so intermediates serve byte-identical responses to the leader's."""
+    from ...proxy.httpcore import Response, json_response
+    params = parse_qs(urlsplit(req.target).query)
+    offset = 0
+    raw_off = (params.get("offset") or ["0"])[0]
+    range_hdr = req.headers.get("Range")
+    try:
+        offset = int(raw_off)
+        if range_hdr:
+            mm = re.match(r"^bytes=(\d+)-$", range_hdr.strip())
+            if mm is None:
+                raise ValueError(f"unsupported Range {range_hdr!r}")
+            offset = int(mm.group(1))
+    except ValueError as e:
+        return json_response(400, {
+            "kind": "Status", "apiVersion": "v1", "metadata": {},
+            "status": "Failure", "code": 400, "message": str(e)})
+
+    def _read():
+        # a sealed segment is up to segment_bytes and a checkpoint
+        # tens of MB — reading it synchronously would park the
+        # serving event loop for the whole disk read, once per
+        # follower fetch (analyzer A001 class); the read runs on an
+        # executor thread
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if offset:
+                f.seek(offset)
+            return size, f.read()
+
+    try:
+        size, body = await asyncio.get_running_loop().run_in_executor(
+            None, _read)
+    except OSError:
+        return json_response(404, {
+            "kind": "Status", "apiVersion": "v1", "metadata": {},
+            "status": "Failure", "reason": "NotFound", "code": 404,
+            "message": f"artifact {os.path.basename(path)!r} is gone "
+                       f"(reclaimed by a checkpoint?); re-bootstrap "
+                       f"from /replication/manifest"})
+    shipped_counter.inc(  # noqa: A004(only hubs built behind the gate call this)
+        len(body), kind=kind)
+    stats[f"{kind}_serves"] = stats.get(f"{kind}_serves", 0) + 1
+    resp = Response(status=206 if offset else 200, body=body)
+    resp.headers.set("Content-Type", "application/octet-stream")
+    resp.headers.set("X-Replication-Offset", str(offset))
+    resp.headers.set("X-Replication-Size", str(size))
+    return resp
 
 
 # gate-off = no hub exists (the server 503s /replication/* without
@@ -61,6 +217,8 @@ class ReplicationHub:  # noqa: A004(built behind gate)
 
     def __init__(self, store: TupleStore, persistence,
                  leader_id: str = "",
+                 incarnation: int = 0,
+                 fenced: Optional[dict] = None,
                  registry: Optional[m.Registry] = None):
         self.store = store
         self.persistence = persistence
@@ -70,6 +228,27 @@ class ReplicationHub:  # noqa: A004(built behind gate)
         # changing and re-bootstrap rather than resume its byte cursor
         self.leader_id = (leader_id
                           or f"leader-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        # monotonic fencing epoch: callers (promotion) pass an explicit
+        # epoch; a plain construction mints restart-in-place from the
+        # data dir's INCARNATION file
+        data_dir = getattr(persistence, "data_dir", None)
+        if incarnation > 0:
+            self.incarnation = int(incarnation)
+            self.fenced = fenced
+            if data_dir:
+                append_leader_lineage(data_dir, self.leader_id)
+        elif data_dir:
+            self.incarnation, persisted_fenced = mint_restart_incarnation(
+                data_dir, self.leader_id)
+            self.fenced = fenced if fenced is not None else persisted_fenced
+        else:  # persistence-less construction (unit tests)
+            self.incarnation = 1
+            self.fenced = fenced
+        # set once a /replication poll (or a peer probe) proves a newer
+        # incarnation exists: {"incarnation": E, "leader_id": id}.  The
+        # server refuses update verbs while fenced — a resurrected
+        # ex-leader must never take a write the fleet won't see.
+        self.fenced_by: Optional[dict] = None
         # (loop, future) pairs parked in wait_for_revision; woken from
         # the commit listener via call_soon_threadsafe (the listener runs
         # under the store lock — it must only schedule, never block)
@@ -77,13 +256,28 @@ class ReplicationHub:  # noqa: A004(built behind gate)
         self._waiters_lock = threading.Lock()
         self._attached = False
         self.stats = {"manifest_serves": 0, "longpoll_waits": 0,
-                      "segment_serves": 0, "checkpoint_serves": 0}
+                      "segment_serves": 0, "checkpoint_serves": 0,
+                      "fenced_polls": 0}
         registry = registry or m.REGISTRY
         self._shipped = registry.counter(
             "authz_replication_shipped_bytes_total",
             "Bytes of WAL segments / sidecars / checkpoints served to "
             "replication followers, by artifact kind",
             labels=("kind",))
+        self._fenced_total = registry.counter(
+            "authz_replication_fenced_total",
+            "Incarnation-fencing events: stage=leader when this leader "
+            "observed a newer incarnation and fenced itself, "
+            "stage=follower when a follower rejected a stale leader's "
+            "manifest", labels=("stage",))
+        import weakref
+        ref = weakref.ref(self)
+        registry.gauge(
+            "authz_replication_incarnation",
+            "Current replication incarnation epoch (leader: own epoch; "
+            "follower: highest epoch observed)",
+            callback=lambda: (float(ref().incarnation)
+                              if ref() is not None else 0.0))
 
     # -- commit hook ---------------------------------------------------------
 
@@ -154,6 +348,40 @@ class ReplicationHub:  # noqa: A004(built behind gate)
                         pass
         return True
 
+    # -- fencing -------------------------------------------------------------
+
+    def note_fenced(self, incarnation: int, leader_id: str) -> None:
+        """Record that a strictly newer incarnation exists.  Idempotent;
+        only the first observation (per newer epoch) counts a fencing
+        event."""
+        cur = self.fenced_by
+        if cur is not None and cur["incarnation"] >= incarnation:
+            return
+        self.fenced_by = {"incarnation": int(incarnation),
+                          "leader_id": leader_id}
+        self._fenced_total.inc(stage="leader")
+
+    def observe_poll_headers(self, req) -> None:
+        """Fencing exchange: a follower's poll echoes the highest
+        incarnation it has seen.  Newer than ours — or an epoch tie
+        under a LARGER leader id (two sides of a partition promoting
+        simultaneously mint the same epoch; the total order on
+        (incarnation, leader_id) makes exactly one of them lose) =>
+        we are superseded."""
+        raw = req.headers.get(INCARNATION_HEADER)
+        if not raw:
+            return
+        try:
+            peer_inc = int(raw)
+        except ValueError:
+            return
+        peer_lid = req.headers.get(LEADER_ID_HEADER)
+        if peer_inc > self.incarnation or (
+                peer_inc == self.incarnation
+                and peer_lid and peer_lid > self.leader_id):
+            self.stats["fenced_polls"] += 1
+            self.note_fenced(peer_inc, peer_lid or "")
+
     # -- manifest ------------------------------------------------------------
 
     def manifest(self) -> dict:
@@ -182,14 +410,25 @@ class ReplicationHub:  # noqa: A004(built behind gate)
         self.stats["manifest_serves"] += 1
         return {
             "leader_id": self.leader_id,
+            "incarnation": self.incarnation,
+            # which log this incarnation superseded at promotion (None
+            # for a plain leader): a rejoining ex-leader whose id
+            # matches bounds its unshipped-tail replay at `revision`
+            "fenced": self.fenced,
             "revision": self.store.revision,
             "checkpoint": ckpt.read_manifest(self.persistence.data_dir),
             "segments": segments,
             "sidecars": sidecars,
+            # chain provenance for fan-out trees: hop lags sum down the
+            # chain (the leader is the root: zero lag by definition)
+            "chain": {"path": [self.leader_id],
+                      "lag_revisions": 0.0, "lag_seconds": 0.0},
         }
 
     async def serve_manifest(self, req) -> "Response":
         from ...proxy.httpcore import json_response
+        fail_point("replServeManifest")
+        self.observe_poll_headers(req)
         params = parse_qs(urlsplit(req.target).query)
         wait_raw = (params.get("wait_revision") or [""])[0]
         if wait_raw:
@@ -210,73 +449,30 @@ class ReplicationHub:  # noqa: A004(built behind gate)
 
     # -- artifact bytes ------------------------------------------------------
 
-    async def _serve_file(self, req, path: str, kind: str) -> "Response":
-        from ...proxy.httpcore import Response, json_response
-        params = parse_qs(urlsplit(req.target).query)
-        offset = 0
-        raw_off = (params.get("offset") or ["0"])[0]
-        range_hdr = req.headers.get("Range")
-        try:
-            offset = int(raw_off)
-            if range_hdr:
-                mm = re.match(r"^bytes=(\d+)-$", range_hdr.strip())
-                if mm is None:
-                    raise ValueError(f"unsupported Range {range_hdr!r}")
-                offset = int(mm.group(1))
-        except ValueError as e:
-            return json_response(400, {
-                "kind": "Status", "apiVersion": "v1", "metadata": {},
-                "status": "Failure", "code": 400, "message": str(e)})
-
-        def _read():
-            # a sealed segment is up to segment_bytes and a checkpoint
-            # tens of MB — reading it synchronously would park the
-            # leader's event loop (which is also serving live traffic)
-            # for the whole disk read, once per follower fetch
-            # (analyzer A001 class); the read runs on an executor thread
-            size = os.path.getsize(path)
-            with open(path, "rb") as f:
-                if offset:
-                    f.seek(offset)
-                return size, f.read()
-
-        try:
-            size, body = await asyncio.get_running_loop().run_in_executor(
-                None, _read)
-        except OSError:
-            return json_response(404, {
-                "kind": "Status", "apiVersion": "v1", "metadata": {},
-                "status": "Failure", "reason": "NotFound", "code": 404,
-                "message": f"artifact {os.path.basename(path)!r} is gone "
-                           f"(reclaimed by a checkpoint?); re-bootstrap "
-                           f"from /replication/manifest"})
-        self._shipped.inc(len(body), kind=kind)
-        self.stats[f"{kind}_serves"] += 1
-        resp = Response(status=206 if offset else 200, body=body)
-        resp.headers.set("Content-Type", "application/octet-stream")
-        resp.headers.set("X-Replication-Offset", str(offset))
-        resp.headers.set("X-Replication-Size", str(size))
-        return resp
-
     async def serve_segment(self, req, name: str) -> "Response":
         from ...proxy.httpcore import json_response
+        fail_point("replServeSegment")
+        self.observe_poll_headers(req)
         if not safe_artifact_name(name) or name.startswith("ckpt-"):
             return json_response(400, {
                 "kind": "Status", "apiVersion": "v1", "metadata": {},
                 "status": "Failure", "code": 400,
                 "message": f"invalid segment name {name!r}"})
-        return await self._serve_file(
-            req, os.path.join(self.persistence.wal.dir, name), "segment")
+        return await serve_artifact_file(
+            req, os.path.join(self.persistence.wal.dir, name), "segment",
+            self._shipped, self.stats)
 
     async def serve_checkpoint(self, req, name: str) -> "Response":
         from ...proxy.httpcore import json_response
+        self.observe_poll_headers(req)
         if not safe_artifact_name(name) or not name.startswith("ckpt-"):
             return json_response(400, {
                 "kind": "Status", "apiVersion": "v1", "metadata": {},
                 "status": "Failure", "code": 400,
                 "message": f"invalid checkpoint name {name!r}"})
-        return await self._serve_file(
-            req, os.path.join(self.persistence.ckpt_dir, name), "checkpoint")
+        return await serve_artifact_file(
+            req, os.path.join(self.persistence.ckpt_dir, name), "checkpoint",
+            self._shipped, self.stats)
 
     def snapshot(self) -> dict:
         """/debug/replication payload (leader role)."""
@@ -284,6 +480,9 @@ class ReplicationHub:  # noqa: A004(built behind gate)
             waiters = len(self._waiters)
         man = self.manifest()
         return {"role": "leader", "leader_id": self.leader_id,
+                "incarnation": self.incarnation,
+                "fenced": self.fenced,
+                "fenced_by": self.fenced_by,
                 "revision": man["revision"],
                 "checkpoint_revision": (man["checkpoint"] or {}).get(
                     "revision"),

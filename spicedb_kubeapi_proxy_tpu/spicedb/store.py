@@ -377,6 +377,30 @@ class TupleStore:
                             out.append((e.rel.expires_at, (rtype, relation)))
         return out
 
+    def relationships_since(self, revision: int) -> list:
+        """Live relationships whose last write landed AFTER `revision`.
+        Overlay entries carry exact per-tuple revisions; base-layer rows
+        all carry the base's adoption revision, so a base adopted above
+        `revision` exports wholesale — conservative, and safe for the
+        TOUCH-idempotent rejoin replay this serves
+        (spicedb/replication/failover.py collect_unshipped_tail: the
+        WAL record stream for a window reclaimed by a pre-crash
+        checkpoint is gone, but the surviving EFFECTS are still here)."""
+        now = self._clock()
+        out = []
+        with self._lock:
+            if self._base is not None and self._base.revision > revision:
+                snap = self._base.snap
+                out.extend(snap.relationship(int(i))
+                           for i in self._base.matching_rows(None, now))
+            for by_id in self._by_relation.values():
+                for subjects in by_id.values():
+                    for entry in subjects.values():
+                        if (entry.revision > revision
+                                and not entry.rel.expired(now)):
+                            out.append(entry.rel)
+        return out
+
     def has_exact(self, rel: Relationship) -> bool:
         now = self._clock()
         with self._lock:

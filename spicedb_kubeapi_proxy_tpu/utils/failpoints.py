@@ -6,10 +6,21 @@ crash inside an activity, recovered by the workflow journal).  The reference
 gates these behind a build tag; here they are enabled via this module (a
 no-op unless armed).
 
-Sites now live on the dispatch hot path (drain loop, readback waiters,
-arena pool, background rebuild executor — see tests/test_faultmatrix.py),
-so the disarmed fast path is a single module-global bool read: no lock,
-no dict lookup, until the first enable_failpoint() of the process.
+Sites live on the dispatch hot path (drain loop, readback waiters, arena
+pool, background rebuild executor — tests/test_faultmatrix.py) and on the
+replication paths (manifest long-poll, segment/checkpoint fetch, bootstrap
+adoption, promotion critical section — tests/test_failover.py), so the
+disarmed fast path is a single module-global bool read: no lock, no dict
+lookup, until the first enable_failpoint() of the process.
+
+Two failure kinds (`enable_failpoint(name, n, kind=...)`):
+
+- ``KIND_PANIC`` (default) raises FailPointPanic — a simulated process
+  crash at the site;
+- ``KIND_REFUSE`` raises FailPointRefused, a ConnectionError subclass —
+  a simulated network partition ("connection refused") at an RPC site,
+  so callers exercise their leader-unreachable degradation paths rather
+  than their crash paths.
 """
 
 from __future__ import annotations
@@ -25,8 +36,20 @@ class FailPointPanic(Exception):
         super().__init__(f"failpoint panic: {name}")
 
 
+class FailPointRefused(ConnectionError):
+    """Simulates a refused connection (network partition) at a failpoint
+    site on an RPC path — callers see an ordinary ConnectionError."""
+
+    def __init__(self, name: str):
+        self.name = name
+        super().__init__(f"failpoint partition: {name}: connection refused")
+
+
+KIND_PANIC = "panic"
+KIND_REFUSE = "refuse"
+
 _lock = threading.Lock()
-_armed: dict[str, int] = {}
+_armed: dict[str, tuple[int, str]] = {}
 # fast-path gate: False until the first arm, True until disable_all().
 # fail_point() reads it unlocked — a benign race (a site observing the
 # old value takes at most one extra no-op pass, never a missed panic
@@ -35,10 +58,12 @@ _armed: dict[str, int] = {}
 _active = False
 
 
-def enable_failpoint(name: str, times: int) -> None:
+def enable_failpoint(name: str, times: int, kind: str = KIND_PANIC) -> None:
+    if kind not in (KIND_PANIC, KIND_REFUSE):
+        raise ValueError(f"unknown failpoint kind {kind!r}")
     global _active
     with _lock:
-        _armed[name] = times
+        _armed[name] = (times, kind)
         _active = True
 
 
@@ -53,8 +78,10 @@ def fail_point(name: str) -> None:
     if not _active:
         return
     with _lock:
-        remaining = _armed.get(name, 0)
+        remaining, kind = _armed.get(name, (0, KIND_PANIC))
         if remaining <= 0:
             return
-        _armed[name] = remaining - 1
+        _armed[name] = (remaining - 1, kind)
+    if kind == KIND_REFUSE:
+        raise FailPointRefused(name)
     raise FailPointPanic(name)

@@ -1,0 +1,1070 @@
+"""Replication fault-tolerance suite (ISSUE 11): leader failover with
+incarnation fencing, follower fan-out trees, and the replication fault
+matrix (spicedb/replication/failover.py).
+
+Proves the acceptance bar:
+- kill -9 the leader -> promotion completes well under one flight
+  window and the promoted node takes writes;
+- every acknowledged dual-write before the kill is readable after
+  failover (zero lost): shipped writes ride the promotion, unshipped
+  ones ride the rejoining ex-leader's tail replay;
+- a healed partition with the old leader resurrected converges to
+  exactly one writable leader (fencing tripwire: stale manifests
+  rejected by followers, stale leaders refuse update verbs);
+- no injected fault (segment fetch, manifest poll, checkpoint
+  bootstrap, promotion critical section, partition) hangs anything;
+- the Replication gate off reproduces single-node behavior.
+"""
+
+import asyncio
+import json
+import os
+import random
+import shutil
+import tempfile
+import time
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import FakeKubeApiServer
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
+from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+from spicedb_kubeapi_proxy_tpu.spicedb.replication import (
+    MIN_REVISION_HEADER,
+    StaleLeaderError,
+    failover,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    RelationshipUpdate,
+    UpdateOp,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.utils.failpoints import (
+    KIND_PANIC,
+    KIND_REFUSE,
+    FailPointPanic,
+    disable_all,
+    enable_failpoint,
+)
+from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+definition pod {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+"""
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [list]}]
+prefilter:
+- fromObjectIDNameExpr: "{{resourceId}}"
+  lookupMatchingResources: {tpl: "namespace:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+lock: Optimistic
+check: [{tpl: "namespace:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+"""
+
+N_NS = 10
+
+
+@pytest.fixture(autouse=True)
+def reset_gates_and_failpoints():
+    yield
+    GATES.reset()
+    disable_all()
+
+
+@pytest.fixture
+def tmp():
+    d = tempfile.mkdtemp(prefix="failover-test-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+class LeaderLink:
+    """In-process transport resolving the target proxy's CURRENT handler
+    on every call; swappable for leader-restart scenarios."""
+
+    def __init__(self, proxy=None):
+        self.proxy = proxy
+
+    async def round_trip(self, req):
+        if self.proxy is None:
+            raise ConnectionError("link not bound")
+        return await self.proxy.handler(req)
+
+    def set_leader(self, proxy):
+        self.proxy = proxy
+
+
+class DeadTransport:
+    async def round_trip(self, req):
+        raise ConnectionError("peer is gone")
+
+
+def make_leader(tmp, sub="leader", seed_ns=True, kube=None, **opt_kw):
+    kube = kube or FakeKubeApiServer()
+    if seed_ns:
+        for i in range(N_NS):
+            kube.seed("", "v1", "namespaces",
+                      {"metadata": {"name": f"ns{i}"}})
+    leader = ProxyServer(Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=HandlerTransport(kube),
+        data_dir=os.path.join(tmp, sub), wal_fsync="never", **opt_kw))
+    if seed_ns:
+        leader.endpoint.store.bulk_load([
+            parse_relationship(f"namespace:ns{i}#creator@user:alice")
+            for i in range(0, N_NS, 2)])
+    return leader, kube
+
+
+def make_follower(leader, kube, **opt_kw):
+    transport = LeaderLink(leader)
+    follower = ProxyServer(Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=HandlerTransport(kube),
+        replicate_from="http://leader.test",
+        leader_transport=transport, **opt_kw))
+    return follower, transport
+
+
+def churn(leader, i):
+    op = UpdateOp.DELETE if i % 3 == 2 else UpdateOp.TOUCH
+    rel = parse_relationship(
+        f"namespace:ns{i % N_NS}#viewer@user:u{i % 5}")
+    return leader.endpoint.write_relationships(
+        [RelationshipUpdate(op, rel)])
+
+
+async def list_ns(proxy, user, headers=None):
+    client = proxy.get_embedded_client(user)
+    resp = await client.get("/api/v1/namespaces", headers=headers or [])
+    return resp, (sorted(i["metadata"]["name"]
+                         for i in json.loads(resp.body).get("items", []))
+                  if resp.status == 200 else None)
+
+
+async def assert_parity(a, b, users=("alice", "u0", "u1", "nobody")):
+    for user in users:
+        ra, ia = await list_ns(a, user)
+        rb, ib = await list_ns(b, user)
+        assert ra.status == rb.status == 200
+        assert ia == ib, f"divergence for {user}: {ia} != {ib}"
+
+
+# -- incarnation & manifest ---------------------------------------------------
+
+
+def test_manifest_carries_incarnation_and_chain(tmp):
+    leader, _ = make_leader(tmp)
+
+    async def go():
+        client = leader.get_embedded_client("alice")
+        man = json.loads((await client.get("/replication/manifest")).body)
+        assert man["incarnation"] == 1  # fresh data dir
+        assert man["fenced"] is None
+        assert man["chain"] == {"path": [man["leader_id"]],
+                                "lag_revisions": 0.0, "lag_seconds": 0.0}
+        st = json.loads((await client.get("/replication/status")).body)
+        assert st["role"] == "leader"
+        assert st["incarnation"] == 1 and st["fenced_by"] is None
+
+    asyncio.run(go())
+    # restart-in-place bumps the epoch by one and extends the lineage
+    leader2, _ = make_leader(tmp, seed_ns=False)
+    hub = leader2.replication_hub
+    assert hub.incarnation == 2
+    from spicedb_kubeapi_proxy_tpu.spicedb.replication.leader import (
+        leader_lineage,
+    )
+    lineage = leader_lineage(leader2.persistence.data_dir)
+    assert leader.replication_hub.leader_id in lineage
+    assert hub.leader_id in lineage
+
+
+# -- promotion ---------------------------------------------------------------
+
+
+def test_promote_follower_becomes_writable_leader(tmp):
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube,
+                           promote_data_dir=os.path.join(tmp, "promote"))
+    fol.enable_dual_writes()
+
+    async def go():
+        for i in range(6):
+            await churn(leader, i)
+        await fol.replication.sync_once()
+        shipped = fol.replication.store.revision
+        old_inc = fol.replication.max_incarnation
+
+        # kill -9: the leader object is simply abandoned.  Promotion is
+        # a privileged control action: a plain principal gets 403, the
+        # replication identity / system:masters succeeds.
+        resp = await fol.get_embedded_client("mallory").post(
+            "/replication/promote", {})
+        assert resp.status == 403
+        assert fol.replication is not None  # nothing happened
+        client = fol.get_embedded_client("admin",
+                                         groups=["system:masters"])
+        resp = await client.post("/replication/promote", {})
+        assert resp.status == 200, resp.body
+        info = json.loads(resp.body)
+        assert info["revision"] == shipped
+        assert info["incarnation"] == old_inc + 2  # promotion mint
+        assert fol.replication is None
+        assert fol.replication_hub is not None
+        assert fol.replication_hub.fenced["revision"] == shipped
+
+        # /debug + /status agree on the new role
+        st = json.loads((await client.get("/replication/status")).body)
+        assert st["role"] == "leader" and st["incarnation"] == old_inc + 2
+        dbg = json.loads((await client.get("/debug/replication")).body)
+        assert dbg["role"] == "leader"
+
+        # the promoted node takes writes LOCALLY (no forwarding)
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p1", "namespace": "ns0"}}
+        resp = await fol.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods", pod)
+        assert resp.status in (200, 201), resp.body
+        assert resp.headers.get("X-Authz-Forwarded-To") == ""
+        assert fol.endpoint.store.has_exact(parse_relationship(
+            "pod:ns0/p1#creator@user:alice"))
+
+        # a second promote is a 409: already the leader
+        resp = await client.post("/replication/promote", {})
+        assert resp.status == 409
+
+        # the promoted log is bootstrappable: a FRESH follower anchors
+        # on the promotion checkpoint and tails the new segments
+        g, _ = make_follower(fol, kube)
+        await g.replication.sync_once()
+        assert g.replication.store.revision == fol.endpoint.store.revision
+        assert g.replication.max_incarnation == old_inc + 2
+        await assert_parity(fol, g)
+
+    asyncio.run(go())
+
+
+def test_promotion_crash_rolls_back_to_intact_follower(tmp):
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube,
+                           promote_data_dir=os.path.join(tmp, "promote"))
+
+    async def go():
+        for i in range(4):
+            await churn(leader, i)
+        await fol.replication.sync_once()
+        _, before = await list_ns(fol, "u1")
+        enable_failpoint("replPromote", 1)
+        with pytest.raises(FailPointPanic):
+            await failover.promote_follower(fol)
+        # still an intact follower: no hub, reads serve, tail resumes
+        assert fol.replication is not None
+        assert fol.replication_hub is None
+        resp, after = await list_ns(fol, "u1")
+        assert resp.status == 200 and after == before
+        await churn(leader, 99)
+        await fol.replication.sync_once()
+        assert (fol.replication.store.revision
+                == leader.endpoint.store.revision)
+        # disarmed, the same promotion succeeds
+        disable_all()
+        info = await failover.promote_follower(fol)
+        assert fol.replication_hub is not None
+        assert info["revision"] == fol.endpoint.store.revision
+
+    asyncio.run(go())
+
+
+def test_promote_requires_follower_and_gate(tmp):
+    GATES.set("Replication", False)
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube,
+                           promote_data_dir=os.path.join(tmp, "p"),
+                           serve_replication=True)
+    # gate off: no replication objects at all, single-node behavior
+    assert fol.replication is None and fol.fanout_hub is None
+    assert leader.replication_hub is None
+
+    async def go():
+        resp = await fol.get_embedded_client(
+            "a", groups=["system:masters"]).post(
+            "/replication/promote", {})
+        assert resp.status == 503
+        resp = await fol.get_embedded_client("a").get(
+            "/replication/status")
+        assert resp.status == 503
+
+    asyncio.run(go())
+
+
+# -- zero lost acknowledged writes across failover ---------------------------
+
+
+def test_rejoin_replays_unshipped_tail_zero_lost(tmp):
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube,
+                           promote_data_dir=os.path.join(tmp, "promote"))
+
+    async def go():
+        for i in range(6):
+            await churn(leader, i)
+        await fol.replication.sync_once()
+        shipped = fol.replication.store.revision
+
+        # acknowledged on the leader, never shipped to the follower
+        await leader.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns9#viewer@user:lostwrite"))])
+
+        # kill -9 the leader; promote the follower at the SHIPPED
+        # revision (never guessing at unshipped writes)
+        info = await failover.promote_follower(fol)
+        assert info["revision"] == shipped
+        assert not fol.endpoint.store.has_exact(parse_relationship(
+            "namespace:ns9#viewer@user:lostwrite"))
+
+        # post-failover write on the new leader
+        await fol.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns1#viewer@user:afterfail"))])
+
+        # resurrect the ex-leader over its old data dir, with the new
+        # leader among its peers: the startup fence probe demotes it
+        # and replays the unshipped tail
+        link = LeaderLink(fol)
+        old2, _ = make_leader(
+            tmp, seed_ns=False, kube=kube,
+            replica_peers=["http://new.test"],
+            peer_transports={"http://new.test": link})
+        # recovery restored the acknowledged-but-unshipped write
+        assert old2.endpoint.store.has_exact(parse_relationship(
+            "namespace:ns9#viewer@user:lostwrite"))
+        assert old2.replication_hub.incarnation < info["incarnation"]
+
+        mon = failover.FenceMonitor(old2)
+        assert await mon.check_once() == "demoted"
+        assert old2.replication_hub is None
+        assert old2.replication is not None
+
+        # ZERO LOST: the unshipped write landed on the new leader via
+        # the rejoin replay, next to the post-failover write
+        assert fol.endpoint.store.has_exact(parse_relationship(
+            "namespace:ns9#viewer@user:lostwrite"))
+        assert fol.endpoint.store.has_exact(parse_relationship(
+            "namespace:ns1#viewer@user:afterfail"))
+        # and the rejoined ex-leader converged to the new leader
+        assert (old2.endpoint.store.revision
+                == fol.endpoint.store.revision)
+        await assert_parity(fol, old2,
+                            users=("alice", "u1", "lostwrite",
+                                   "afterfail"))
+        # writes on the rejoined ex-leader forward to the new leader
+        fol.enable_dual_writes()
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p2", "namespace": "ns0"}}
+        resp = await old2.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods", pod)
+        assert resp.status in (200, 201), resp.body
+        assert resp.headers.get("X-Authz-Forwarded-To") == "leader"
+        assert fol.endpoint.store.has_exact(parse_relationship(
+            "pod:ns0/p2#creator@user:alice"))
+
+    asyncio.run(go())
+
+
+def test_healed_partition_converges_to_one_writable_leader(tmp):
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube,
+                           promote_data_dir=os.path.join(tmp, "promote"))
+    other, _ = make_follower(leader, kube)
+    fol.enable_dual_writes()
+
+    async def go():
+        for i in range(5):
+            await churn(leader, i)
+        await fol.replication.sync_once()
+        await other.replication.sync_once()
+
+        # partition: the leader dies, the follower promotes
+        info = await failover.promote_follower(fol)
+        new_inc = info["incarnation"]
+        # `other` adopts the new leader (election loser path)
+        other.opts.peer_transports = {"http://new.test": LeaderLink(fol)}
+        other.opts.replica_peers = ["http://new.test"]
+        other.repoint_leader("http://new.test")
+        await other.replication.sync_once()
+        assert other.replication.max_incarnation == new_inc
+
+        # the partition heals: the old leader resurrects over its dir
+        # (no peers configured — it doesn't know about the promotion)
+        old2, _ = make_leader(tmp, seed_ns=False, kube=kube)
+        assert old2.replication_hub.incarnation < new_inc
+
+        # a follower still pointed at the resurrected ex-leader refuses
+        # its stale manifest and keeps serving its adopted state...
+        _, before = await list_ns(other, "u1")
+        other.replication.repoint(LeaderLink(old2), "http://old.test")
+        with pytest.raises(StaleLeaderError):
+            await other.replication.sync_once()
+        assert other.replication.stats["fenced_polls"] == 1
+        resp, after = await list_ns(other, "u1")
+        assert resp.status == 200 and after == before
+
+        # ...and its poll carried the newer incarnation: the ex-leader
+        # is now fenced and refuses update verbs — exactly ONE writable
+        # leader even before any demotion runs
+        assert old2.replication_hub.fenced_by["incarnation"] == new_inc
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "px", "namespace": "ns0"}}
+        resp = await old2.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods", pod)
+        assert resp.status == 503
+        assert b"superseded" in resp.body
+        # fenced reads stay degraded-but-200
+        resp, _ = await list_ns(old2, "u1")
+        assert resp.status == 200
+        ready = await old2.get_embedded_client("x").get("/readyz")
+        assert ready.status == 200 and b"fenced" in ready.body
+        # the new leader takes the same write
+        resp = await fol.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods", pod)
+        assert resp.status in (200, 201), resp.body
+
+        # full convergence: the fenced ex-leader demotes into the fleet
+        old2.opts.peer_transports = {"http://new.test": LeaderLink(fol)}
+        old2.opts.replica_peers = ["http://new.test"]
+        mon = failover.FenceMonitor(old2)
+        assert await mon.check_once() == "demoted"
+        assert old2.replication_hub is None
+        await assert_parity(fol, old2)
+
+    asyncio.run(go())
+
+
+def test_rejoin_endpoint_requires_privilege(tmp):
+    leader, kube = make_leader(tmp)
+
+    async def go():
+        rev = leader.endpoint.store.revision
+        body = {"from_leader_id": "x", "from_incarnation": 1,
+                "updates": [["t", "namespace:ns0#viewer@user:evil"]]}
+        # an ordinary authenticated principal must NOT be able to write
+        # tuples through the rejoin control endpoint
+        resp = await leader.get_embedded_client("mallory").post(
+            "/replication/rejoin", body)
+        assert resp.status == 403
+        assert leader.endpoint.store.revision == rev
+        assert not leader.endpoint.store.has_exact(parse_relationship(
+            "namespace:ns0#viewer@user:evil"))
+        # the replication identity may (that is the rejoin path)
+        resp = await leader.get_embedded_client("system:replica").post(
+            "/replication/rejoin", body)
+        assert resp.status == 200
+        assert json.loads(resp.body)["applied"] == 1
+
+    asyncio.run(go())
+
+
+def test_rejoin_replays_checkpoint_reclaimed_window(tmp):
+    """A pre-crash checkpoint can reclaim the WAL segments holding the
+    unshipped tail: the rejoin then replays the surviving EFFECTS from
+    the recovered store (revision-stamped tuples) instead of silently
+    losing them."""
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube,
+                           promote_data_dir=os.path.join(tmp, "promote"))
+
+    async def go():
+        for i in range(4):
+            await churn(leader, i)
+        await fol.replication.sync_once()
+        shipped = fol.replication.store.revision
+        # unshipped writes... then a checkpoint RECLAIMS their segments
+        await leader.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns8#viewer@user:ckptlost"))])
+        leader.persistence.checkpoint()
+        assert leader.persistence._last_ckpt_revision > shipped
+        # kill -9 the leader; promote the follower at the shipped rev
+        info = await failover.promote_follower(fol)
+        assert info["revision"] == shipped
+        # resurrect + demote: the WAL stream past `shipped` is gone,
+        # but the effects replay recovers the write
+        link = LeaderLink(fol)
+        old2, _ = make_leader(
+            tmp, seed_ns=False, kube=kube,
+            replica_peers=["http://new.test"],
+            peer_transports={"http://new.test": link})
+        mon = failover.FenceMonitor(old2)
+        assert await mon.check_once() == "demoted"
+        assert fol.endpoint.store.has_exact(parse_relationship(
+            "namespace:ns8#viewer@user:ckptlost"))
+        assert (old2.endpoint.store.revision
+                == fol.endpoint.store.revision)
+        await assert_parity(fol, old2, users=("alice", "ckptlost"))
+
+    asyncio.run(go())
+
+
+def test_equal_epoch_tie_breaks_on_larger_leader_id(tmp):
+    """Two sides of a partition promoting simultaneously mint the same
+    epoch: the (incarnation, leader_id) total order makes exactly ONE
+    of them lose — never both (zero writable leaders) and never a
+    per-follower split."""
+    leader, _ = make_leader(tmp)
+    hub = leader.replication_hub
+    small_id = "leader-0000-aaaa"
+    big_id = "leader-9999-zzzz"
+
+    class FakeReq:
+        def __init__(self, inc, lid):
+            from spicedb_kubeapi_proxy_tpu.proxy.httpcore import Headers
+            self.headers = Headers([
+                ("X-Replication-Incarnation", str(inc)),
+                ("X-Replication-Leader-Id", lid)])
+
+    # the hub only loses an epoch tie to a LARGER id...
+    hub.leader_id = big_id
+    hub.observe_poll_headers(FakeReq(hub.incarnation, small_id))
+    assert hub.fenced_by is None
+    # ...and loses it to a larger one
+    hub.observe_poll_headers(FakeReq(hub.incarnation, big_id + "x"))
+    assert hub.fenced_by is not None
+
+    # follower side: same order — an equal-epoch smaller id is stale,
+    # an equal-epoch larger id is adopted
+    kube = FakeKubeApiServer()
+    fol, _ = make_follower(leader, kube)
+    fol.replication.max_incarnation = 7
+    fol.replication.max_leader_id = big_id
+
+    class FakeTransport:
+        def __init__(self, inc, lid):
+            self.inc, self.lid = inc, lid
+
+        async def round_trip(self, req):
+            from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+                json_response,
+            )
+            return json_response(200, {
+                "leader_id": self.lid, "incarnation": self.inc,
+                "revision": 0, "checkpoint": None, "segments": [],
+                "sidecars": [], "chain": {"path": [self.lid],
+                                          "lag_revisions": 0,
+                                          "lag_seconds": 0}})
+
+    async def go():
+        from spicedb_kubeapi_proxy_tpu.spicedb.replication import (
+            StaleLeaderError as SLE,
+        )
+        fol.replication.transport = FakeTransport(7, small_id)
+        with pytest.raises(SLE):
+            await fol.replication._fetch_manifest(wait=False)
+        fol.replication.transport = FakeTransport(7, big_id + "x")
+        await fol.replication._fetch_manifest(wait=False)
+        assert fol.replication.max_leader_id == big_id + "x"
+
+    asyncio.run(go())
+
+
+# -- election ----------------------------------------------------------------
+
+
+def _make_election_pair(tmp, kube, leader):
+    link_a, link_b = LeaderLink(), LeaderLink()
+    fa, _ = make_follower(
+        leader, kube, replica_id="node-a",
+        promote_data_dir=os.path.join(tmp, "pa"),
+        replica_peers=["http://b.test"],
+        peer_transports={"http://b.test": link_b})
+    fb, _ = make_follower(
+        leader, kube, replica_id="node-b",
+        promote_data_dir=os.path.join(tmp, "pb"),
+        replica_peers=["http://a.test"],
+        peer_transports={"http://a.test": link_a})
+    link_a.set_leader(fa)
+    link_b.set_leader(fb)
+    return fa, fb
+
+
+def test_election_highest_revision_wins_and_loser_repoints(tmp):
+    leader, kube = make_leader(tmp)
+    fa, fb = _make_election_pair(tmp, kube, leader)
+
+    async def go():
+        for i in range(4):
+            await churn(leader, i)
+        await fb.replication.sync_once()
+        for i in range(4, 8):
+            await churn(leader, i)
+        await fa.replication.sync_once()  # A strictly ahead of B
+        assert (fa.replication.store.revision
+                > fb.replication.store.revision)
+
+        wd_a = failover.LeaderLossWatchdog(fa, grace_s=0.0)
+        wd_b = failover.LeaderLossWatchdog(fb, grace_s=0.0)
+        # B sees a better candidate (A, higher revision): defers
+        assert await wd_b.run_election() == "deferred"
+        assert fb.replication is not None
+        # A wins and promotes
+        assert await wd_a.run_election() == "promoted"
+        assert fa.replication_hub is not None
+        # B's next pass finds the promoted leader and repoints
+        assert await wd_b.run_election() == "repointed"
+        assert fb.opts.replicate_from == "http://a.test"
+        await fb.replication.sync_once()
+        assert (fb.replication.store.revision
+                == fa.endpoint.store.revision)
+        # a write on the new leader replicates to the repointed loser
+        await fa.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns2#viewer@user:postelect"))])
+        await fb.replication.sync_once()
+        assert fb.replication.store.has_exact(parse_relationship(
+            "namespace:ns2#viewer@user:postelect"))
+        await assert_parity(fa, fb)
+
+    asyncio.run(go())
+
+
+def test_election_tie_breaks_on_smallest_replica_id(tmp):
+    leader, kube = make_leader(tmp)
+    fa, fb = _make_election_pair(tmp, kube, leader)
+
+    async def go():
+        for i in range(4):
+            await churn(leader, i)
+        await fa.replication.sync_once()
+        await fb.replication.sync_once()
+        assert (fa.replication.store.revision
+                == fb.replication.store.revision)
+        wd_a = failover.LeaderLossWatchdog(fa, grace_s=0.0)
+        wd_b = failover.LeaderLossWatchdog(fb, grace_s=0.0)
+        # node-b defers to node-a (same revision, smaller id)
+        assert await wd_b.run_election() == "deferred"
+        assert await wd_a.run_election() == "promoted"
+        assert fa.replication_hub is not None
+
+    asyncio.run(go())
+
+
+def test_watchdog_probe_prevents_false_promotion(tmp):
+    """An idle tail parked in a long-poll has a stale `last_success`;
+    the watchdog must confirm loss with a direct probe instead of
+    promoting past a perfectly healthy leader."""
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube,
+                           promote_data_dir=os.path.join(tmp, "promote"))
+
+    async def go():
+        await fol.replication.sync_once()
+        wd = failover.LeaderLossWatchdog(fol, grace_s=0.05)
+        # stale success (as during an idle 25s long-poll), live leader
+        fol.replication._last_success = time.monotonic() - 60.0
+        assert await wd.check_once() == "healthy"
+        assert fol.replication_hub is None  # no false promotion
+        assert wd.stats.get("probes_ok") == 1
+        # the successful probe refreshed the loss clock: the next tick
+        # is healthy WITHOUT re-probing (no probe churn per tick)
+        assert await wd.check_once() == "healthy"
+        assert wd.stats.get("probes_ok") == 1
+        # same staleness with the leader actually gone: election fires
+        fol.replication.transport = DeadTransport()
+        fol.replication._last_success = time.monotonic() - 60.0
+        assert await wd.check_once() == "promoted"
+        assert fol.replication_hub is not None
+
+    asyncio.run(go())
+
+
+def test_watchdog_failover_completes_within_flight_window(tmp):
+    flight_window_s = 5.0
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube,
+                           promote_data_dir=os.path.join(tmp, "promote"),
+                           flight_window_s=flight_window_s)
+
+    async def go():
+        for i in range(4):
+            await churn(leader, i)
+        await fol.replication.sync_once()
+        # kill -9: both the tail and any forwarding path die
+        fol.replication.transport = DeadTransport()
+        fol._leader_transport = DeadTransport()
+        wd = failover.LeaderLossWatchdog(fol, grace_s=0.15,
+                                         interval_s=0.05)
+        t0 = time.monotonic()
+        wd.start()
+        try:
+            while (fol.replication_hub is None
+                   and time.monotonic() - t0 < flight_window_s):
+                await asyncio.sleep(0.02)
+            elapsed = time.monotonic() - t0
+            assert fol.replication_hub is not None, \
+                "promotion did not happen"
+            assert elapsed < flight_window_s, \
+                f"failover took {elapsed:.2f}s (window {flight_window_s}s)"
+            # the promoted node is immediately writable
+            rev = await fol.endpoint.write_relationships([
+                RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                    "namespace:ns3#viewer@user:postwd"))])
+            assert rev > 0
+        finally:
+            await wd.stop()
+
+    asyncio.run(go())
+
+
+# -- fan-out trees -----------------------------------------------------------
+
+
+def _make_chain(tmp, kube, leader):
+    mid, _ = make_follower(
+        leader, kube, serve_replication=True,
+        mirror_dir=os.path.join(tmp, "mirror"), replica_id="mid")
+    leaf, _ = make_follower(mid, kube, replica_wait_ms=30.0)
+    return mid, leaf
+
+
+def test_fanout_chain_parity_and_chain_lag(tmp):
+    leader, kube = make_leader(tmp)
+    leader.persistence.checkpoint()  # bootstrap via mirrored checkpoint
+    mid, leaf = _make_chain(tmp, kube, leader)
+
+    async def go():
+        for i in range(6):
+            await churn(leader, i)
+        await mid.replication.sync_once()
+        await leaf.replication.sync_once()
+        assert (leaf.replication.store.revision
+                == leader.endpoint.store.revision)
+        await assert_parity(leader, leaf)
+        # provenance: the leaf sees the full upstream path
+        assert (leaf.replication.upstream_chain["path"]
+                == [leader.replication_hub.leader_id, "mid"])
+        dbg = json.loads((await leaf.get_embedded_client("a").get(
+            "/debug/replication")).body)
+        assert dbg["upstream_path"] == [
+            leader.replication_hub.leader_id, "mid"]
+        mid_dbg = json.loads((await mid.get_embedded_client("a").get(
+            "/debug/replication")).body)
+        assert mid_dbg["fanout"]["serves_replication"]
+        # incarnation passes through unchanged down the chain
+        assert (leaf.replication.max_incarnation
+                == leader.replication_hub.incarnation)
+
+        # chain lag is additive: the mid falls behind, the (locally
+        # caught-up) leaf reports the mid's hop in its own lag
+        for i in range(6, 11):
+            await churn(leader, i)
+        await mid.replication._fetch_manifest(wait=False)  # sees lag
+        assert mid.replication.lag_revisions() > 0
+        await leaf.replication.sync_once()
+        assert (leaf.replication.lag_revisions()
+                >= mid.replication.lag_revisions())
+
+        # the mid catches up; the chain drains to parity end to end
+        await mid.replication.sync_once()
+        await leaf.replication.sync_once()
+        assert (leaf.replication.store.revision
+                == leader.endpoint.store.revision)
+        assert leaf.replication.lag_revisions() == 0.0
+        await assert_parity(leader, leaf)
+
+    asyncio.run(go())
+
+
+def test_fanout_write_forwards_up_the_chain(tmp):
+    leader, kube = make_leader(tmp)
+    leader.enable_dual_writes()
+    mid, leaf = _make_chain(tmp, kube, leader)
+
+    async def go():
+        await mid.replication.sync_once()
+        await leaf.replication.sync_once()
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "deep", "namespace": "ns0"}}
+        resp = await leaf.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods", pod)
+        assert resp.status in (200, 201), resp.body
+        assert resp.headers.get("X-Authz-Forwarded-To") == "leader"
+        # the dual-write landed on the ROOT leader...
+        assert leader.endpoint.store.has_exact(parse_relationship(
+            "pod:ns0/deep#creator@user:alice"))
+        # ...and replicates back down through the tree
+        await mid.replication.sync_once()
+        await leaf.replication.sync_once()
+        assert leaf.replication.store.has_exact(parse_relationship(
+            "pod:ns0/deep#creator@user:alice"))
+
+    asyncio.run(go())
+
+
+# -- ZedToken propagation (satellite) ----------------------------------------
+
+
+def test_min_revision_propagates_through_forwarded_reads(tmp):
+    leader, kube = make_leader(tmp)
+    mid, leaf = _make_chain(tmp, kube, leader)
+    mid.opts.replica_wait_ms = 30.0
+
+    async def go():
+        await mid.replication.sync_once()
+        await leaf.replication.sync_once()
+        rev = await leader.endpoint.write_relationships([
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns5#viewer@user:zed"))])
+        # neither hop has applied `rev`: the leaf waits, forwards to the
+        # mid; the mid's gate sees the SAME token (propagated), waits,
+        # forwards to the leader — the answer is fresh, never stale.  A
+        # dropped header would have served the mid's stale store (no
+        # ns5 for zed) instead.
+        resp, items = await list_ns(
+            leaf, "zed", headers=[(MIN_REVISION_HEADER, str(rev))])
+        assert resp.status == 200
+        assert resp.headers.get("X-Authz-Forwarded-To") == "leader"
+        assert items == ["ns5"]
+
+    asyncio.run(go())
+
+
+def test_min_revision_propagates_on_forwarded_writes(tmp):
+    leader, kube = make_leader(tmp, replica_wait_ms=50.0)
+    leader.enable_dual_writes()
+    fol, _ = make_follower(leader, kube)
+
+    async def go():
+        await fol.replication.sync_once()
+        rev = leader.endpoint.store.revision
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "w1", "namespace": "ns0"}}
+        # satisfiable token rides the forwarded write and succeeds
+        resp = await fol.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods", pod,
+            headers=[(MIN_REVISION_HEADER, str(rev))])
+        assert resp.status in (200, 201), resp.body
+        # an unsatisfiable token fails LOUDLY on the leader — proof the
+        # header crossed the forward hop instead of being dropped
+        resp = await fol.get_embedded_client("alice").post(
+            "/api/v1/namespaces/ns0/pods", dict(
+                pod, metadata={"name": "w2", "namespace": "ns0"}),
+            headers=[(MIN_REVISION_HEADER,
+                      str(leader.endpoint.store.revision + 50))])
+        assert resp.status == 503
+        assert b"not available on this leader" in resp.body
+
+    asyncio.run(go())
+
+
+def test_leader_honors_min_revision_waits_then_503(tmp):
+    leader, _ = make_leader(tmp, replica_wait_ms=500.0)
+
+    async def go():
+        client = leader.get_embedded_client("u1")
+        rev = leader.endpoint.store.revision
+
+        async def poke():
+            await asyncio.sleep(0.05)
+            await churn(leader, 0)
+
+        task = asyncio.ensure_future(poke())
+        resp, _ = await list_ns(
+            leader, "u0", headers=[(MIN_REVISION_HEADER, str(rev + 1))])
+        await task
+        assert resp.status == 200  # waited for the concurrent commit
+        # far-ahead token: bounded wait, then a loud 503 — never a
+        # below-token answer (post-failover safety)
+        leader.opts.replica_wait_ms = 30.0
+        resp = await client.get(
+            "/api/v1/namespaces",
+            headers=[(MIN_REVISION_HEADER,
+                      str(leader.endpoint.store.revision + 10))])
+        assert resp.status == 503
+        # malformed token: 400
+        resp = await client.get(
+            "/api/v1/namespaces",
+            headers=[(MIN_REVISION_HEADER, "banana")])
+        assert resp.status == 400
+
+    asyncio.run(go())
+
+
+# -- fault matrix -------------------------------------------------------------
+
+
+def test_fault_matrix_no_hang_anywhere(tmp):
+    """Every injected replication fault fails FAST (no hangs), never
+    stops the follower from serving its adopted state, and recovery
+    after disarm converges to parity."""
+    leader, kube = make_leader(tmp)
+    leader.persistence.checkpoint()
+
+    async def drive(follower, fault, kind, pre_churn, fresh):
+        for i in range(pre_churn):
+            await churn(leader, random.randrange(1000))
+        if not fresh:
+            await follower.replication.sync_once()
+            await churn(leader, random.randrange(1000))
+        _, before = await list_ns(follower, "u1")
+        enable_failpoint(fault, 1, kind=kind)
+        with pytest.raises(Exception):
+            await asyncio.wait_for(follower.replication.sync_once(),
+                                   timeout=3.0)
+        # still serving (bounded staleness) mid-fault
+        resp, after = await list_ns(follower, "u1")
+        assert resp.status == 200 and after == before
+        disable_all()
+        await follower.replication.sync_once()
+        assert (follower.replication.store.revision
+                == leader.endpoint.store.revision)
+        await assert_parity(leader, follower)
+
+    async def go():
+        cases = [
+            # (failpoint, kind, fresh follower?)
+            ("replManifestPoll", KIND_PANIC, False),
+            ("replManifestPoll", KIND_REFUSE, False),  # partition
+            ("replLeaderLink", KIND_REFUSE, False),    # partition
+            ("replServeManifest", KIND_REFUSE, False),  # leader side
+            ("replSegmentFetch", KIND_PANIC, False),
+            ("replCheckpointFetch", KIND_PANIC, True),
+            ("replBootstrapAdopt", KIND_PANIC, True),
+            ("replBootstrapFinish", KIND_PANIC, True),
+        ]
+        for fault, kind, fresh in cases:
+            follower, _ = make_follower(leader, kube)
+            await drive(follower, fault, kind, pre_churn=2, fresh=fresh)
+
+    asyncio.run(go())
+
+
+def test_torn_bootstrap_never_serves_half_adopted_store(tmp):
+    """Satellite: a follower that crashes mid-checkpoint-adoption
+    restarts cleanly from the manifest — the store is either the old
+    state or the fully-adopted checkpoint, never in between."""
+    leader, kube = make_leader(tmp)
+
+    async def go():
+        for i in range(6):
+            await churn(leader, i)
+        leader.persistence.checkpoint()
+
+        # crash BEFORE adoption: nothing adopted, /readyz stays 503
+        f1, _ = make_follower(leader, kube)
+        enable_failpoint("replBootstrapAdopt", 1)
+        with pytest.raises(FailPointPanic):
+            await f1.replication.sync_once()
+        assert f1.replication.store.revision == 0
+        assert not f1.replication.ever_bootstrapped
+        ready = await f1.get_embedded_client("x").get("/readyz")
+        assert ready.status == 503
+        disable_all()
+        await f1.replication.sync_once()
+        assert (f1.replication.store.revision
+                == leader.endpoint.store.revision)
+        await assert_parity(leader, f1)
+
+        # crash AFTER adoption but before the cursor/flags land: the
+        # retry re-adopts idempotently from the manifest
+        f2, _ = make_follower(leader, kube)
+        enable_failpoint("replBootstrapFinish", 1)
+        with pytest.raises(FailPointPanic):
+            await f2.replication.sync_once()
+        assert not f2.replication.bootstrapped
+        rev_mid = f2.replication.store.revision
+        assert rev_mid in (0, leader.persistence._last_ckpt_revision)
+        disable_all()
+        await f2.replication.sync_once()
+        assert (f2.replication.store.revision
+                == leader.endpoint.store.revision)
+        await assert_parity(leader, f2)
+
+    asyncio.run(go())
+
+
+# -- jittered backoff (satellite) --------------------------------------------
+
+
+def test_backoff_is_jittered_exponential_with_cap():
+    from spicedb_kubeapi_proxy_tpu.spicedb.replication.follower import (
+        ReplicaFollower,
+    )
+    from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+    fol = ReplicaFollower(TupleStore(), DeadTransport(),
+                          retry_backoff_s=1.0, retry_backoff_cap_s=15.0,
+                          rng=random.Random(42))
+    cur = 1.0
+    sleeps = []
+    for _ in range(8):
+        sleep_s, cur2 = fol._next_backoff(cur)
+        assert cur / 2 <= sleep_s < cur, (sleep_s, cur)
+        assert cur2 == min(cur * 2.0, 15.0)
+        sleeps.append(sleep_s)
+        cur = cur2
+    assert cur == 15.0  # capped
+    # jitter: the draws are not a deterministic halving/doubling ladder
+    ratios = {round(s / b, 4)
+              for s, b in zip(sleeps, [1, 2, 4, 8, 15, 15, 15, 15])}
+    assert len(ratios) > 1
+
+
+def test_run_loop_backoff_jitters_between_retries(tmp):
+    leader, kube = make_leader(tmp)
+    fol, _ = make_follower(leader, kube)
+    fol.replication._rng = random.Random(7)
+
+    async def go():
+        await fol.replication.sync_once()
+        fol.replication.transport = DeadTransport()
+        sleeps = []
+        real_sleep = asyncio.sleep
+
+        async def fake_sleep(s, *a, **kw):
+            sleeps.append(s)
+            if len(sleeps) >= 6:
+                raise asyncio.CancelledError
+            await real_sleep(0)
+
+        asyncio.sleep = fake_sleep
+        try:
+            with pytest.raises(asyncio.CancelledError):
+                await fol.replication.run()
+        finally:
+            asyncio.sleep = real_sleep
+        assert len(sleeps) == 6
+        # jittered: distinct values, each inside its doubling band
+        bands = [1, 2, 4, 8, 15, 15]
+        for s, b in zip(sleeps, bands):
+            assert b / 2 <= s < b, (s, b)
+        assert len({round(s / b, 4)
+                    for s, b in zip(sleeps, bands)}) > 1
+        assert fol.replication.stats["poll_errors"] >= 6
+
+    asyncio.run(go())

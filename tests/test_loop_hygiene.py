@@ -236,6 +236,7 @@ class TestReplicationOffLoop:
         )
         from spicedb_kubeapi_proxy_tpu.spicedb.replication.leader import (
             ReplicationHub,
+            serve_artifact_file,
         )
         from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
         from spicedb_kubeapi_proxy_tpu.utils import metrics as m
@@ -259,7 +260,10 @@ class TestReplicationOffLoop:
             req = Request(method="GET",
                           target="/replication/segment/seg-00000001.wal",
                           headers=Headers())
-            resp = await hub._serve_file(req, str(seg), "segment")
+            # serve_artifact_file is the ONE byte-serving path (leader
+            # hub and fan-out hub both route through it)
+            resp = await serve_artifact_file(req, str(seg), "segment",
+                                             hub._shipped, hub.stats)
             assert resp.status == 200
             assert resp.body == b"0123456789abcdef"
             assert seen["thread"] is not loop_thread, (
@@ -269,7 +273,8 @@ class TestReplicationOffLoop:
                 method="GET",
                 target="/replication/segment/seg-00000001.wal?offset=10",
                 headers=Headers())
-            resp2 = await hub._serve_file(req2, str(seg), "segment")
+            resp2 = await serve_artifact_file(req2, str(seg), "segment",
+                                              hub._shipped, hub.stats)
             assert resp2.status == 206
             assert resp2.body == b"abcdef"
 
