@@ -1378,6 +1378,235 @@ def _cpu_pair_ceiling(taskset) -> float:
     return round(total / max(single, 1), 2)
 
 
+def _scenario_chain(workload, clock, cache_on: bool):
+    """jax:// endpoint over a FAKE-clock store (+ DecisionCacheEndpoint
+    when the scenario exercises the cache seam) and its oracle."""
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+    from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+
+    schema = sch.parse_schema(workload.schema_text)
+    store = TupleStore(clock=clock.now)
+    inner = JaxEndpoint(schema, store=store)
+    store.bulk_load_text("\n".join(workload.relationships))
+    ep = inner
+    if cache_on:
+        from spicedb_kubeapi_proxy_tpu.spicedb.decision_cache import (
+            DecisionCacheEndpoint)
+        ep = DecisionCacheEndpoint(inner)
+    return ep, inner, Evaluator(schema, store)
+
+
+def _scenario_bench(name, args, churn_fn, cache_on=False, rounds=None,
+                    extra=None):
+    """Shared scenario runner with the HOST-ORACLE PARITY REFEREE:
+    every round applies scenario churn, referees N subjects' frontiers
+    and a check-bulk sample against the recursive evaluator over the
+    SAME store at the SAME revision, and measures device throughput.
+    Churn rounds scale with --rounds (the default 10 maps to 6 rounds,
+    --rounds 20 to 12, ...).  Divergence acceptance for every scenario
+    config: 0."""
+    if rounds is None:
+        rounds = max(2, args.rounds * 6 // 10)
+    import asyncio
+    import random as _random
+
+    from spicedb_kubeapi_proxy_tpu.fuzz.delta_gen import FakeClock
+    from spicedb_kubeapi_proxy_tpu.fuzz.scenarios import SCENARIO_WORKLOADS
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+        CheckRequest, ObjectRef, SubjectRef)
+
+    clock = FakeClock()
+    wl_kw = {"now": clock.now()} if name == "ephemeral-grants" else {}
+    workload = SCENARIO_WORKLOADS[name](**wl_kw)
+    stage(f"{name} build ({len(workload.relationships)} tuples)")
+    ep, inner, oracle = _scenario_chain(workload, clock, cache_on)
+    rng = _random.Random(99)
+    rt, perm = workload.resource_type, workload.permission
+    subjects = [SubjectRef("user", workload.subjects[i * 7
+                                                     % len(workload.subjects)])
+                for i in range(4)]
+    divergences = 0
+    refereed = 0
+    check_s = 0.0
+    n_checks = 0
+    list_s = 0.0
+    n_lists = 0
+
+    async def run():
+        nonlocal divergences, refereed, check_s, n_checks, list_s, n_lists
+        # warmup: pay first-use jit compiles outside the timed rounds
+        await ep.lookup_resources(rt, perm, subjects[0])
+        ids0 = inner.store.object_ids_of_type(rt)[:64]
+        await ep.check_bulk_permissions(
+            [CheckRequest(ObjectRef(rt, o), perm, subjects[0])
+             for o in ids0])
+        for r in range(rounds):
+            churn_fn(inner.store, clock, rng, r)
+            # referee: frontier parity per subject at the pinned
+            # revision — twice when the cache rides the chain, so the
+            # SECOND pass referees a cache-served answer too
+            for s in subjects:
+                want = sorted(oracle.lookup_resources(rt, perm, s))
+                for _pass in range(2 if cache_on else 1):
+                    t0 = time.time()
+                    got = sorted(await ep.lookup_resources(rt, perm, s))
+                    list_s += time.time() - t0
+                    n_lists += 1
+                    refereed += 1
+                    if got != want:
+                        divergences += 1
+            # referee: tri-state check parity on a sampled id block
+            ids = inner.store.object_ids_of_type(rt)
+            sample = ids[:: max(1, len(ids) // 128)][:128]
+            reqs = [CheckRequest(ObjectRef(rt, o), perm, s)
+                    for o in sample for s in subjects[:2]]
+            t0 = time.time()
+            res = await ep.check_bulk_permissions(reqs)
+            check_s += time.time() - t0
+            n_checks += len(reqs)
+            p3 = {"NO_PERMISSION": 0, "CONDITIONAL_PERMISSION": 1,
+                  "HAS_PERMISSION": 2}
+            for req, cr in zip(reqs, res):
+                refereed += 1
+                if p3[cr.permissionship.name] != oracle.check3(
+                        req.resource, req.permission, req.subject):
+                    divergences += 1
+
+    asyncio.run(run())
+    out = {
+        "divergences": divergences,
+        "refereed_answers": refereed,
+        "rounds": rounds,
+        "checks_per_s": round(n_checks / max(check_s, 1e-9), 1),
+        "lists_per_s": round(n_lists / max(list_s, 1e-9), 2),
+        "objects": workload.expected_objects,
+        "tuples": len(workload.relationships),
+        "kernel_calls": inner.stats["kernel_calls"],
+        "oracle_residual_checks": inner.stats["oracle_residual_checks"],
+        "rebuilds": inner.stats["rebuilds"],
+    }
+    if cache_on:
+        st = ep.cache.stats
+        probes = st["hits"] + st["misses"]
+        out["hit_rate"] = round(st["hits"] / max(probes, 1), 4)
+        out["cache_invalidations"] = st["invalidations"]
+    if extra:
+        out.update(extra(inner))
+    log(f"{name}: {divergences} divergences over {refereed} refereed "
+        f"answers, {out['checks_per_s']} checks/s, "
+        f"{out['rebuilds']} rebuilds")
+    return out
+
+
+def bench_scenario_caveat_heavy(args) -> dict:
+    """CEL-caveated tuples at scale (ROADMAP item 5): decided-true /
+    decided-false / undecidable contexts churned every round; the
+    artifact records WHICH side decided the caveats (`caveat_path`) —
+    the tri-state device bitplanes or the host-oracle post-filter."""
+
+    def churn(store, clock, rng, r):
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipUpdate, UpdateOp, parse_relationship)
+        ops = []
+        for _ in range(24):
+            d = rng.randrange(3000)
+            u = rng.randrange(400)
+            roll = rng.random()
+            if roll < 0.4:
+                ctx = '{"used": 1, "quota": 5}' if rng.random() < 0.5 \
+                    else '{"used": 1}'
+                ops.append(RelationshipUpdate(
+                    UpdateOp.TOUCH, parse_relationship(
+                        f"doc:d{d}#assigned@user:u{u}"
+                        f"[caveat:within_quota:{ctx}]")))
+            elif roll < 0.7:
+                lvl = rng.randrange(6)
+                ops.append(RelationshipUpdate(
+                    UpdateOp.TOUCH, parse_relationship(
+                        f"doc:d{d}#approved@user:u{u}"
+                        f'[caveat:min_level:{{"level": {lvl}}}]')))
+            else:
+                ops.append(RelationshipUpdate(
+                    UpdateOp.DELETE, parse_relationship(
+                        f"doc:d{d}#assigned@user:u{u}")))
+        store.write(ops)
+
+    def caveat_path(inner):
+        graph = inner._graph
+        bitplane = bool(getattr(graph, "has_cav", False))
+        residual = inner.stats["oracle_residual_checks"]
+        return {"caveat_path": ("device-bitplane" if bitplane and not
+                                residual else
+                                "device-bitplane+host-residual" if bitplane
+                                else "host-postfilter"),
+                "caveat_bitplanes": bitplane}
+
+    return _scenario_bench("caveat-heavy", args, churn, extra=caveat_path)
+
+
+def bench_scenario_wildcard_public(args) -> dict:
+    """Wildcard-heavy public resources: `user:*` grants FLIP on and off
+    every round — the delta class the device graph cannot absorb in
+    place, so the rebuild path (sync or background per the AsyncRebuild
+    gate) carries the churn while the referee holds parity."""
+
+    def churn(store, clock, rng, r):
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipUpdate, UpdateOp, parse_relationship)
+        ops = []
+        for _ in range(8):
+            d = rng.randrange(4000)
+            op = (UpdateOp.DELETE if rng.random() < 0.5 else UpdateOp.TOUCH)
+            ops.append(RelationshipUpdate(
+                op, parse_relationship(f"doc:d{d}#public@user:*")))
+        for _ in range(8):
+            d = rng.randrange(4000)
+            u = rng.randrange(400)
+            ops.append(RelationshipUpdate(
+                UpdateOp.TOUCH,
+                parse_relationship(f"doc:d{d}#viewer@user:u{u}")))
+        store.write(ops)
+
+    return _scenario_bench("wildcard-public", args, churn)
+
+
+def bench_scenario_ephemeral_grants(args) -> dict:
+    """PAuth-style task-scoped ephemeral grants: short-TTL expiring
+    tuples at high churn against the store's fake clock, with the
+    DecisionCache ON — every round grants expire mid-stream, so the
+    PR 3 expiry heap must invalidate cached frontiers exactly when the
+    clock crosses each instant (the referee proves it)."""
+
+    def churn(store, clock, rng, r):
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            RelationshipUpdate, UpdateOp, parse_relationship)
+        ops = []
+        for _ in range(32):
+            d = rng.randrange(3000)
+            u = rng.randrange(300)
+            ttl = 5.0 + 25.0 * rng.random()
+            exp = clock.now() + ttl
+            ops.append(RelationshipUpdate(
+                UpdateOp.TOUCH, parse_relationship(
+                    f"doc:d{d}#grant@user:u{u}[expiration:{exp}]")))
+        store.write(ops)
+        # cross a swath of TTL instants: earlier rounds' grants lapse
+        clock.advance(12.0)
+
+    return _scenario_bench("ephemeral-grants", args, churn, cache_on=True)
+
+
+# scenario matrix configs (ISSUE 12 / ROADMAP item 5): the three
+# workload shapes the sweep was missing, each with a host-oracle parity
+# referee (docs/performance.md "Scenario matrix")
+SCENARIO_CONFIGS = {
+    "caveat-heavy": bench_scenario_caveat_heavy,
+    "wildcard-public": bench_scenario_wildcard_public,
+    "ephemeral-grants": bench_scenario_ephemeral_grants,
+}
+
 # device-resident pipeline A/B (ISSUE 7): same contract as CACHE_CONFIGS
 PIPELINE_CONFIGS = {
     "pipeline-depth": bench_pipeline_depth,
@@ -1415,13 +1644,40 @@ CONFIGS = {
 }
 
 
+def _config_registry() -> dict:
+    """Every runnable --config, grouped; the source of truth for both
+    validation and the unknown-config listing."""
+    return {
+        "workload sweep (CONFIGS)": list(CONFIGS),
+        "decision cache": list(CACHE_CONFIGS),
+        "durable store": list(PERSIST_CONFIGS),
+        "device pipeline": list(PIPELINE_CONFIGS),
+        "replication": list(REPLICATION_CONFIGS),
+        "scenario matrix": list(SCENARIO_CONFIGS),
+    }
+
+
+def _reject_unknown_config(name: str) -> None:
+    """Unknown --config: print the grouped registry and exit 2 (never a
+    traceback — ISSUE 12 satellite)."""
+    groups = _config_registry()
+    if any(name in names for names in groups.values()):
+        return
+    print(f"bench.py: unknown --config {name!r}; registered configs:",
+          file=sys.stderr)
+    for group, names in groups.items():
+        print(f"  {group}:", file=sys.stderr)
+        for n in names:
+            print(f"    {n}", file=sys.stderr)
+    sys.exit(2)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="multitenant-1m",
-                    choices=(list(CONFIGS) + list(CACHE_CONFIGS)
-                             + list(PERSIST_CONFIGS)
-                             + list(PIPELINE_CONFIGS)
-                             + list(REPLICATION_CONFIGS)))
+                    metavar="NAME",
+                    help="one of the registered configs (an unknown "
+                         "name prints the grouped registry and exits 2)")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--oracle-queries", type=int, default=2)
@@ -1454,6 +1710,7 @@ def main() -> None:
                          "concurrent dispatcher path")
     ap.add_argument("--replica-worker", default="", help=argparse.SUPPRESS)
     args = ap.parse_args()
+    _reject_unknown_config(args.config)
 
     if args.replica_worker:
         # replica-scale follower subprocess: no probe, no watchdog —
@@ -1546,6 +1803,29 @@ def main() -> None:
               "platform": _STATE["platform"],
               "baseline": "single follower aggregate filtered-list "
                           "throughput (same churn, same graph)",
+              **res})
+        return
+
+    if args.config in SCENARIO_CONFIGS:
+        # standalone scenario config: refereed divergences must be 0;
+        # the headline value is the device check throughput under churn
+        stage(f"scenario config {args.config}")
+        tel_before = devtel_snapshot()
+        tl_mark = timeline_mark()
+        res = SCENARIO_CONFIGS[args.config](args)
+        tel = devtel_delta(tel_before)
+        if tel:
+            res["device_telemetry"] = tel
+        tl_sum = timeline_summary(tl_mark)
+        if tl_sum:
+            res["timeline_summary"] = tl_sum
+        res.update(timeline_headline(tl_sum))
+        _STATE["metric"] = f"scenario {args.config}"
+        emit({"metric": _STATE["metric"],
+              "value": res.get("checks_per_s", 0.0), "unit": "checks/s",
+              "platform": _STATE["platform"],
+              "baseline": "host-oracle referee over the same store "
+                          "(parity acceptance: divergences == 0)",
               **res})
         return
 
@@ -1758,7 +2038,8 @@ def main() -> None:
         # too (hit rate, on/off speedup, churn divergences, and the
         # restart time-to-serve + WAL write-overhead columns)
         for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS,
-                         **PIPELINE_CONFIGS, **REPLICATION_CONFIGS}.items():
+                         **PIPELINE_CONFIGS, **REPLICATION_CONFIGS,
+                         **SCENARIO_CONFIGS}.items():
             try:
                 tel_before = devtel_snapshot()
                 tl_mark = timeline_mark()

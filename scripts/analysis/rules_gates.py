@@ -34,6 +34,10 @@ GATED_MODULES = (
     ("utils/devtel.py", "DeviceTelemetry"),
     ("spicedb/decision_cache.py", "DecisionCache"),
     ("spicedb/persist/", "DurableStore"),
+    # the differential fuzz harness's authz_fuzz_* recording helpers
+    # (the generators/driver/shrinker themselves are offline tooling
+    # with no subsystem state to gate)
+    ("fuzz/metrics.py", "FuzzTelemetry"),
 )
 
 _MUTATOR_METHODS = ("inc", "observe", "dec")

@@ -49,6 +49,19 @@ echo "== decision-cache coherence smoke (deterministic, CPU, small sizes)"
 JAX_PLATFORMS=cpu python -m pytest tests/test_decision_cache.py -q \
     -p no:cacheprovider -k "coherence or Footprint or Invalidation"
 
+echo "== differential fuzz smoke (25 fixed seeds x 3 gate combos x 3"
+echo "   replication roles, jax:// vs host oracle)"
+# seeded, deterministic, time-boxed (docs/fuzzing.md): random schemas +
+# random delta streams replayed against the device kernels AND the
+# recursive oracle at pinned revisions, as leader / 2-hop follower
+# chain / promoted leader, across the DecisionCache x DevicePipeline x
+# AsyncRebuild killswitch matrix.  Any divergence anywhere in that
+# matrix fails HERE with a shrunken repro artifact + one-line seed.
+# Runs even with --fast.  (~12s with a warm /tmp XLA cache, ~20s cold;
+# an injected-bug tripwire for the harness itself lives in
+# tests/test_fuzz.py::TestMutationCheck.)
+python scripts/fuzz_smoke.py
+
 echo "== crash-recovery smoke (kill -9 mid write-churn, restart, parity)"
 # the durable store must never lose an acked write: fsync=always child,
 # SIGKILL mid-churn, recover on the same data dir, compare against an

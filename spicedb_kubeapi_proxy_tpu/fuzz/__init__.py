@@ -1,0 +1,40 @@
+"""Seeded differential fuzzing harness (docs/fuzzing.md).
+
+Three parts, one contract: a seed integer fully determines a
+(schema, delta-stream, query-stream) triple, the triple replays
+identically against the `jax://` device kernels and the host oracle at
+pinned revisions, and any answer mismatch anywhere in the gate matrix
+(DecisionCache x DevicePipeline x AsyncRebuild) or the replication role
+matrix (leader / 2-hop follower chain / promoted leader) surfaces as a
+one-line reproducible seed that shrinks to a self-contained artifact.
+
+- `schema_gen`   random schemas (bounded-depth rewrites, arrows,
+  intersections/exclusions, wildcards, CEL caveats, expiring
+  relations), biased toward deep/entangled closures via
+  `relation_footprint`
+- `delta_gen`    random delta streams (writes, deletes,
+  delete_by_filter, bulk loads, TTL churn against a FAKE clock,
+  wildcard flips, plane-less caveats that force quarantine/rebuild)
+- `driver`       the differential replay across gates x roles
+- `shrink`       delta-stream minimizer + repro artifacts
+- `scenarios`    the three first-class bench scenario workloads
+  (caveat-heavy / wildcard-public / ephemeral-grants) + fuzz biases
+- `metrics`      `authz_fuzz_*` counters (FuzzTelemetry gate)
+"""
+
+from .driver import (  # noqa: F401
+    GATE_COMBOS,
+    ROLES,
+    Divergence,
+    FuzzCase,
+    build_case,
+    run_case,
+    smoke_cell_for,
+)
+from .schema_gen import generate_schema  # noqa: F401
+from .shrink import (  # noqa: F401
+    load_artifact,
+    replay_artifact,
+    shrink_case,
+    write_artifact,
+)

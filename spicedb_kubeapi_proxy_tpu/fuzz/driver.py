@@ -1,0 +1,439 @@
+"""Differential replay driver: jax:// vs the host oracle, swept across
+the gate matrix and the replication-role matrix.
+
+One `FuzzCase` (fully derived from its seed) replays against a device
+endpoint built over the store a REPLICATION ROLE produces:
+
+- `leader`      deltas land via `store.write` / `delete_by_filter` /
+                `bulk_load` — the single-node path;
+- `follower2`   a plain leader store fans every committed batch through
+                TWO `apply_replica_batch` hops (leader -> mid -> leaf,
+                the PR 9/11 chain shape); leader bulk loads / resets
+                re-bootstrap each hop via `replica_reset` — the device
+                graph, decision-cache epochs, and expiry heaps on the
+                LEAF must follow through the replica delta pipeline;
+- `promoted`    a 1-hop follower consumes the first half of the stream,
+                then promotes: the remaining bursts are written
+                DIRECTLY to the promoted store (the post-
+                `/replication/promote` serving shape).
+
+After every burst, every query in the case's query stream is answered
+by the device endpoint (optionally behind a DecisionCacheEndpoint) and
+by a fresh `Evaluator` over the SAME store — both at the same pinned
+revision (the driver is single-threaded; no concurrent writers).  Any
+mismatch is a `Divergence`.
+
+Gate combos (the killswitch matrix of PRs 3/7/8):
+
+- `off`    DecisionCache / DevicePipeline / AsyncRebuild all OFF
+           (the bare serial kernel path);
+- `cache`  DecisionCache ON (wrapper constructed), pipeline OFF,
+           AsyncRebuild OFF — cache coherence against the oracle;
+- `full`   all three ON — the production chain.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from dataclasses import dataclass, field
+
+from ..spicedb import schema as sch
+from ..spicedb.evaluator import Evaluator
+from ..spicedb.store import TupleStore
+from ..spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+from ..utils.features import GATES
+from . import metrics as fuzz_metrics
+from .delta_gen import (
+    DEFAULT_DELTA_BIAS,
+    FakeClock,
+    generate_bursts,
+    id_universe,
+    initial_rels,
+)
+from .schema_gen import DEFAULT_BIAS, generate_schema
+
+import random
+
+GATE_COMBOS = {
+    "off": {"DecisionCache": False, "DevicePipeline": False,
+            "AsyncRebuild": False},
+    "cache": {"DecisionCache": True, "DevicePipeline": False,
+              "AsyncRebuild": False},
+    "full": {"DecisionCache": True, "DevicePipeline": True,
+             "AsyncRebuild": True},
+}
+
+ROLES = ("leader", "follower2", "promoted")
+
+SMOKE_KERNELS = ("segment", "ell")
+
+
+def smoke_cell_for(seed: int) -> tuple:
+    """The fixed (gates, role, kernel) cell a smoke seed lands in: the
+    seed index walks the 3x3 gate x role matrix (so 25 seeds cover
+    every cell >= 2x) with the kernel alternating on top.  Shared by
+    scripts/fuzz_smoke.py and the mutation-check tests so 'the fixed
+    seed set' means one thing."""
+    return (tuple(GATE_COMBOS)[seed % 3], ROLES[(seed // 3) % 3],
+            SMOKE_KERNELS[seed % 2])
+
+
+_P3 = {"NO_PERMISSION": 0, "CONDITIONAL_PERMISSION": 1, "HAS_PERMISSION": 2}
+
+
+@dataclass
+class FuzzCase:
+    """Everything a replay needs; serializes to the repro artifact."""
+    seed: int
+    schema_text: str
+    init_rels: list           # rel strings, bulk-loaded at revision 1
+    bursts: list              # serialized delta stream (delta_gen format)
+    targets: list             # [(resource_type, permission), ...]
+    subjects: list            # subject id strings ("user:u1")
+    kernel: str = "ell"
+    schema: sch.Schema = field(default=None, repr=False, compare=False)
+
+    def parsed_schema(self) -> sch.Schema:
+        if self.schema is None:
+            self.schema = sch.parse_schema(self.schema_text)
+        return self.schema
+
+
+@dataclass
+class Divergence:
+    seed: int
+    gates: str
+    role: str
+    kernel: str
+    step: int                 # burst index the divergence was seen after
+    query: dict               # {"kind": "check"|"lookup", ...}
+    got: object               # device-side answer
+    want: object              # oracle answer
+    revision: int
+
+    def line(self) -> str:
+        return (f"DIVERGENCE seed={self.seed} gates={self.gates} "
+                f"role={self.role} kernel={self.kernel} step={self.step} "
+                f"rev={self.revision} query={self.query} "
+                f"jax={self.got!r} oracle={self.want!r}")
+
+
+def build_case(seed: int, schema_bias=DEFAULT_BIAS,
+               delta_bias=DEFAULT_DELTA_BIAS, kernel: str = "ell",
+               n_bursts: int = None, smoke: bool = False) -> FuzzCase:
+    """Derive the full (schema, deltas, queries) triple from `seed`.
+
+    `smoke=True` is the check.sh profile: the same generator universe
+    but trimmed replay cost (shorter stream, ONE deepest-footprint
+    target, 2 subjects + the stranger) so
+    the fixed-seed matrix fits the smoke time box; the open-ended
+    budgeted search runs the full-size profile."""
+    rng = random.Random(seed * 2_654_435_761 % (2 ** 31))
+    if smoke and schema_bias is DEFAULT_BIAS:
+        from .schema_gen import SMOKE_BIAS
+        schema_bias = SMOKE_BIAS
+    text, schema = generate_schema(seed, bias=schema_bias)
+    clock = FakeClock()
+    ids = id_universe(schema, rng)
+    init = initial_rels(schema, rng, clock, ids, delta_bias,
+                        rng.randint(6, 18))
+    if n_bursts is None:
+        n_bursts = rng.randint(2, 3) if smoke else rng.randint(3, 6)
+    bursts = generate_bursts(schema, rng, clock, ids, delta_bias, n_bursts)
+    # query targets: every (type, permission) pair, deepest closures
+    # first (relation_footprint bias), capped for replay cost
+    from ..ops.graph_compile import relation_footprint
+    pairs = [(tname, pname)
+             for tname, d in schema.definitions.items()
+             for pname in d.permissions]
+    pairs.sort(key=lambda p: (-len(relation_footprint(schema, *p)), p))
+    targets = pairs[:1 if smoke else 3]
+    user_ids = ids.get("user", [])
+    subjects = [f"user:{u}" for u in
+                rng.sample(user_ids, min(2 if smoke else 3, len(user_ids)))]
+    subjects.append("user:stranger")
+    return FuzzCase(seed=seed, schema_text=text, init_rels=init,
+                    bursts=bursts, targets=targets, subjects=subjects,
+                    kernel=kernel, schema=schema)
+
+
+@contextlib.contextmanager
+def gates_set(combo: str):
+    flags = GATE_COMBOS[combo]
+    prev = {k: GATES.enabled(k) for k in flags}
+    for k, v in flags.items():
+        GATES.set(k, v)
+    try:
+        yield
+    finally:
+        for k, v in prev.items():
+            GATES.set(k, v)
+
+
+# -- role plumbing ------------------------------------------------------------
+
+
+class _RoleHarness:
+    """Owns the store topology of one replay and routes bursts into it.
+
+    `query_store` is the store the device endpoint and the oracle both
+    read — the leaf of whatever replication chain the role builds."""
+
+    def __init__(self, role: str, clock: FakeClock, n_bursts: int):
+        self.role = role
+        self.clock = clock
+        self.leader = TupleStore(clock=clock.now)
+        self._recorded: list = []      # captured committed delta batches
+        self._leader_reset = False
+        self._promote_at = n_bursts // 2 if role == "promoted" else None
+        self._promoted = False
+        if role == "leader":
+            self.query_store = self.leader
+            self.hops = []
+        elif role == "follower2":
+            self.hops = [TupleStore(clock=clock.now),
+                         TupleStore(clock=clock.now)]
+            self.query_store = self.hops[-1]
+        elif role == "promoted":
+            self.hops = [TupleStore(clock=clock.now)]
+            self.query_store = self.hops[-1]
+        else:
+            raise ValueError(f"unknown role {role!r}")
+        if self.hops:
+            # delta listeners run under the leader store lock; recording
+            # is append-only and the driver drains OUTSIDE the lock
+            self.leader.add_delta_listener(self._record_delta)
+            self.leader.add_reset_listener(self._record_reset)
+
+    def _record_delta(self, update) -> None:
+        self._recorded.append(update.updates)
+
+    def _record_reset(self) -> None:
+        self._leader_reset = True
+
+    def _drain_into_hops(self) -> None:
+        if self._leader_reset:
+            # leader bulk-load/clear: each hop re-bootstraps from its
+            # upstream exactly like a follower losing its tail does
+            self._leader_reset = False
+            self._recorded.clear()
+            upstream = self.leader
+            for hop in self.hops:
+                hop.replica_reset(None, upstream.read(None),
+                                  upstream.revision)
+                upstream = hop
+            return
+        batches, self._recorded = self._recorded, []
+        for updates in batches:
+            for hop in self.hops:
+                hop.apply_replica_batch(updates)
+
+    def seed_initial(self, rels: list) -> None:
+        self.leader.bulk_load([parse_relationship(r) for r in rels])
+        if self.hops:
+            self._drain_into_hops()
+
+    def _writable_store(self) -> TupleStore:
+        if self._promoted:
+            return self.hops[-1]
+        return self.leader
+
+    def apply_burst(self, i: int, burst: dict) -> None:
+        if self._promote_at is not None and i >= self._promote_at:
+            if not self._promoted:
+                # promotion: stop consuming the old leader; the adopted
+                # state keeps serving and the remaining stream lands as
+                # DIRECT writes on the promoted store
+                self.leader.remove_delta_listener(self._record_delta)
+                self._recorded.clear()
+                self._promoted = True
+        store = self._writable_store()
+        kind = burst["kind"]
+        if kind == "advance":
+            self.clock.advance(burst["dt"])
+        elif kind == "write":
+            store.write([
+                RelationshipUpdate(
+                    UpdateOp.DELETE if op["op"] == "delete"
+                    else UpdateOp.TOUCH,
+                    parse_relationship(op["rel"]))
+                for op in burst["ops"]])
+        elif kind == "dbf":
+            store.delete_by_filter(RelationshipFilter(
+                resource_type=burst["resource_type"],
+                relation=burst["relation"],
+                resource_id=burst["resource_id"]))
+        elif kind == "bulk":
+            store.bulk_load([parse_relationship(r)
+                             for r in burst["rels"]])
+        else:
+            raise ValueError(f"unknown burst kind {kind!r}")
+        if self.hops and not self._promoted:
+            self._drain_into_hops()
+
+
+# -- the replay ---------------------------------------------------------------
+
+
+def _parse_subject(s: str) -> SubjectRef:
+    stype, _, rest = s.partition(":")
+    sid, _, srel = rest.partition("#")
+    return SubjectRef(stype, sid, srel)
+
+
+async def _compare_queries(case: FuzzCase, ep, oracle, step: int,
+                           gates: str, role: str,
+                           check_only: dict = None) -> list:
+    """Run the query stream; return Divergences.  `check_only` restricts
+    to one serialized query (the shrinker's single-query probe)."""
+    out = []
+    store = oracle.store
+    rev = store.revision
+    if check_only is not None:
+        # single-query probe (the shrinker): evaluate exactly this query
+        # against the end state, independent of id enumeration
+        q = check_only
+        subject = _parse_subject(q["subject"])
+        if q["kind"] == "lookup":
+            want = sorted(oracle.lookup_resources(q["type"], q["perm"],
+                                                  subject))
+            got = sorted(await ep.lookup_resources(q["type"], q["perm"],
+                                                   subject))
+            if got != want:
+                out.append(Divergence(case.seed, gates, role, case.kernel,
+                                      step, q, got, want, rev))
+        else:
+            rt, _, oid = q["resource"].partition(":")
+            res = await ep.check_bulk_permissions(
+                [CheckRequest(ObjectRef(rt, oid), q["perm"], subject)])
+            got3 = _P3[res[0].permissionship.name]
+            want3 = oracle.check3(ObjectRef(rt, oid), q["perm"], subject)
+            if got3 != want3:
+                out.append(Divergence(case.seed, gates, role, case.kernel,
+                                      step, q, got3, want3, rev))
+        return out
+    for rtype, perm in case.targets:
+        for s in case.subjects:
+            subject = _parse_subject(s)
+            q = {"kind": "lookup", "type": rtype, "perm": perm,
+                 "subject": s}
+            want = sorted(oracle.lookup_resources(rtype, perm, subject))
+            got = sorted(await ep.lookup_resources(rtype, perm, subject))
+            if got != want:
+                out.append(Divergence(case.seed, gates, role,
+                                      case.kernel, step, q, got, want,
+                                      rev))
+        ids = store.object_ids_of_type(rtype)[:12]
+        if not ids:
+            continue
+        subjects = [_parse_subject(s) for s in case.subjects]
+        wanted_queries = []
+        reqs = []
+        for oid in ids:
+            for s, subject in zip(case.subjects, subjects):
+                q = {"kind": "check", "resource": f"{rtype}:{oid}",
+                     "perm": perm, "subject": s}
+                wanted_queries.append((q, subject))
+                reqs.append(CheckRequest(ObjectRef(rtype, oid), perm,
+                                         subject))
+        res = await ep.check_bulk_permissions(reqs)
+        for (q, subject), r in zip(wanted_queries, res):
+            got3 = _P3[r.permissionship.name]
+            rt, _, oid = q["resource"].partition(":")
+            want3 = oracle.check3(ObjectRef(rt, oid), q["perm"], subject)
+            if got3 != want3:
+                out.append(Divergence(case.seed, gates, role, case.kernel,
+                                      step, q, got3, want3, rev))
+    return out
+
+
+def run_case(case: FuzzCase, gates: str = "off", role: str = "leader",
+             stop_on_first: bool = False, check_only: dict = None,
+             final_only: bool = False, checkpoints: str = "every",
+             record_metrics: bool = True) -> list:
+    """Replay one (case, gate-combo, role) cell; returns Divergences.
+
+    `checkpoints` picks where the query stream runs: "every" compares
+    after the initial load and every burst (the budgeted search);
+    "ends" compares after the initial load and the final burst only;
+    "final" warm-starts the device graph over the initial state (so the
+    stream still flows through the live intake/absorb machinery) and
+    compares once after the last burst — ONE kernel-compile set per
+    cell, which is what lets the fixed-seed smoke matrix fit its time
+    box.
+
+    `final_only` + `check_only` are the shrinker's probe mode: apply the
+    whole stream, then evaluate one query once at the end state."""
+    from ..ops.jax_endpoint import JaxEndpoint
+
+    schema = case.parsed_schema()
+    clock = FakeClock()
+    harness = _RoleHarness(role, clock, len(case.bursts))
+    divergences: list = []
+
+    with gates_set(gates):
+        harness.seed_initial(case.init_rels)
+        ep = JaxEndpoint(schema, store=harness.query_store,
+                         kernel=case.kernel)
+        if GATE_COMBOS[gates]["DecisionCache"]:
+            from ..spicedb.decision_cache import DecisionCacheEndpoint
+            ep = DecisionCacheEndpoint(ep)
+        oracle = Evaluator(schema, harness.query_store)
+
+        async def replay():
+            last = len(case.bursts) - 1
+            if final_only or checkpoints == "final":
+                # build the device graph over the initial state WITHOUT
+                # compiling query kernels: the delta stream must flow
+                # through a live graph's intake/absorb machinery, not be
+                # absorbed into a fresh build at the final query
+                ep.warm_start()
+            else:
+                divergences.extend(await _compare_queries(
+                    case, ep, oracle, -1, gates, role,
+                    check_only=check_only))
+                if divergences and stop_on_first:
+                    return
+            for i, burst in enumerate(case.bursts):
+                harness.apply_burst(i, burst)
+                if i < last and (final_only
+                                 or checkpoints in ("ends", "final")):
+                    continue
+                divergences.extend(await _compare_queries(
+                    case, ep, oracle, i, gates, role,
+                    check_only=check_only))
+                if divergences and stop_on_first:
+                    return
+            if not case.bursts and (final_only or checkpoints == "final"):
+                # degenerate empty-stream case (a shrunk repro can be
+                # init-rels-only): the end state IS the initial state —
+                # compare it rather than vacuously passing
+                divergences.extend(await _compare_queries(
+                    case, ep, oracle, -1, gates, role,
+                    check_only=check_only))
+
+        asyncio.run(replay())
+        wait = getattr(ep, "wait_rebuilds", None)
+        if wait is not None:
+            wait()
+    if record_metrics:
+        # shrink probes pass record_metrics=False: a single failing case
+        # must count ONE divergence, not one per still-reproducing probe
+        fuzz_metrics.note_case(diverged=bool(divergences))
+    return divergences
+
+
+def run_seed(seed: int, gates: str = "off", role: str = "leader",
+             kernel: str = "ell") -> list:
+    """Convenience: build + replay one cell for a bare seed."""
+    case = build_case(seed, kernel=kernel)
+    return run_case(case, gates=gates, role=role)

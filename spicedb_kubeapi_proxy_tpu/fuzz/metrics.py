@@ -1,0 +1,40 @@
+"""`authz_fuzz_*` counters (FuzzTelemetry gate; docs/observability.md).
+
+The harness is an offline tool, but its runs ride the same metrics
+registry the server exports so a long `--budget-seconds` campaign can
+be scraped/snapshotted like any other workload.  The `FuzzTelemetry`
+gate is the killswitch: off, every recording helper is inert (analyzer
+rule A004 enforces the dominating check — this module is registered in
+scripts/analysis/rules_gates.py)."""
+
+from __future__ import annotations
+
+from ..utils import metrics as m
+from ..utils.features import GATES
+
+_cases = m.REGISTRY.counter(
+    "authz_fuzz_cases_total",
+    "Differential fuzz (case, gate-combo, role) cells replayed")
+_divergences = m.REGISTRY.counter(
+    "authz_fuzz_divergences_total",
+    "Fuzz replays that produced >=1 jax-vs-oracle divergence")
+_shrink_probes = m.REGISTRY.counter(
+    "authz_fuzz_shrink_probes_total",
+    "Replay probes spent minimizing failing delta streams")
+
+
+def fuzz_telemetry_enabled() -> bool:
+    return GATES.enabled("FuzzTelemetry")
+
+
+def note_case(diverged: bool) -> None:
+    if not fuzz_telemetry_enabled():
+        return
+    _cases.inc()
+    if diverged:
+        _divergences.inc()
+
+
+def note_shrink_probe() -> None:
+    if fuzz_telemetry_enabled():
+        _shrink_probes.inc()

@@ -17,6 +17,17 @@ cache invalidates by — so the lint is cheap:
   SL004 (warn)   unreachable relation: no permission's footprint
                  includes it and no rule template reads it directly —
                  tuples written to it can never influence a decision
+  SL005 (error)  caveated relation references an undefined caveat name:
+                 a rule template writes `[caveat:name:...]` (or a
+                 programmatically-built schema annotates `with name`)
+                 for a caveat the schema never declares — every such
+                 write fails at runtime
+  SL006 (warn)   relation only reachable through an expiring path:
+                 every route from a permission to it crosses a
+                 `with expiration` subject annotation, so once those
+                 expiring tuples lapse its tuples can never influence
+                 a decision again (the PAuth ephemeral-grant footgun:
+                 durable grants parked behind ephemeral indirection)
 
 Proxy-internal definitions (lock / workflow / activity — the dual-write
 engine's bookkeeping, spicedb/endpoints.py INTERNAL_SCHEMA) are exempt
@@ -45,6 +56,9 @@ _TPL_RE = re.compile(
     r"#(?P<rel>[A-Za-z0-9_]+)"
     r"@(?P<stype>[A-Za-z0-9_/]+):(?P<sid>[^#]*)"
     r"(?:#(?P<srel>[A-Za-z0-9_*]+))?$")
+
+# `[caveat:name]` / `[caveat:name:{...}]` suffixes on rule templates
+_TPL_CAVEAT_RE = re.compile(r"\[caveat:([A-Za-z_][\w/]*)")
 
 
 @dataclass
@@ -95,13 +109,65 @@ def _parse_template(tpl: str):
             mm.group("srel") or "")
 
 
+def _nonexpiring_reachable(schema: sch.Schema) -> set:
+    """(type, relation) pairs reachable from ANY permission without
+    crossing a `with expiration` subject annotation — the complement
+    (vs the full footprint union) is SL006's warning set."""
+    seen: set = set()
+    rels: set = set()
+    stack: list = [(t, p) for t, d in schema.definitions.items()
+                   for p in d.permissions]
+
+    def push_expr(t: str, d: sch.Definition, e: sch.Expr) -> None:
+        if isinstance(e, sch.RelRef):
+            stack.append((t, e.name))
+        elif isinstance(e, sch.Arrow):
+            stack.append((t, e.left))
+            for ref in d.relations.get(e.left, ()):
+                if "expiration" not in ref.traits:
+                    stack.append((ref.type, e.target))
+        elif isinstance(e, (sch.Union, sch.Intersection)):
+            for c in e.children:
+                push_expr(t, d, c)
+        elif isinstance(e, sch.Exclusion):
+            push_expr(t, d, e.base)
+            push_expr(t, d, e.subtract)
+
+    while stack:
+        node = stack.pop()
+        if node in seen:
+            continue
+        seen.add(node)
+        t, n = node
+        d = schema.definitions.get(t)
+        if d is None:
+            continue
+        if n in d.relations:
+            rels.add((t, n))
+            for ref in d.relations[n]:
+                if ref.relation and "expiration" not in ref.traits:
+                    stack.append((ref.type, ref.relation))
+            continue
+        expr = d.permissions.get(n)
+        if expr is not None:
+            push_expr(t, d, expr)
+    return rels
+
+
 def lint_schema(schema: sch.Schema, rule_configs=()) -> list:
     """Run every lint pass; returns Findings (errors first)."""
     findings: list = []
     referenced: set = set()  # (type, relation) pairs rules read directly
 
-    # -- SL001/SL002: rule templates vs the schema ---------------------------
+    # -- SL001/SL002/SL005: rule templates vs the schema ---------------------
     for rule_name, tpl in _iter_rule_templates(rule_configs or ()):
+        for cav_name in _TPL_CAVEAT_RE.findall(tpl):
+            if cav_name not in schema.caveats:
+                findings.append(Finding(
+                    "SL005", "error", f"rule {rule_name}",
+                    f"template {tpl!r} writes caveat {cav_name!r}, but "
+                    f"the schema declares no such caveat — every write "
+                    f"through this rule fails validation"))
         parsed = _parse_template(tpl)
         if parsed is None:
             continue  # not a single-relationship template; nothing to check
@@ -138,6 +204,21 @@ def lint_schema(schema: sch.Schema, rule_configs=()) -> list:
         elif srel and srel != "*":
             referenced.add((stype, srel))
 
+    # -- SL005 (schema side): annotated caveats must exist -------------------
+    # the parser rejects these, but schemas can also be BUILT (merged
+    # internal definitions, programmatic IR) — lint re-checks the
+    # invariant so --lint-schema holds for every construction path
+    for tname, d in sorted(schema.definitions.items()):
+        for rname in sorted(d.relations):
+            for ref in d.relations[rname]:
+                for trait in ref.traits:
+                    if trait != "expiration" and trait not in schema.caveats:
+                        findings.append(Finding(
+                            "SL005", "error", f"{tname}#{rname}",
+                            f"relation {tname}#{rname} annotates subject "
+                            f"{ref.type!r} with caveat {trait!r}, but the "
+                            f"schema declares no such caveat"))
+
     # -- footprints ----------------------------------------------------------
     reachable: set = set()  # (type, relation) influencing some permission
     for tname, d in sorted(schema.definitions.items()):
@@ -150,6 +231,21 @@ def lint_schema(schema: sch.Schema, rule_configs=()) -> list:
                     f"permission {tname}#{pname} has an empty relation "
                     f"footprint: no tuple can ever grant it (statically "
                     f"DENY for every subject)"))
+
+    # -- SL006: relations only reachable through an expiring path ------------
+    nonexpiring = _nonexpiring_reachable(schema)
+    for tname, rname in sorted(reachable - nonexpiring):
+        if tname in INTERNAL_TYPES:
+            continue
+        if rname not in schema.definitions.get(
+                tname, sch.Definition(tname)).relations:
+            continue
+        findings.append(Finding(
+            "SL006", "warn", f"{tname}#{rname}",
+            f"relation {tname}#{rname} is only reachable through an "
+            f"expiring path: every route from a permission to it crosses "
+            f"a `with expiration` annotation, so once those tuples lapse "
+            f"its tuples can no longer influence any decision"))
 
     # a relation is also "used" when another relation's subject
     # annotation names it (`viewer: group#member` keeps group#member live)

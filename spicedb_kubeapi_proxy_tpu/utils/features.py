@@ -127,6 +127,11 @@ GATES.register("AdmissionControl", stage=BETA, default=True)
 # routes are not served and a configured --replicate-from is inert —
 # exactly today's single-node behavior.
 GATES.register("Replication", stage=ALPHA, default=True)
+# differential fuzz-harness telemetry (fuzz/metrics.py): authz_fuzz_*
+# counters recorded by the offline harness (scripts/fuzz_smoke.py,
+# budgeted campaigns).  This gate is the killswitch for the recording
+# helpers; off, fuzz runs tick nothing.
+GATES.register("FuzzTelemetry", stage=ALPHA, default=True)
 
 
 def pipeline_enabled() -> bool:

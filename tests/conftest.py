@@ -18,3 +18,11 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tiers (budgeted fuzz search, full-profile "
+        "differential replays) — excluded from the tier-1 gate via "
+        "-m 'not slow'")

@@ -576,3 +576,151 @@ def test_follower_drives_watch_and_delta_pipeline(tmp):
         watcher.close()
 
     asyncio.run(go())
+
+
+# -- PR 3 x PR 9 seam: expiry-driven invalidation on a REPLICA ----------------
+# (ISSUE 12 satellite) An expiring tuple that arrived via the replica
+# delta pipeline — apply_replica_batch, or wholesale via replica_reset
+# (re-bootstrap) — must invalidate cached decision frontiers at its
+# expiry INSTANT on the follower, exactly as a leader-local write would:
+# the expiry heaps (decision cache + device graph) must be reseeded by
+# both replica paths, not just by store.write.
+
+EXPIRY_SCHEMA = """
+definition user {}
+definition namespace {
+  relation viewer: user | user with expiration
+  relation creator: user
+  permission view = viewer + creator
+}
+"""
+
+
+def _expiring(ns: str, user: str, at: float) -> RelationshipUpdate:
+    return RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+        f"namespace:{ns}#viewer@user:{user}[expiration:{at}]"))
+
+
+def test_replica_expiry_invalidates_cached_frontier_apply_batch():
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.decision_cache import (
+        DecisionCacheEndpoint)
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+    t = [1_700_000_000.0]
+    leaf = TupleStore(clock=lambda: t[0])
+    schema = sch.parse_schema(EXPIRY_SCHEMA)
+    ep = DecisionCacheEndpoint(EmbeddedEndpoint(schema, store=leaf))
+    alice = SubjectRef("user", "alice")
+
+    async def go():
+        # the replica applies a leader batch carrying a 10s grant — the
+        # ONLY route the expiry instant has onto this node's heaps
+        leaf.apply_replica_batch([
+            _expiring("ns1", "alice", t[0] + 10.0),
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns2#creator@user:alice")),
+        ])
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == ["ns1", "ns2"]
+        # warm: the second list is served from the cache
+        again = await ep.lookup_resources("namespace", "view", alice)
+        assert getattr(again, "source", "") == "cache"
+        # the clock crosses the expiry instant with NO further delta:
+        # a heap that apply_replica_batch failed to seed would keep the
+        # cached frontier "valid" and serve ns1 forever
+        t[0] += 20.0
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == ["ns2"]
+        assert ep.cache.stats["invalidations"] >= 1
+
+    asyncio.run(go())
+
+
+def test_replica_expiry_invalidates_cached_frontier_after_rebootstrap():
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.decision_cache import (
+        DecisionCacheEndpoint)
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+    t = [1_700_000_000.0]
+    leaf = TupleStore(clock=lambda: t[0])
+    schema = sch.parse_schema(EXPIRY_SCHEMA)
+    ep = DecisionCacheEndpoint(EmbeddedEndpoint(schema, store=leaf))
+    alice = SubjectRef("user", "alice")
+
+    async def go():
+        # pre-bootstrap state, cache warmed on it
+        leaf.apply_replica_batch([RelationshipUpdate(
+            UpdateOp.TOUCH,
+            parse_relationship("namespace:ns9#creator@user:alice"))])
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == ["ns9"]
+        # re-bootstrap (reclaimed-tail path): the adopted checkpoint
+        # carries an expiring grant the delta listener NEVER saw — only
+        # the post-reset expiry_schedule() rescan can seed its instant
+        leaf.replica_reset(
+            None,
+            [parse_relationship(
+                f"namespace:ns1#viewer@user:alice"
+                f"[expiration:{t[0] + 10.0}]"),
+             parse_relationship("namespace:ns2#creator@user:alice")],
+            revision=50)
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == ["ns1", "ns2"]
+        t[0] += 20.0
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == ["ns2"]
+
+    asyncio.run(go())
+
+
+def test_replica_expiry_reseeds_device_graph_heap():
+    """Same seam, device side: a jax:// endpoint serving a FOLLOWER
+    store must lazily expire tuples that arrived via apply_replica_batch
+    and via replica_reset — the graph's own expiry heap is fed by the
+    replica delta pipeline, not only by leader-local writes."""
+    import os
+    if os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"):
+        pytest.skip("CPU-only determinism test")
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+
+    t = [1_700_000_000.0]
+    leaf = TupleStore(clock=lambda: t[0])
+    schema = sch.parse_schema(EXPIRY_SCHEMA)
+    ep = JaxEndpoint(schema, store=leaf)
+    alice = SubjectRef("user", "alice")
+
+    async def go():
+        leaf.apply_replica_batch([
+            _expiring("ns1", "alice", t[0] + 10.0),
+            RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                "namespace:ns2#creator@user:alice")),
+        ])
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == ["ns1", "ns2"]
+        t[0] += 20.0
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == ["ns2"]
+        # re-bootstrap with a fresh expiring grant: reset -> rebuild ->
+        # expiry reseed from the adopted store
+        leaf.replica_reset(
+            None,
+            [parse_relationship(
+                f"namespace:ns3#viewer@user:alice"
+                f"[expiration:{t[0] + 10.0}]")],
+            revision=90)
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == ["ns3"]
+        t[0] += 20.0
+        assert sorted(await ep.lookup_resources(
+            "namespace", "view", alice)) == []
+
+    asyncio.run(go())

@@ -160,3 +160,115 @@ def test_cli_lint_schema_verb(tmp_path, capsys):
     assert rc == 1
     # the built-in default schema lints clean of errors
     assert cli_main(["--lint-schema"]) == 0
+
+
+# -- SL005: undefined caveat names (ISSUE 12 satellite) -----------------------
+
+CAVEAT_SCHEMA = """
+caveat within_quota(used int, quota int) { used < quota }
+definition user {}
+definition doc {
+  relation viewer: user | user with within_quota
+  permission view = viewer
+}
+"""
+
+RULES_CAVEAT_BAD = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: grant-caveated}
+match: [{apiVersion: v1, resource: docs, verbs: [create]}]
+update:
+  touches:
+  - tpl: 'doc:{{name}}#viewer@user:{{user.name}}[caveat:no_such_caveat:{"used": 1}]'
+"""
+
+RULES_CAVEAT_OK = RULES_CAVEAT_BAD.replace("no_such_caveat", "within_quota")
+
+
+def test_sl005_rule_template_undefined_caveat():
+    schema = sch.parse_schema(CAVEAT_SCHEMA)
+    findings = lint_schema(schema, proxyrule.parse(RULES_CAVEAT_BAD))
+    sl005 = [f for f in findings if f.code == "SL005"]
+    assert len(sl005) == 1
+    assert sl005[0].severity == "error"
+    assert "no_such_caveat" in sl005[0].message
+    assert sl005[0].where == "rule grant-caveated"
+    # the same template naming a DECLARED caveat is clean
+    ok = lint_schema(schema, proxyrule.parse(RULES_CAVEAT_OK))
+    assert not [f for f in ok if f.code == "SL005"]
+
+
+def test_sl005_programmatic_schema_undefined_caveat():
+    """The parser rejects `with ghost`, but a programmatically-built
+    schema IR can still carry it — lint re-checks the invariant."""
+    schema = sch.parse_schema("""
+definition user {}
+definition doc {
+  relation viewer: user
+  permission view = viewer
+}
+""")
+    schema.definitions["doc"].relations["viewer"].append(
+        sch.TypeRef(type="user", traits=("ghost",)))
+    findings = lint_schema(schema)
+    sl005 = [f for f in findings if f.code == "SL005"]
+    assert len(sl005) == 1 and sl005[0].where == "doc#viewer"
+    assert "ghost" in sl005[0].message
+
+
+# -- SL006: relations only reachable through an expiring path -----------------
+
+
+def test_sl006_expiring_only_path():
+    schema = sch.parse_schema("""
+definition user {}
+definition group { relation member: user }
+definition ns {
+  relation viewer: group#member with expiration
+  relation creator: user
+  permission view = viewer + creator
+}
+""")
+    findings = lint_schema(schema)
+    sl006 = [f for f in findings if f.code == "SL006"]
+    assert [f.where for f in sl006] == ["group#member"]
+    assert sl006[0].severity == "warn"
+    # the directly-read relations are NOT flagged (their own tuples may
+    # expire, but the relations are reachable without crossing an
+    # expiring annotation)
+    flagged = {f.where for f in sl006}
+    assert "ns#viewer" not in flagged and "ns#creator" not in flagged
+
+
+def test_sl006_alternate_durable_path_suppresses():
+    """One non-expiring route to the relation is enough: no warning."""
+    schema = sch.parse_schema("""
+definition user {}
+definition group { relation member: user }
+definition ns {
+  relation viewer: group#member with expiration
+  relation auditor: group#member
+  permission view = viewer + auditor
+}
+""")
+    assert not [f for f in lint_schema(schema) if f.code == "SL006"]
+
+
+def test_sl006_arrow_through_expiring_left():
+    """An arrow whose left relation only accepts expiring subjects
+    makes the target's whole closure expiry-gated."""
+    schema = sch.parse_schema("""
+definition user {}
+definition org {
+  relation admin: user
+}
+definition ns {
+  relation org: org with expiration
+  permission view = org->admin
+}
+""")
+    findings = lint_schema(schema)
+    sl006 = {f.where for f in findings if f.code == "SL006"}
+    assert "org#admin" in sl006
+    assert "ns#org" not in sl006
