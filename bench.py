@@ -1378,6 +1378,345 @@ def _cpu_pair_ceiling(taskset) -> float:
     return round(total / max(single, 1), 2)
 
 
+# -- partitioned write scale-out (ISSUE 15) -----------------------------------
+
+# four independent co-location classes — (kube resource, namespace-like
+# parent type, tuple type) — so a 4-shard partition map can spread them
+# 1:1 and a 2-shard map packs two classes per shard.  Every class is
+# symmetric: the per-class dual-write cost is identical, so aggregate
+# throughput differences between fleet sizes measure sharding, not
+# workload skew.
+SHARD_CLASSES = (
+    ("pods", "podns", "pod"),
+    ("configmaps", "cfgns", "configmap"),
+    ("secrets", "secns", "secret"),
+    ("services", "svcns", "service"),
+)
+
+SHARD_SCHEMA = "definition user {}\n" + "\n".join(
+    f"definition {t} {{\n  relation creator: user\n"
+    f"  permission view = creator\n}}"
+    for _res, ns, typ in SHARD_CLASSES for t in (ns, typ))
+
+_SHARD_RULE_TPL = """\
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {{name: create-{res}}}
+match: [{{apiVersion: v1, resource: {res}, verbs: [create]}}]
+lock: Optimistic
+check: [{{tpl: "{ns}:{{{{namespace}}}}#view@user:{{{{user.name}}}}"}}]
+update:
+  creates:
+  - tpl: "{typ}:{{{{namespacedName}}}}#creator@user:{{{{user.name}}}}"
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {{name: delete-{res}}}
+match: [{{apiVersion: v1, resource: {res}, verbs: [delete]}}]
+lock: Optimistic
+update:
+  deleteByFilter:
+  - tpl: "{typ}:{{{{namespacedName}}}}#$resourceRelation@$subjectType:$subjectID"
+"""
+
+SHARD_RULES = "\n---\n".join(
+    _SHARD_RULE_TPL.format(res=res, ns=ns, typ=typ)
+    for res, ns, typ in SHARD_CLASSES)
+
+SHARD_WORKER_SPEC = {
+    "measure_s": 4.0, "inflight": 6, "wal_fsync": "always",
+}
+
+
+def shard_leader_worker(spec_json: str) -> None:
+    """`bench.py --shard-worker <spec-json>` subprocess: ONE shard
+    leader — an unmodified embedded proxy (rules engine, dual-write
+    workflow engine, its own WAL under `data_dir` with the spec'd fsync
+    policy) taking kube-style create/delete dual-writes through the
+    in-process client, exactly the per-shard write path behind the
+    router (spicedb/sharding/router.py).  Protocol on stdio: READY
+    after warm; each `RUN {"tag":..,"resources":[..]}` line runs one
+    measured churn window over those resources and prints
+    `DONE <json>`; `EXIT` quits.  A separate pinned process per shard
+    leader is the point: each has its own GIL, event loop, and WAL —
+    the deployment unit the partition map scales."""
+    import asyncio
+
+    spec = json.loads(spec_json)
+    from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import FakeKubeApiServer
+    from spicedb_kubeapi_proxy_tpu.proxy.httpcore import HandlerTransport
+    from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import parse_relationship
+
+    kube = FakeKubeApiServer()
+    kube.seed("", "v1", "namespaces", {"metadata": {"name": "team-a"}})
+    opts = Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SHARD_SCHEMA),
+        rules_yaml=SHARD_RULES,
+        upstream_transport=HandlerTransport(kube),
+        workflow_database_path="",  # in-memory dual-write journal
+    )
+    opts.data_dir = spec["data_dir"]
+    opts.wal_fsync = spec["wal_fsync"]
+    proxy = ProxyServer(opts)
+    if proxy.endpoint.store.revision == 0:
+        proxy.endpoint.store.bulk_load([
+            parse_relationship(f"{ns}:team-a#creator@user:alice")
+            for _res, ns, _typ in SHARD_CLASSES])
+    proxy.enable_dual_writes()
+    client = proxy.get_embedded_client(user="alice")
+    ident = spec["identity"]
+
+    async def one_create(res: str, name: str) -> float:
+        t0 = time.perf_counter()
+        resp = await client.post(
+            f"/api/v1/namespaces/team-a/{res}",
+            {"apiVersion": "v1", "metadata": {"name": name,
+                                              "namespace": "team-a"}})
+        assert resp.status in (200, 201), (res, name, resp.status,
+                                           resp.body)
+        return time.perf_counter() - t0
+
+    async def one_delete(res: str, name: str) -> float:
+        t0 = time.perf_counter()
+        resp = await client.delete(
+            f"/api/v1/namespaces/team-a/{res}/{name}")
+        assert resp.status in (200, 404), (res, name, resp.status,
+                                           resp.body)
+        return time.perf_counter() - t0
+
+    async def window(tag: str, resources: list, seconds: float) -> dict:
+        lat: list = []
+        done = 0
+        deadline = time.perf_counter() + seconds
+
+        async def loop(lane: int):
+            nonlocal done
+            i = 0
+            recent: list = []
+            while time.perf_counter() < deadline:
+                res = resources[i % len(resources)]
+                # churn profile: 3 creates then a delete of the oldest
+                # pending create — bounded store growth, both dual-write
+                # verbs (create = check + precondition + create tuple;
+                # delete = delete-by-filter), unique names across
+                # windows via the round tag
+                if len(recent) >= 3:
+                    lat.append(await one_delete(*recent.pop(0)))
+                else:
+                    name = f"{ident}-{tag}-l{lane}-{i}"
+                    lat.append(await one_create(res, name))
+                    recent.append((res, name))
+                done += 1
+                i += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(loop(k) for k in range(spec["inflight"])))
+        elapsed = time.perf_counter() - t0
+        lat.sort()
+
+        def pct(p):
+            return round(
+                lat[min(len(lat) - 1, int(p * len(lat)))] * 1000, 3)
+
+        return {"writes": done, "elapsed_s": round(elapsed, 3),
+                "writes_per_s": round(done / elapsed, 1),
+                "p50_ms": pct(0.5), "p99_ms": pct(0.99),
+                "store_revision": proxy.endpoint.store.revision}
+
+    async def main_loop():
+        # warm every rule/template path before READY so compilation
+        # never lands inside a measured window
+        for res, _ns, _typ in SHARD_CLASSES:
+            await one_create(res, f"{ident}-warm")
+            await one_delete(res, f"{ident}-warm")
+        print("READY", flush=True)
+        loop = asyncio.get_running_loop()
+        while True:
+            line = await loop.run_in_executor(None, sys.stdin.readline)
+            if not line or line.strip() == "EXIT":
+                return
+            if line.startswith("RUN "):
+                cmd = json.loads(line[4:])
+                res = await window(cmd["tag"], cmd["resources"],
+                                   spec["measure_s"])
+                print("DONE " + json.dumps(res), flush=True)
+
+    asyncio.run(main_loop())
+
+
+def bench_write_shard_scale(args) -> dict:
+    """Partitioned write scale-out (ISSUE 15): aggregate dual-write
+    throughput + p99 at 1/2/4 shard-leader PROCESSES (shard_leader_worker
+    above — each an unmodified embedded proxy with its own WAL,
+    fsync=always, pinned to a core) under the create/delete churn
+    profile.  The parent plays the thin stateless router: it owns the
+    PartitionMap, footprint-validates the schema against it per fleet
+    size (the SL007 startup gate), and assigns each co-location class to
+    its shard — routers are horizontally scalable, so routing cost rides
+    the client, not a one-process bottleneck that would cap the thing
+    being measured.  Headline `write_shard_scaling` = 2-shard aggregate
+    over 1-shard (acceptance >= 1.5x — same hardware ceiling caveat as
+    replica-scale: scaling cannot exceed the box's measured pair
+    ceiling, recorded alongside)."""
+    import shutil
+    import tempfile
+
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+        merge_internal_definitions,
+    )
+    from spicedb_kubeapi_proxy_tpu.spicedb.sharding import PartitionMap
+
+    spec = dict(SHARD_WORKER_SPEC)
+    fleet_sizes = (1, 2, 4)
+    schema = merge_internal_definitions(sch.parse_schema(SHARD_SCHEMA))
+
+    # the partition maps the parent-as-router would serve each fleet
+    # with: class c -> shard c % n.  Footprint-validate each one — the
+    # same hard startup gate the real router applies (SL007): 0 errors
+    # proves every class's closure is shard-local for every fleet size.
+    maps: dict = {}
+    for n in fleet_sizes:
+        assignments = {}
+        for c, (_res, ns, typ) in enumerate(SHARD_CLASSES):
+            assignments[ns] = c % n
+            assignments[typ] = c % n
+        pmap = PartitionMap(n, assignments)
+        errors, _warnings = pmap.validate_schema(schema)
+        if errors:
+            raise AssertionError(
+                f"write-shard-scale partition map for {n} shard(s) "
+                f"fails footprint validation: {errors}")
+        maps[n] = pmap
+
+    tmp = tempfile.mkdtemp(prefix="shard-bench-")
+    out: dict = {"fleet": {}, "measure_s": spec["measure_s"],
+                 "inflight_per_shard": spec["inflight"],
+                 "wal_fsync": spec["wal_fsync"],
+                 "partition_map_4": maps[4].describe(),
+                 "cores": os.cpu_count()}
+    workers: list = []
+    try:
+        stage(f"write-shard-scale: spawn + warm {max(fleet_sizes)} "
+              f"shard-leader processes")
+        # same fixed per-process budget as replica-scale: production
+        # shard leaders are separate nodes, so the claim is "aggregate
+        # write throughput grows as shards are added at a constant
+        # per-shard budget"
+        taskset = shutil.which("taskset")
+        ncores = os.cpu_count() or 1
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_cpu_multi_thread_eigen=false "
+                             "intra_op_parallelism_threads=1",
+                   OMP_NUM_THREADS="1", OPENBLAS_NUM_THREADS="1")
+        for i in range(max(fleet_sizes)):
+            wspec = dict(spec, identity=f"shard{i}",
+                         data_dir=os.path.join(tmp, f"shard-{i}"))
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--shard-worker", json.dumps(wspec)]
+            if taskset:
+                cmd = [taskset, "-c", str(i % ncores)] + cmd
+            workers.append(subprocess.Popen(
+                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                env=env, text=True, bufsize=1))
+        for w in workers:
+            line = w.stdout.readline()
+            assert line.strip() == "READY", f"worker said {line!r}"
+
+        def window(n: int, tag: str) -> list:
+            # ownership split the fleet-n partition map prescribes:
+            # worker i writes the kube resources of classes c%n == i
+            pmap = maps[n]
+            for i, w in enumerate(workers[:n]):
+                resources = [res for res, _ns, typ in SHARD_CLASSES
+                             if pmap.shard_for_type(typ) == i]
+                w.stdin.write("RUN " + json.dumps(
+                    {"tag": tag, "resources": resources}) + "\n")
+                w.stdin.flush()
+            results = []
+            for w in workers[:n]:
+                while True:
+                    line = w.stdout.readline()
+                    if line.startswith("DONE "):
+                        results.append(json.loads(line[5:]))
+                        break
+                    if not line:
+                        raise AssertionError("shard worker died mid-run")
+            return results
+
+        # interleaved rounds, median per fleet size, paired per-round
+        # scaling ratios — the replica-scale methodology (ambient load
+        # on a shared box drifts by more than the effect measured)
+        rounds = 3
+        acc: dict = {n: [] for n in fleet_sizes}
+        for r in range(rounds):
+            for n in fleet_sizes:
+                stage(f"write-shard-scale round {r + 1}/{rounds}: {n} "
+                      f"shard leader(s) under churn")
+                acc[n].append(window(n, f"r{r}n{n}"))
+        for n in fleet_sizes:
+            aggs = [sum(res["writes_per_s"] for res in results)
+                    for results in acc[n]]
+            agg = statistics.median(aggs)
+            flat = [res for results in acc[n] for res in results]
+            out["fleet"][str(n)] = {
+                "aggregate_writes_per_s": round(agg, 1),
+                "aggregate_writes_per_s_rounds": [round(a, 1)
+                                                  for a in aggs],
+                "per_shard_writes_per_s": round(agg / n, 1),
+                "dual_write_p50_ms": statistics.median(
+                    res["p50_ms"] for res in flat),
+                # conservative: the slowest shard's p99 across rounds
+                "dual_write_p99_ms": max(res["p99_ms"] for res in flat),
+                "writes": sum(res["writes"] for res in flat),
+            }
+            log(f"write-shard-scale n={n}: {agg:.1f} dual-writes/s "
+                f"aggregate (median of {aggs}), p99 "
+                f"{out['fleet'][str(n)]['dual_write_p99_ms']}ms")
+    finally:
+        for w in workers:
+            try:
+                w.stdin.write("EXIT\n")
+                w.stdin.flush()
+            except OSError:
+                pass
+        for w in workers:
+            try:
+                w.wait(10)
+            except subprocess.TimeoutExpired:
+                w.kill()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    stage("write-shard-scale: CPU pair-scaling ceiling probe")
+    out["cpu_pair_scaling_ceiling"] = _cpu_pair_ceiling(taskset)
+
+    base_rounds = [sum(res["writes_per_s"] for res in results)
+                   for results in acc[1]]
+    out["noise_spread_1x"] = round(
+        max(base_rounds) / max(min(base_rounds), 1e-9), 2)
+    for n in fleet_sizes[1:]:
+        ratios = [
+            sum(res["writes_per_s"] for res in results) / max(b, 1e-9)
+            for results, b in zip(acc[n], base_rounds)]
+        out[f"scaling_{n}x"] = round(statistics.median(ratios), 2)
+        out[f"scaling_{n}x_rounds"] = [round(r, 2) for r in ratios]
+    out["write_shard_scaling"] = out.get("scaling_2x", 0.0)
+    ceiling = out["cpu_pair_scaling_ceiling"]
+    out["write_shard_scaling_normalized"] = round(
+        out["write_shard_scaling"] / max(ceiling, 1e-9), 2)
+    out["dual_write_p99_ms"] = out["fleet"]["2"]["dual_write_p99_ms"]
+    log(f"write-shard-scale: write scaling at 2 shards = "
+        f"{out['write_shard_scaling']}x raw (acceptance >= 1.5x on >=2 "
+        f"free cores), {out['write_shard_scaling_normalized']}x of this "
+        f"box's measured pair ceiling {ceiling}x; at 4 = "
+        f"{out.get('scaling_4x')}x on {out['cores']} cores "
+        f"(n=1 round noise spread {out['noise_spread_1x']}x)")
+    return out
+
+
 def _scenario_chain(workload, clock, cache_on: bool):
     """jax:// endpoint over a FAKE-clock store (+ DecisionCacheEndpoint
     when the scenario exercises the cache seam) and its oracle."""
@@ -1617,6 +1956,11 @@ REPLICATION_CONFIGS = {
     "replica-scale": bench_replica_scale,
 }
 
+# partitioned write scale-out (ISSUE 15): same contract
+SHARDING_CONFIGS = {
+    "write-shard-scale": bench_write_shard_scale,
+}
+
 # decision-cache bench configs (ISSUE 3): run standalone via --config or
 # appended to the --all sweep artifact
 CACHE_CONFIGS = {
@@ -1653,6 +1997,7 @@ def _config_registry() -> dict:
         "durable store": list(PERSIST_CONFIGS),
         "device pipeline": list(PIPELINE_CONFIGS),
         "replication": list(REPLICATION_CONFIGS),
+        "write sharding": list(SHARDING_CONFIGS),
         "scenario matrix": list(SCENARIO_CONFIGS),
     }
 
@@ -1709,6 +2054,7 @@ def main() -> None:
                     help="headline = direct batched call instead of the "
                          "concurrent dispatcher path")
     ap.add_argument("--replica-worker", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--shard-worker", default="", help=argparse.SUPPRESS)
     args = ap.parse_args()
     _reject_unknown_config(args.config)
 
@@ -1716,6 +2062,10 @@ def main() -> None:
         # replica-scale follower subprocess: no probe, no watchdog —
         # the parent bench owns the lifecycle (see replica_worker)
         replica_worker(args.replica_worker)
+        return
+    if args.shard_worker:
+        # write-shard-scale shard-leader subprocess: same contract
+        shard_leader_worker(args.shard_worker)
         return
 
     start_watchdog(args.deadline)
@@ -1803,6 +2153,25 @@ def main() -> None:
               "platform": _STATE["platform"],
               "baseline": "single follower aggregate filtered-list "
                           "throughput (same churn, same graph)",
+              **res})
+        return
+
+    if args.config in SHARDING_CONFIGS:
+        # standalone write-sharding config: 2-shard write scaling is the
+        # headline, single shard-leader aggregate is the baseline
+        stage(f"sharding config {args.config}")
+        tel_before = devtel_snapshot()
+        res = SHARDING_CONFIGS[args.config](args)
+        tel = devtel_delta(tel_before)
+        if tel:
+            res["device_telemetry"] = tel
+        _STATE["metric"] = f"write-sharding {args.config}"
+        emit({"metric": _STATE["metric"],
+              "value": res.get("write_shard_scaling", 0.0), "unit": "x",
+              "platform": _STATE["platform"],
+              "baseline": "single shard-leader aggregate dual-write "
+                          "throughput (same churn profile, same "
+                          "per-process core budget)",
               **res})
         return
 
@@ -2039,7 +2408,7 @@ def main() -> None:
         # restart time-to-serve + WAL write-overhead columns)
         for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS,
                          **PIPELINE_CONFIGS, **REPLICATION_CONFIGS,
-                         **SCENARIO_CONFIGS}.items():
+                         **SHARDING_CONFIGS, **SCENARIO_CONFIGS}.items():
             try:
                 tel_before = devtel_snapshot()
                 tl_mark = timeline_mark()

@@ -38,6 +38,11 @@ GATED_MODULES = (
     # (the generators/driver/shrinker themselves are offline tooling
     # with no subsystem state to gate)
     ("fuzz/metrics.py", "FuzzTelemetry"),
+    # partitioned write scale-out: the directory fragment covers the
+    # whole sharding subsystem (partition map, revision vectors, the
+    # router/endpoint compositions, and the authz_shard_* recording
+    # helpers) under the `Sharding` killswitch
+    ("spicedb/sharding/", "Sharding"),
 )
 
 _MUTATOR_METHODS = ("inc", "observe", "dec")

@@ -50,7 +50,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_decision_cache.py -q \
     -p no:cacheprovider -k "coherence or Footprint or Invalidation"
 
 echo "== differential fuzz smoke (25 fixed seeds x 3 gate combos x 3"
-echo "   replication roles, jax:// vs host oracle)"
+echo "   replication roles + 2 sharded2 router cells, jax:// vs oracle)"
 # seeded, deterministic, time-boxed (docs/fuzzing.md): random schemas +
 # random delta streams replayed against the device kernels AND the
 # recursive oracle at pinned revisions, as leader / 2-hop follower

@@ -1,10 +1,12 @@
 #!/usr/bin/env python
 """Differential fuzz smoke: the fixed-seed gate x replication-role matrix.
 
-check.sh mode (default): replays 25 FIXED seeds, each mapped onto one
-cell of the 3 gate-combos x 3 replication-roles matrix (every cell
-covered >= 2x across the set; kernels alternate ell/segment), asserting
-ZERO jax://-vs-oracle divergences.  Deterministic: schemas, delta
+check.sh mode (default): replays 27 FIXED seeds — 25 mapped onto the
+3 gate-combos x 3 replication-roles matrix (every cell covered >= 2x
+across the set; kernels alternate ell/segment), plus 2 `sharded2`
+cells replaying through a router over TWO partition leaders
+(spicedb/sharding, schema-derived co-location-valid map, off/full
+gates) — asserting ZERO jax://-vs-oracle divergences.  Deterministic: schemas, delta
 streams, clocks, and queries all derive from the seed; wall time is the
 only thing that varies.  A divergence shrinks to a self-contained repro
 artifact (docs/fuzzing.md) and fails the run with its path + seed line.
@@ -137,14 +139,29 @@ def run_fixed_set(n_seeds: int, workers: int, time_box: float) -> int:
                 failed.append(res)
     elapsed = time.time() - t0
     # matrix-coverage tripwire (a real error path, not an assert: it
-    # must survive python -O and scale with --seeds): every (gates,
-    # role) cell the seed walk CAN reach at this n must have been hit
-    want_cells = min(9, n_seeds)
-    want_per_cell = max(1, n_seeds // 9)
-    if (len(cells_hit) != want_cells
-            or any(v < want_per_cell for v in cells_hit.values())):
+    # must survive python -O and scale with --seeds).  The expectation
+    # is INDEPENDENT of smoke_cell_for — derived from the documented
+    # walk (seeds 0..24 = classic 3x3 matrix, >= 25 = sharded2 cells
+    # alternating off/full) — so a regression in the seed->cell map
+    # itself trips here instead of validating its own output.
+    n_classic = min(n_seeds, 25)
+    n_sharded = max(0, n_seeds - 25)
+    classic_hit = {c: v for c, v in cells_hit.items()
+                   if c[1] != "sharded2"}
+    sharded_hit = {c: v for c, v in cells_hit.items()
+                   if c[1] == "sharded2"}
+    want_sharded = {k: v for k, v in (
+        (("off", "sharded2"), (n_sharded + 1) // 2),
+        (("full", "sharded2"), n_sharded // 2)) if v}
+    if (len(classic_hit) != min(9, n_classic)
+            or sum(classic_hit.values()) != n_classic
+            or any(v < max(1, n_classic // 9)
+                   for v in classic_hit.values())
+            or sharded_hit != want_sharded):
         print(f"fuzz smoke: matrix coverage hole at --seeds {n_seeds}: "
-              f"{cells_hit}")
+              f"classic {dict(classic_hit)}, sharded {dict(sharded_hit)} "
+              f"(want {min(9, n_classic)} classic cells x >= "
+              f"{max(1, n_classic // 9)}, sharded {dict(want_sharded)})")
         return 1
     if failed:
         for res in failed:
@@ -155,7 +172,8 @@ def run_fixed_set(n_seeds: int, workers: int, time_box: float) -> int:
               f"in {elapsed:.1f}s")
         return 1
     print(f"fuzz smoke: {n_seeds} seeds x 3 gate combos x 3 replication "
-          f"roles AGREE in {elapsed:.1f}s")
+          f"roles (+ {n_sharded} sharded2 router cells) AGREE in "
+          f"{elapsed:.1f}s")
     if elapsed > time_box:
         print(f"fuzz smoke: exceeded the {time_box:.0f}s time box")
         return 1
@@ -172,7 +190,7 @@ def run_budgeted(budget_s: float, start_seed: int, scenario: str = "") -> int:
     from spicedb_kubeapi_proxy_tpu.fuzz.shrink import (
         delta_count, shrink_case, write_artifact)
     from spicedb_kubeapi_proxy_tpu.fuzz.driver import (
-        GATE_COMBOS, ROLES, SMOKE_KERNELS)
+        ALL_ROLES, GATE_COMBOS, SMOKE_KERNELS)
     bias_kw = {}
     if scenario:
         sb, db = SCENARIO_BIASES[scenario]
@@ -182,7 +200,7 @@ def run_budgeted(budget_s: float, start_seed: int, scenario: str = "") -> int:
     n = 0
     while time.time() - t0 < budget_s:
         gates = tuple(GATE_COMBOS)[seed % 3]
-        role = ROLES[(seed // 3) % 3]
+        role = ALL_ROLES[(seed // 3) % len(ALL_ROLES)]
         kernel = SMOKE_KERNELS[(seed // 9) % 2]
         case = build_case(seed, kernel=kernel, **bias_kw)
         divs = run_case(case, gates=gates, role=role, checkpoints="every",
@@ -251,7 +269,10 @@ def run_mutation_check(name: str, n_seeds: int) -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--seeds", type=int, default=25)
+    ap.add_argument("--seeds", type=int, default=27,
+                    help="seeds 0..24 walk the classic 3x3 gate x role "
+                         "matrix; seeds 25+ are the appended sharded2 "
+                         "(2-partition-leader router) cells")
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--time-box", type=float, default=90.0,
                     help="hard wall-clock bound for the fixed set "

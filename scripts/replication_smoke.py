@@ -22,6 +22,18 @@ Three REAL processes over localhost HTTP:
      and forwards new writes to the new leader.  Exactly one writable
      leader after the partition heals.
 
+Then the sharded write scale-out section (ISSUE 15): TWO shard-leader
+proxies (pods+namespaces on shard 0, configmaps+cfgns on shard 1, each
+its own data dir) behind the CLI router (`--shard-leaders`):
+
+  8. dual-writes through the router land on the owning shard
+     (X-Authz-Shard header + revision-vector ZedToken stamps);
+  9. a read carrying the write's revision-vector token serves
+     (read-your-writes through the router);
+ 10. kill -9 the shard-1 leader → pod dual-writes through the router
+     KEEP LANDING on shard 0 (the satellite's core assertion), while
+     configmap traffic answers 502 naming the dead shard.
+
 No jax import on the serving path (embedded endpoint): runs in seconds.
 """
 
@@ -77,9 +89,47 @@ update:
 
 LAG_BOUND_S = 8.0
 
+# sharded section: a second co-location class (cfgns + configmap) that
+# can live on its own shard — the pod rules' types (namespace + pod)
+# form the shard-0 class
+SHARD_SCHEMA = SCHEMA + """
+definition cfgns {
+  relation creator: user
+  permission view = creator
+}
+definition configmap {
+  relation creator: user
+  permission view = creator
+}
+"""
+
+SHARD_RULES = RULES + """
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-configmaps}
+match: [{apiVersion: v1, resource: configmaps, verbs: [list]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "configmap:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-configmaps}
+match: [{apiVersion: v1, resource: configmaps, verbs: [create]}]
+lock: Optimistic
+check: [{tpl: "cfgns:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "configmap:{{namespacedName}}#creator@user:{{user.name}}"
+"""
+
+PARTITION_MAP = "configmap=1,cfgns=1"
+
 
 def serve(role: str, port: int, data_dir: str, leader_url: str,
-          kube_url: str, peers: str = "") -> None:
+          kube_url: str, peers: str = "", seed_rel: str = "") -> None:
     """Child process: the shared fake kube-apiserver, or one proxy
     serving plain HTTP with header authn in front of it."""
     import asyncio
@@ -117,13 +167,15 @@ def serve(role: str, port: int, data_dir: str, leader_url: str,
 
     opts = Options(
         spicedb_endpoint="embedded://",
-        bootstrap=Bootstrap(schema_text=SCHEMA),
-        rules_yaml=RULES,
+        bootstrap=Bootstrap(schema_text=(SHARD_SCHEMA
+                                         if role == "shardleader"
+                                         else SCHEMA)),
+        rules_yaml=SHARD_RULES if role == "shardleader" else RULES,
         upstream_transport=H11Transport(kube_url),
         authenticators=[HeaderAuthenticator()],
         workflow_database_path="",  # in-memory dual-write journal
     )
-    if role == "leader":
+    if role in ("leader", "shardleader"):
         opts.data_dir = data_dir
         opts.wal_fsync = "never"
         if peers:
@@ -143,6 +195,10 @@ def serve(role: str, port: int, data_dir: str, leader_url: str,
         if role == "leader" and proxy.endpoint.store.revision == 0:
             proxy.endpoint.store.bulk_load([parse_relationship(
                 "namespace:team-a#creator@user:alice")])
+        if role == "shardleader" and proxy.endpoint.store.revision == 0:
+            proxy.endpoint.store.bulk_load(
+                [parse_relationship(r)
+                 for r in seed_rel.split(",") if r])
         # dual writes on every role: a follower forwards them until it
         # is promoted, then serves them locally
         proxy.enable_dual_writes()
@@ -204,16 +260,18 @@ def wait_ready(base: str, deadline_s: float, want_degraded=False) -> bytes:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--role", default="",
-                    choices=["", "kube", "leader", "follower"])
+                    choices=["", "kube", "leader", "follower",
+                             "shardleader"])
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--data-dir", default="")
     ap.add_argument("--leader", default="")
     ap.add_argument("--kube", default="")
     ap.add_argument("--peers", default="")
+    ap.add_argument("--seed-rel", default="")
     args = ap.parse_args()
     if args.role:
         serve(args.role, args.port, args.data_dir, args.leader, args.kube,
-              peers=args.peers)
+              peers=args.peers, seed_rel=args.seed_rel)
         return 0
 
     tmp = tempfile.mkdtemp(prefix="repl-smoke-")
@@ -414,6 +472,97 @@ def main() -> int:
             "GET", follower_url + "/api/v1/namespaces/team-a/pods", "alice")
         assert "healed-pod" in [i["metadata"]["name"]
                                 for i in json.loads(body)["items"]]
+
+        # -- sharded write scale-out (ISSUE 15): 2 shard leaders + the
+        # -- CLI router -------------------------------------------------
+        print("== sharded: boot 2 shard leaders + the CLI router")
+        s0p, s1p, rp = free_port(), free_port(), free_port()
+        s0_url = f"http://127.0.0.1:{s0p}"
+        s1_url = f"http://127.0.0.1:{s1p}"
+        router_url = f"http://127.0.0.1:{rp}"
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role",
+             "shardleader", "--port", str(s0p), "--data-dir",
+             os.path.join(tmp, "shard0"), "--kube", kube_url,
+             "--seed-rel", "namespace:team-a#creator@user:alice"],
+            env=env))
+        shard1_proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--role",
+             "shardleader", "--port", str(s1p), "--data-dir",
+             os.path.join(tmp, "shard1"), "--kube", kube_url,
+             "--seed-rel", "cfgns:team-a#creator@user:alice"], env=env)
+        procs.append(shard1_proc)
+        boot2 = os.path.join(tmp, "shard-bootstrap.yaml")
+        rules2 = os.path.join(tmp, "shard-rules.yaml")
+        with open(boot2, "w") as f:
+            yaml.safe_dump({"schema": SHARD_SCHEMA}, f)
+        with open(rules2, "w") as f:
+            f.write(SHARD_RULES)
+        wait_ready(s0_url, 30.0)
+        wait_ready(s1_url, 30.0)
+        # the router is the REAL CLI in --shard-leaders mode: routing
+        # table derived from the rules, footprint-validated at startup
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "spicedb_kubeapi_proxy_tpu",
+             "--shard-leaders", f"{s0_url},{s1_url}",
+             "--partition-map", PARTITION_MAP,
+             "--rule-config", rules2, "--spicedb-bootstrap", boot2,
+             "--embedded-mode", "--bind-address", "127.0.0.1",
+             "--secure-port", str(rp)], env=env))
+        wait_ready(router_url, 30.0)
+
+        print("== sharded: dual-writes land on their owning shards")
+        status, headers, body = http(
+            "POST", router_url + "/api/v1/namespaces/team-a/pods", "alice",
+            body={"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "shard-pod",
+                               "namespace": "team-a"}})
+        assert status in (200, 201), (status, body)
+        assert headers.get("X-Authz-Shard") == "0", headers
+        pod_token = headers.get("X-Authz-Revision", "")
+        assert pod_token.startswith("0:"), pod_token
+        status, headers, body = http(
+            "POST", router_url + "/api/v1/namespaces/team-a/configmaps",
+            "alice",
+            body={"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"name": "shard-cm", "namespace": "team-a"}})
+        assert status in (200, 201), (status, body)
+        assert headers.get("X-Authz-Shard") == "1", headers
+        assert "1:" in headers.get("X-Authz-Revision", ""), headers
+        print(f"   pod -> shard 0 (token {pod_token}); configmap -> "
+              f"shard 1 (token {headers.get('X-Authz-Revision')})")
+
+        print("== sharded: revision-vector read-your-writes via router")
+        req = urllib.request.Request(
+            router_url + "/api/v1/namespaces/team-a/pods",
+            headers={"Accept": "application/json",
+                     "X-Remote-User": "alice",
+                     "X-Authz-Min-Revision": pod_token})
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            assert resp.status == 200
+            names = [i["metadata"]["name"]
+                     for i in json.loads(resp.read()).get("items", [])]
+        assert "shard-pod" in names, names
+
+        print("== sharded: kill -9 shard 1; shard 0 keeps taking writes")
+        shard1_proc.send_signal(signal.SIGKILL)
+        shard1_proc.wait(10)
+        status, headers, body = http(
+            "POST", router_url + "/api/v1/namespaces/team-a/pods", "alice",
+            body={"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "post-shardkill-pod",
+                               "namespace": "team-a"}})
+        assert status in (200, 201), (status, body)
+        assert headers.get("X-Authz-Shard") == "0", headers
+        status, _, body = http(
+            "GET", router_url + "/api/v1/namespaces/team-a/configmaps",
+            "alice")
+        assert status == 502, (status, body)
+        assert json.loads(body)["details"]["shard"] == 1, body
+        status, _, body = http("GET", router_url + "/readyz", "alice")
+        assert status == 200 and b"shard 0" in body, (status, body)
+        print("   pod dual-write landed on shard 0; configmaps answer "
+              "502 naming shard 1; router /readyz degraded-but-200")
 
         print("replication_smoke: ALL GREEN")
         return 0

@@ -23,6 +23,8 @@ import logging
 import signal
 import ssl
 import sys
+
+import yaml
 from dataclasses import dataclass
 from typing import Optional
 
@@ -202,6 +204,33 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stable identity in elections and "
                         "/replication/status (default: minted per "
                         "process); the election tie-break orders on it")
+
+    # partitioned write scale-out (spicedb/sharding, docs/replication.md
+    # "Sharding"; killswitch: --feature-gates Sharding=false)
+    p.add_argument("--shards", type=int, default=1,
+                   help="split the tuple space by resource type across "
+                        "this many independent in-process leaders, each "
+                        "with its own WAL/checkpoint lineage under "
+                        "<data-dir>/shard-<k> (embedded:// and jax:// "
+                        "only; 1 = single leader).  The partition is "
+                        "validated against every permission's and "
+                        "rule's relation_footprint closure at startup: "
+                        "a closure spanning two shards is a hard error")
+    p.add_argument("--partition-map", default="",
+                   help="comma-separated type=shard assignments "
+                        "(e.g. pod=0,secret=1); unassigned types land "
+                        "on shard 0.  Shared verbatim by the router "
+                        "and every shard leader")
+    p.add_argument("--shard-leaders", default="",
+                   help="router mode: serve as a thin stateless router "
+                        "over these comma-separated shard-leader base "
+                        "URLs (one per shard, index = shard id).  Each "
+                        "leader is an unmodified proxy with its own "
+                        "data dir and replication tree; the router "
+                        "maps each request to the shard its matched "
+                        "rules' types live on, and translates "
+                        "revision-vector ZedTokens to per-shard "
+                        "components.  Exclusive with serving locally")
 
     # static schema/rule lint (spicedb/schema_lint.py, Cedar-inspired):
     # analyze instead of serve
@@ -390,6 +419,56 @@ def validate(args: argparse.Namespace) -> list:
         # analysis mode: no upstream, no serving — only the schema/rule
         # inputs matter
         return []
+    from .spicedb.sharding import PartitionMap, PartitionMapError
+    if args.shard_leaders:
+        # router mode: no upstream, no local endpoint — the shard
+        # leaders do the serving
+        urls = [u.strip() for u in args.shard_leaders.split(",")
+                if u.strip()]
+        for u in urls:
+            if not u.startswith(("http://", "https://")):
+                errs.append(f"--shard-leaders entry {u!r} must be an "
+                            f"http(s) base URL")
+        if args.shards > 1:
+            errs.append("--shards describes in-process sharding; router "
+                        "mode derives the shard count from the "
+                        "--shard-leaders list")
+        if args.replicate_from:
+            errs.append("--shard-leaders (router mode) is exclusive "
+                        "with --replicate-from")
+        if args.data_dir:
+            errs.append("--shard-leaders (router mode) is exclusive "
+                        "with --data-dir: the router is stateless; the "
+                        "shard leaders own the logs")
+        if urls and not errs:
+            try:
+                PartitionMap.parse(args.partition_map,
+                                   n_shards=len(urls))
+            except PartitionMapError as e:
+                errs.append(f"--partition-map: {e}")
+        if not args.embedded_mode and not (0 < args.secure_port < 65536):
+            errs.append(f"--secure-port {args.secure_port} is not a "
+                        f"valid port")
+        return errs
+    if args.shards < 1:
+        errs.append("--shards must be >= 1")
+    elif args.shards > 1:
+        if not args.spicedb_endpoint.startswith(("embedded", "jax")):
+            errs.append("--shards requires a store-backed endpoint "
+                        "(embedded:// or jax://)")
+        if args.replicate_from:
+            errs.append("--shards is exclusive with --replicate-from: "
+                        "a follower tails ONE leader's log; run one "
+                        "follower per shard leader instead")
+        try:
+            PartitionMap.parse(args.partition_map, n_shards=args.shards)
+        except PartitionMapError as e:
+            errs.append(f"--partition-map: {e}")
+    elif args.partition_map:
+        try:
+            PartitionMap.parse(args.partition_map)
+        except PartitionMapError as e:
+            errs.append(f"--partition-map: {e}")
     if not args.backend_kubeconfig and not args.use_in_cluster_config:
         errs.append("either --backend-kubeconfig or --use-in-cluster-config"
                     " must be specified")
@@ -664,6 +743,8 @@ def complete(args: argparse.Namespace,
         replica_peers=[u.strip() for u in args.replica_peers.split(",")
                        if u.strip()],
         replica_id=args.replica_id,
+        shards=args.shards,
+        partition_map=args.partition_map,
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,
@@ -749,13 +830,29 @@ def run_schema_lint(args: argparse.Namespace) -> int:
         schema = merge_internal_definitions(sch.parse_schema(schema_text))
         rule_configs = (proxyrule.parse_file(args.rule_config)
                         if args.rule_config else [])
+        # sharding co-location lint (SL007/SL008) engages when a
+        # partition is configured: --shards N and/or an explicit
+        # --partition-map (router mode infers the count from the
+        # leader list)
+        partition_map = None
+        if args.partition_map or args.shards > 1 or args.shard_leaders:
+            from .spicedb.sharding import PartitionMap
+            n_shards = None
+            if args.shard_leaders:
+                n_shards = len([u for u in args.shard_leaders.split(",")
+                                if u.strip()])
+            elif args.shards > 1:
+                n_shards = args.shards
+            partition_map = PartitionMap.parse(args.partition_map,
+                                               n_shards=n_shards)
     except Exception as e:
         if args.lint_schema_json:
             print(json.dumps({"version": 1, "error": str(e),
                               "findings": []}))
         print(f"error: {e}", file=sys.stderr)
         return 2
-    findings = schema_lint.lint_schema(schema, rule_configs)
+    findings = schema_lint.lint_schema(schema, rule_configs,
+                                       partition_map=partition_map)
     errors = [f for f in findings if f.severity == "error"]
     warnings = [f for f in findings if f.severity != "error"]
     failed = bool(errors or (warnings and args.lint_schema_strict))
@@ -779,6 +876,89 @@ def run_schema_lint(args: argparse.Namespace) -> int:
     return 1 if failed else 0
 
 
+def run_router(args: argparse.Namespace) -> int:
+    """`--shard-leaders`: serve as the thin stateless shard router
+    (spicedb/sharding/router.py) instead of a local proxy.  The routing
+    table derives from --rule-config (+ the bootstrap schema's
+    footprint closures when supplied) and is validated at startup: a
+    rule whose types span shards refuses to boot."""
+    level = (logging.DEBUG if args.verbosity >= 4
+             else logging.INFO if args.verbosity >= 2 else logging.WARNING)
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    if getattr(args, "feature_gates", ""):
+        from .utils.features import GATES, FeatureGateError
+        try:
+            GATES.apply_flag(args.feature_gates)
+        except FeatureGateError as e:
+            print(f"error: invalid --feature-gates: {e}", file=sys.stderr)
+            return 1
+    from .spicedb import schema as sch
+    from .spicedb import sharding
+    from .spicedb.endpoints import merge_internal_definitions
+    urls = [u.strip() for u in args.shard_leaders.split(",") if u.strip()]
+    try:
+        pmap = sharding.PartitionMap.parse(args.partition_map,
+                                           n_shards=len(urls))
+        rule_configs = (proxyrule.parse_file(args.rule_config)
+                        if args.rule_config else [])
+        schema = None
+        if args.spicedb_bootstrap:
+            bootstrap = Bootstrap.from_file(args.spicedb_bootstrap)
+            if bootstrap.schema_text:
+                schema = merge_internal_definitions(
+                    sch.parse_schema(bootstrap.schema_text))
+        ssl_context: Optional[ssl.SSLContext] = None
+        if not args.embedded_mode:
+            cert_file, key_file = args.tls_cert_file, args.tls_private_key_file
+            if bool(cert_file) != bool(key_file):
+                raise OptionsError(
+                    "--tls-cert-file and --tls-private-key-file must be"
+                    " specified together")
+            if not cert_file:
+                cert_file, key_file = kubecfg.generate_self_signed_cert(
+                    args.cert_dir, hosts=[args.bind_address])
+            ssl_context = kubecfg.serving_ssl_context(cert_file, key_file)
+        server = sharding.RouterServer(pmap, urls,
+                                       rule_configs=rule_configs,
+                                       schema=schema,
+                                       ssl_context=ssl_context)
+    except (OSError, ValueError, yaml.YAMLError) as e:
+        # yaml.YAMLError: Bootstrap.from_file / parse_file surface
+        # malformed YAML directly, and it is not a ValueError subclass
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    log = logging.getLogger("spicedb_kubeapi_proxy_tpu")
+    if not sharding.enabled():
+        log.info("Sharding gate disabled: routing everything to shard "
+                 "%d (pass-through)", pmap.default_shard)
+
+    async def serve() -> None:
+        port = await server.start(args.bind_address, args.secure_port)
+        scheme = "http" if args.embedded_mode else "https"
+        log.info("shard router serving on %s://%s:%d over %d shard "
+                 "leader(s): %s", scheme, args.bind_address, port,
+                 len(urls), ", ".join(urls))
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # non-unix
+                pass
+        try:
+            await stop.wait()
+        finally:
+            await server.stop()
+
+    try:
+        asyncio.run(serve())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     _sync_jax_platforms()
     parser = build_parser()
@@ -791,6 +971,8 @@ def main(argv: Optional[list] = None) -> int:
         return 2
     if args.lint_schema:
         return run_schema_lint(args)
+    if args.shard_leaders:
+        return run_router(args)
     try:
         completed = complete(args)
     except OptionsError as e:

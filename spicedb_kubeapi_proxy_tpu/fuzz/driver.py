@@ -15,7 +15,15 @@ endpoint built over the store a REPLICATION ROLE produces:
 - `promoted`    a 1-hop follower consumes the first half of the stream,
                 then promotes: the remaining bursts are written
                 DIRECTLY to the promoted store (the post-
-                `/replication/promote` serving shape).
+                `/replication/promote` serving shape);
+- `sharded2`    the stream routes through a schema-derived (and
+                footprint-revalidated) partition map into TWO
+                partition-leader stores behind a ShardedEndpoint
+                (spicedb/sharding) while the oracle reads a single
+                mirror store of the full stream — the per-shard device
+                graphs must answer exactly like the whole-store oracle
+                (the footprint co-location proof, exercised end to
+                end).
 
 After every burst, every query in the case's query stream is answered
 by the device endpoint (optionally behind a DecisionCacheEndpoint) and
@@ -74,15 +82,31 @@ GATE_COMBOS = {
 
 ROLES = ("leader", "follower2", "promoted")
 
+# partitioned write scale-out (spicedb/sharding): the case replays
+# through a ShardedEndpoint routing over TWO partition leaders, with a
+# schema-derived co-location-valid partition map; the oracle reads a
+# single mirror store receiving the same stream
+SHARDED_ROLE = "sharded2"
+ALL_ROLES = ROLES + (SHARDED_ROLE,)
+
 SMOKE_KERNELS = ("segment", "ell")
+
+# the gate combos the appended sharded smoke cells run under (the
+# bare path and the full production chain)
+SMOKE_SHARDED_GATES = ("off", "full")
 
 
 def smoke_cell_for(seed: int) -> tuple:
-    """The fixed (gates, role, kernel) cell a smoke seed lands in: the
-    seed index walks the 3x3 gate x role matrix (so 25 seeds cover
-    every cell >= 2x) with the kernel alternating on top.  Shared by
+    """The fixed (gates, role, kernel) cell a smoke seed lands in:
+    seeds 0..24 walk the classic 3x3 gate x role matrix (every cell
+    covered >= 2x) with the kernel alternating on top; seeds >= 25 are
+    the appended `sharded2` cells (router over 2 partition leaders,
+    off/full gates, kernels alternating).  Shared by
     scripts/fuzz_smoke.py and the mutation-check tests so 'the fixed
     seed set' means one thing."""
+    if seed >= 25:
+        return (SMOKE_SHARDED_GATES[(seed - 25) % 2], SHARDED_ROLE,
+                SMOKE_KERNELS[seed % 2])
     return (tuple(GATE_COMBOS)[seed % 3], ROLES[(seed // 3) % 3],
             SMOKE_KERNELS[seed % 2])
 
@@ -188,7 +212,8 @@ class _RoleHarness:
     `query_store` is the store the device endpoint and the oracle both
     read — the leaf of whatever replication chain the role builds."""
 
-    def __init__(self, role: str, clock: FakeClock, n_bursts: int):
+    def __init__(self, role: str, clock: FakeClock, n_bursts: int,
+                 schema: sch.Schema = None):
         self.role = role
         self.clock = clock
         self.leader = TupleStore(clock=clock.now)
@@ -196,6 +221,8 @@ class _RoleHarness:
         self._leader_reset = False
         self._promote_at = n_bursts // 2 if role == "promoted" else None
         self._promoted = False
+        self.pmap = None               # sharded2: the partition map
+        self.shard_stores: list = []   # sharded2: per-shard stores
         if role == "leader":
             self.query_store = self.leader
             self.hops = []
@@ -206,6 +233,26 @@ class _RoleHarness:
         elif role == "promoted":
             self.hops = [TupleStore(clock=clock.now)]
             self.query_store = self.hops[-1]
+        elif role == SHARDED_ROLE:
+            # two partition leaders behind a ShardedEndpoint; the oracle
+            # reads `self.leader` as a single mirror of the full stream.
+            # schema_gen emits cross-type DAGs, so the map is DERIVED
+            # per schema (co-location classes from the footprint
+            # closures) and then re-validated: the footprint validator
+            # must accept it or the harness fails loudly.
+            from ..spicedb.sharding import partition_map_for_schema
+            if schema is None:
+                raise ValueError("sharded2 role needs the case schema")
+            self.hops = []
+            self.query_store = self.leader
+            self.pmap = partition_map_for_schema(schema, 2)
+            errors, _ = self.pmap.validate_schema(schema)
+            if errors:
+                raise AssertionError(
+                    f"derived partition map failed footprint "
+                    f"validation: {errors}")
+            self.shard_stores = [TupleStore(clock=clock.now)
+                                 for _ in range(2)]
         else:
             raise ValueError(f"unknown role {role!r}")
         if self.hops:
@@ -238,9 +285,49 @@ class _RoleHarness:
                 hop.apply_replica_batch(updates)
 
     def seed_initial(self, rels: list) -> None:
-        self.leader.bulk_load([parse_relationship(r) for r in rels])
+        parsed = [parse_relationship(r) for r in rels]
+        self.leader.bulk_load(parsed)
         if self.hops:
             self._drain_into_hops()
+        if self.shard_stores:
+            self._route_bulk(parsed)
+
+    # -- sharded2 plumbing ---------------------------------------------------
+
+    def _route_bulk(self, rels: list) -> None:
+        groups: dict = {}
+        for rel in rels:
+            k = self.pmap.shard_of(rel.resource.type, rel.resource.id)
+            groups.setdefault(k, []).append(rel)
+        for k, subset in sorted(groups.items()):
+            self.shard_stores[k].bulk_load(subset)
+
+    def _route_burst(self, burst: dict) -> None:
+        """Mirror one burst into the partition leaders, routed by the
+        partition map — the stream a real router would deliver."""
+        kind = burst["kind"]
+        if kind == "advance":
+            return  # the FakeClock is shared by every store
+        if kind == "write":
+            groups: dict = {}
+            for op in burst["ops"]:
+                rel = parse_relationship(op["rel"])
+                k = self.pmap.shard_of(rel.resource.type, rel.resource.id)
+                groups.setdefault(k, []).append(RelationshipUpdate(
+                    UpdateOp.DELETE if op["op"] == "delete"
+                    else UpdateOp.TOUCH, rel))
+            for k, ups in sorted(groups.items()):
+                self.shard_stores[k].write(ups)
+        elif kind == "dbf":
+            flt = RelationshipFilter(
+                resource_type=burst["resource_type"],
+                relation=burst["relation"],
+                resource_id=burst["resource_id"])
+            for k in self.pmap.shards_for_filter(flt):
+                self.shard_stores[k].delete_by_filter(flt)
+        elif kind == "bulk":
+            self._route_bulk([parse_relationship(r)
+                              for r in burst["rels"]])
 
     def _writable_store(self) -> TupleStore:
         if self._promoted:
@@ -279,6 +366,30 @@ class _RoleHarness:
             raise ValueError(f"unknown burst kind {kind!r}")
         if self.hops and not self._promoted:
             self._drain_into_hops()
+        if self.shard_stores:
+            self._route_burst(burst)
+
+    def build_endpoint(self, schema: sch.Schema, kernel: str,
+                       cache_on: bool):
+        """The device endpoint under test for this role: a plain
+        JaxEndpoint over the query store, or (sharded2) a
+        ShardedEndpoint routing over per-shard JaxEndpoints — with the
+        decision cache wrapped per shard, exactly as a sharded
+        deployment runs it (caches are shard-local)."""
+        from ..ops.jax_endpoint import JaxEndpoint
+        if self.role == SHARDED_ROLE:
+            from ..spicedb.sharding import ShardedEndpoint
+            inners: list = [JaxEndpoint(schema, store=s, kernel=kernel)
+                            for s in self.shard_stores]
+            if cache_on:
+                from ..spicedb.decision_cache import DecisionCacheEndpoint
+                inners = [DecisionCacheEndpoint(i) for i in inners]
+            return ShardedEndpoint(self.pmap, inners, schema=schema)
+        ep = JaxEndpoint(schema, store=self.query_store, kernel=kernel)
+        if cache_on:
+            from ..spicedb.decision_cache import DecisionCacheEndpoint
+            ep = DecisionCacheEndpoint(ep)
+        return ep
 
 
 # -- the replay ---------------------------------------------------------------
@@ -373,20 +484,16 @@ def run_case(case: FuzzCase, gates: str = "off", role: str = "leader",
 
     `final_only` + `check_only` are the shrinker's probe mode: apply the
     whole stream, then evaluate one query once at the end state."""
-    from ..ops.jax_endpoint import JaxEndpoint
-
     schema = case.parsed_schema()
     clock = FakeClock()
-    harness = _RoleHarness(role, clock, len(case.bursts))
+    harness = _RoleHarness(role, clock, len(case.bursts), schema=schema)
     divergences: list = []
 
     with gates_set(gates):
         harness.seed_initial(case.init_rels)
-        ep = JaxEndpoint(schema, store=harness.query_store,
-                         kernel=case.kernel)
-        if GATE_COMBOS[gates]["DecisionCache"]:
-            from ..spicedb.decision_cache import DecisionCacheEndpoint
-            ep = DecisionCacheEndpoint(ep)
+        ep = harness.build_endpoint(
+            schema, case.kernel,
+            cache_on=GATE_COMBOS[gates]["DecisionCache"])
         oracle = Evaluator(schema, harness.query_store)
 
         async def replay():

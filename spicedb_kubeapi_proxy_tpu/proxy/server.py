@@ -243,6 +243,17 @@ class Options:
     # stable identity in elections and /replication/status (minted per
     # process when empty); the election tie-break orders on it
     replica_id: str = ""
+    # partitioned write scale-out (spicedb/sharding, docs/replication.md
+    # "Sharding"; killswitch: --feature-gates Sharding=false).
+    # shards > 1 splits the tuple space by resource type across that
+    # many independent in-process leaders — each its own store and
+    # (with a data dir) its own WAL/checkpoint lineage under
+    # <data-dir>/shard-<k> — behind a ShardedEndpoint.  partition_map
+    # is the `type=shard` assignment string; the partition is validated
+    # against every permission's and rule's relation_footprint closure
+    # at construction (a closure spanning two shards refuses to boot).
+    shards: int = 1
+    partition_map: str = ""
 
 
 class ProxyServer:
@@ -257,8 +268,69 @@ class ProxyServer:
         # bootstrap-once then skips re-applying it onto recovered state
         self.persistence = None
         endpoint_kwargs = dict(opts.endpoint_kwargs)
+        # rule configs are needed BEFORE endpoint construction now: the
+        # sharded endpoint validates the partition map against every
+        # rule's footprint closure at startup
+        configs = list(opts.rule_configs)
+        if opts.rules_yaml:
+            configs.extend(proxyrule.parse(opts.rules_yaml))
+        # partitioned write scale-out (spicedb/sharding): N independent
+        # in-process leaders behind a ShardedEndpoint.  The Sharding
+        # gate is the killswitch — off, opts.shards is inert and the
+        # proxy is exactly single-shard.
+        self.sharding = None           # PartitionMap when sharded
+        self._shard_persistence = []   # per-shard PersistenceManagers
+        sharded_on = False
+        if opts.shards > 1:
+            from ..spicedb import sharding as shrd
+            if not opts.spicedb_endpoint.startswith(("embedded", "jax")):
+                raise ValueError(
+                    "--shards requires a store-backed endpoint "
+                    "(embedded:// or jax://)")
+            if opts.replicate_from:
+                raise ValueError(
+                    "--shards is exclusive with --replicate-from: a "
+                    "follower tails ONE leader's log; run one follower "
+                    "per shard leader instead")
+            sharded_on = shrd.enabled()
+            if not sharded_on:
+                logger.info("--shards %d set but the Sharding gate is "
+                            "disabled; running single-shard", opts.shards)
         from ..spicedb import replication as repl
-        if opts.data_dir:
+        if sharded_on:
+            from ..spicedb.sharding import (
+                PartitionMap,
+                build_sharded_endpoint,
+            )
+            from ..spicedb.store import TupleStore
+            from ..utils.features import GATES
+            pmap = PartitionMap.parse(opts.partition_map,
+                                      n_shards=opts.shards)
+            stores = []
+            if opts.data_dir and GATES.enabled("DurableStore"):
+                from ..spicedb.persist import PersistenceManager
+                for k in range(opts.shards):
+                    mgr = PersistenceManager(
+                        os.path.join(opts.data_dir, f"shard-{k}"),
+                        fsync=opts.wal_fsync,
+                        checkpoint_interval=opts.checkpoint_interval)
+                    store = mgr.recover()
+                    mgr.attach(store)
+                    self._shard_persistence.append(mgr)
+                    stores.append(store)
+            else:
+                if opts.data_dir:
+                    logger.info("--data-dir %r set but the DurableStore "
+                                "gate is disabled; running in-memory",
+                                opts.data_dir)
+                stores = [TupleStore() for _ in range(opts.shards)]
+            # hard startup error when any footprint closure spans
+            # shards (SL007): raises RouterConfigError before serving
+            self.endpoint: PermissionsEndpoint = build_sharded_endpoint(
+                opts.spicedb_endpoint, opts.bootstrap, pmap, stores,
+                rule_configs=configs, **endpoint_kwargs)
+            self.sharding = pmap
+        if opts.data_dir and not sharded_on:
             from ..utils.features import GATES
             if GATES.enabled("DurableStore"):
                 from ..spicedb.persist import PersistenceManager
@@ -339,9 +411,10 @@ class ProxyServer:
             logger.info("--replicate-from %r set but the Replication gate "
                         "is disabled; running single-node",
                         opts.replicate_from)
-        self.endpoint: PermissionsEndpoint = create_endpoint(
-            opts.spicedb_endpoint, bootstrap=opts.bootstrap,
-            **endpoint_kwargs)
+        if not sharded_on:
+            self.endpoint = create_endpoint(
+                opts.spicedb_endpoint, bootstrap=opts.bootstrap,
+                **endpoint_kwargs)
         # label = URL scheme; a scheme-less host:port endpoint is a
         # remote gRPC dial — label it "grpc" rather than leaking the
         # hostname into metric label cardinality
@@ -365,11 +438,9 @@ class ProxyServer:
                          f" {info['torn_records']} torn,"
                          f" {info['idempotency_keys']} idempotency keys)"
                          f" in {info['total_s']}s")))
-        configs = list(opts.rule_configs)
-        if opts.rules_yaml:
-            configs.extend(proxyrule.parse(opts.rules_yaml))
         # exposed mutable matcher (reference server.go:145-146: e2e tests
-        # swap rule sets at runtime through the *Matcher pointer)
+        # swap rule sets at runtime through the *Matcher pointer);
+        # `configs` was assembled above, before endpoint construction
         self.matcher = MapMatcher(configs)
         self.rest_mapper = CachingRESTMapper(opts.upstream_transport)
         self.authenticator: Authenticator = AuthenticatorChain(
@@ -438,14 +509,18 @@ class ProxyServer:
                     if self.replication is not None else None))
         # off-loop rebuilds prewarm their candidate generations when
         # compile prewarm is on, so a post-swap first request recompiles
-        # nothing (ops/jax_endpoint.py _prewarm_graph)
+        # nothing (ops/jax_endpoint.py _prewarm_graph); a sharded
+        # endpoint prewarms every shard's graph
         if opts.prewarm_compiles:
-            inner = self.endpoint
-            while inner is not None and not hasattr(inner,
-                                                    "prewarm_rebuilds"):
-                inner = getattr(inner, "inner", None)
-            if inner is not None:
-                inner.prewarm_rebuilds = True
+            roots = (list(self.endpoint.shards)
+                     if self.sharding is not None else [self.endpoint])
+            for root in roots:
+                inner = root
+                while inner is not None and not hasattr(
+                        inner, "prewarm_rebuilds"):
+                    inner = getattr(inner, "inner", None)
+                if inner is not None:
+                    inner.prewarm_rebuilds = True
         # unconditional: set_hbm_peak(0) restores auto-detection, so a
         # server built with the default never inherits a previous
         # server's configured peak through the module singleton
@@ -517,8 +592,27 @@ class ProxyServer:
                             "long-poll waiters) or follower (applied "
                             "revision, lag, cursor, bootstraps); "
                             "docs/replication.md", self._debug_replication),
+            "sharding": ("partition map + per-shard revisions of the "
+                         "in-process sharded endpoint (docs/replication"
+                         ".md \"Sharding\")", self._debug_sharding),
         }
         return surfaces
+
+    def _debug_sharding(self) -> dict:
+        if self.sharding is None:
+            from ..spicedb import sharding as shrd
+            return {"enabled": False,
+                    "reason": ("Sharding feature gate disabled"
+                               if not shrd.enabled() else
+                               "not configured (--shards N with a "
+                               "store-backed endpoint)")}
+        return {"enabled": True,
+                "partition_map": self.sharding.describe(),
+                "revision_vector": self.endpoint.revision_vector().encode(),
+                "shard_revisions": {
+                    str(k): store.revision
+                    for k, store in
+                    enumerate(self.endpoint.shard_stores())}}
 
     def _serve_debug(self, req: Request) -> Response:
         surfaces = self._debug_surfaces()
@@ -942,7 +1036,9 @@ class ProxyServer:
     def _stamp_revision(self, resp: Response) -> None:
         """Every authenticated response from a replicating proxy carries
         the revision it served at — the ZedToken a client threads back
-        as X-Authz-Min-Revision to read-your-writes on any replica."""
+        as X-Authz-Min-Revision to read-your-writes on any replica.  A
+        sharded proxy stamps the full revision VECTOR ({shard:
+        revision}, docs/replication.md "Sharding")."""
         from ..spicedb import replication as repl
         if self.replication_hub is not None:
             resp.headers.set(repl.REVISION_HEADER,
@@ -950,6 +1046,56 @@ class ProxyServer:
         elif self.replication is not None:
             resp.headers.set(repl.REVISION_HEADER,
                              str(self.replication.store.revision))
+        elif self.sharding is not None:
+            resp.headers.set(repl.REVISION_HEADER,
+                             self.endpoint.revision_vector().encode())
+
+    def _sharded_gate(self, req: Request) -> Optional[Response]:
+        """In-process sharded mode: honor revision-vector ZedTokens.
+        Writes commit synchronously here (no replication tail), so any
+        token this proxy issued is already satisfied; a component ahead
+        of its shard (a token from a lost future, or another fleet) is
+        refused 503 rather than served below the token.  None = serve."""
+        from ..spicedb import replication as repl
+        from ..spicedb.sharding import RevisionVector, RevisionVectorError
+        raw = req.headers.get(repl.MIN_REVISION_HEADER)
+        if not raw:
+            return None
+        try:
+            vec = RevisionVector.decode(raw)
+        except RevisionVectorError as e:
+            return json_response(400, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 400,
+                "message": f"invalid {repl.MIN_REVISION_HEADER} "
+                           f"revision-vector token: {e}"})
+        stores = self.endpoint.shard_stores()
+        for k, store in enumerate(stores):
+            want = vec.component(k)
+            if want > store.revision:
+                return json_response(503, {
+                    "kind": "Status", "apiVersion": "v1", "metadata": {},
+                    "status": "Failure", "code": 503,
+                    "reason": "ServiceUnavailable",
+                    "message": f"revision {want} is not available on "
+                               f"shard {k} (at {store.revision}); the "
+                               f"token may predate a shard recovery"})
+        # a component naming a shard outside this fleet demands a
+        # revision no store here can ever satisfy — refuse it rather
+        # than silently dropping the client's staleness bound
+        unknown = sorted(k for k, v in vec.parts.items()
+                         if k >= len(stores) and v > 0)
+        if unknown:
+            return json_response(503, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "code": 503,
+                "reason": "ServiceUnavailable",
+                "message": f"revision-vector token names shard(s) "
+                           f"{unknown} outside this fleet's "
+                           f"0..{len(stores) - 1}; the token may come "
+                           f"from another fleet or a larger partition "
+                           f"map"})
+        return None
 
     # -- chain ---------------------------------------------------------------
 
@@ -1019,6 +1165,14 @@ class ProxyServer:
             # never a stale answer below its min-revision
             if self.replication is not None:
                 gated = await self._replica_gate(req, verb)
+                if gated is not None:
+                    return gated
+            # in-process sharded mode: revision-vector tokens are
+            # checked per shard component (writes are synchronous, so
+            # this is a tripwire for tokens from a lost future, never
+            # a wait)
+            if self.sharding is not None:
+                gated = self._sharded_gate(req)
                 if gated is not None:
                     return gated
             from ..utils.admission import AdmissionRejectedError
@@ -1240,7 +1394,8 @@ class ProxyServer:
         # of kernel entry points so first-request-per-bucket jit stalls
         # move here too (recorded as `compile` events on the rebuild
         # timeline track).
-        if self.persistence is not None or self.opts.prewarm_compiles:
+        if (self.persistence is not None or self._shard_persistence
+                or self.opts.prewarm_compiles):
             warm = getattr(self.endpoint, "warm_start", None)
             if warm is not None:
                 prewarm = self.opts.prewarm_compiles
@@ -1272,6 +1427,10 @@ class ProxyServer:
         bound = await self._http.start(host, port)
         if self.persistence is not None:
             await self.persistence.start()
+        for mgr in self._shard_persistence:
+            # per-shard checkpoint loops (sharded mode: each shard owns
+            # its WAL + checkpoint lineage)
+            await mgr.start()
         if self._fence_monitor is not None and self.replication_hub is not None:
             self._fence_monitor.start()
         if self.replication is not None:
@@ -1336,6 +1495,8 @@ class ProxyServer:
             # final checkpoint: a clean shutdown restarts from the
             # checkpoint alone, with an empty WAL tail
             await self.persistence.stop()
+        for mgr in self._shard_persistence:
+            await mgr.stop()
         await self.audit.stop()
 
     # -- embedded client (reference server.go:317-364, pkg/inmemory) ---------

@@ -28,6 +28,14 @@ cache invalidates by — so the lint is cheap:
                  expiring tuples lapse its tuples can never influence
                  a decision again (the PAuth ephemeral-grant footgun:
                  durable grants parked behind ephemeral indirection)
+  SL007 (error)  a permission or rule template whose relation_footprint
+                 closure spans two shards of the configured partition
+                 map (spicedb/sharding): an unroutable dual-write — no
+                 single shard leader can evaluate or apply it
+                 atomically (only with a partition map configured)
+  SL008 (warn)   a partition map key naming a type absent from the
+                 schema: tuples of a mistyped name silently route to
+                 the default shard
 
 Proxy-internal definitions (lock / workflow / activity — the dual-write
 engine's bookkeeping, spicedb/endpoints.py INTERNAL_SCHEMA) are exempt
@@ -154,10 +162,22 @@ def _nonexpiring_reachable(schema: sch.Schema) -> set:
     return rels
 
 
-def lint_schema(schema: sch.Schema, rule_configs=()) -> list:
-    """Run every lint pass; returns Findings (errors first)."""
+def lint_schema(schema: sch.Schema, rule_configs=(),
+                partition_map=None) -> list:
+    """Run every lint pass; returns Findings (errors first).  With a
+    `partition_map` (spicedb/sharding PartitionMap) the sharding
+    co-location passes (SL007/SL008) run too."""
     findings: list = []
     referenced: set = set()  # (type, relation) pairs rules read directly
+
+    # -- SL007/SL008: partition-map co-location (spicedb/sharding) -----------
+    if partition_map is not None:
+        errors, warnings = partition_map.validate_schema(schema,
+                                                         rule_configs or ())
+        findings.extend(Finding("SL007", "error", where, msg)
+                        for where, msg in errors)
+        findings.extend(Finding("SL008", "warn", where, msg)
+                        for where, msg in warnings)
 
     # -- SL001/SL002/SL005: rule templates vs the schema ---------------------
     for rule_name, tpl in _iter_rule_templates(rule_configs or ()):

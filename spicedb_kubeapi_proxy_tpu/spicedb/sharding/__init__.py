@@ -1,0 +1,67 @@
+"""Partitioned write scale-out (ROADMAP item 3c, docs/replication.md
+"Sharding").
+
+The tuple space splits BY RESOURCE TYPE across N independent leaders —
+each with its own WAL, checkpoint lineage, incarnation epochs, and
+replication tree (the unmodified PR 9/11 machinery, per shard) — behind
+a thin stateless router.  The `relation_footprint` closure proves the
+partitioning safe per-schema: a permission whose closure stays on one
+shard evaluates identically over that shard's tuple subset, and a
+closure spanning two shards is a hard startup error (SL007).  Client
+ZedTokens become revision VECTORS (`{shard: revision}`); each shard
+leader enforces only its own component through the existing
+`X-Authz-Min-Revision` gate, byte-identical to a single-leader
+deployment.
+
+- `partition.py`  PartitionMap: `type=shard` assignments + default
+                  shard, footprint validation, write-batch routing
+                  (internal bookkeeping tuples ride their batch's
+                  shard; retries land on the SAME shard).
+- `revvec.py`     revision-vector ZedToken encode/decode/merge.
+- `router.py`     ShardedEndpoint (N leaders in one process,
+                  per-shard device graphs, cross-shard fan-out for
+                  untyped reads / delete_by_filter / bulk / watch
+                  merge) and ShardRouter/RouterServer (the
+                  multi-process thin HTTP router).
+- `metrics.py`    gated `authz_shard_*` telemetry.
+
+Killswitch: the `Sharding` feature gate — off, nothing here is
+constructed and the proxy is exactly single-shard.
+"""
+
+from .metrics import enabled
+from .partition import (
+    CrossShardWriteError,
+    INTERNAL_TYPES,
+    PartitionMap,
+    PartitionMapError,
+    partition_map_for_schema,
+)
+from .revvec import RevisionVector, RevisionVectorError
+from .router import (
+    MergedWatcher,
+    RouterConfigError,
+    RouterServer,
+    ShardRouter,
+    ShardedEndpoint,
+    build_routing_table,
+    build_sharded_endpoint,
+)
+
+__all__ = [
+    "CrossShardWriteError",
+    "INTERNAL_TYPES",
+    "MergedWatcher",
+    "PartitionMap",
+    "PartitionMapError",
+    "RevisionVector",
+    "RevisionVectorError",
+    "RouterConfigError",
+    "RouterServer",
+    "ShardRouter",
+    "ShardedEndpoint",
+    "build_routing_table",
+    "build_sharded_endpoint",
+    "enabled",
+    "partition_map_for_schema",
+]

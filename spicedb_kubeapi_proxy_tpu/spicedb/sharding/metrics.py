@@ -1,0 +1,54 @@
+"""Sharding telemetry (docs/observability.md), behind the `Sharding`
+feature gate.
+
+Every recording helper checks the gate first (analyzer rule A004:
+"killswitch off must mean inert" — with Sharding=false nothing here
+ticks, matching the single-shard behavior contract).  Label
+cardinality is bounded by configuration: `shard` is one of the
+configured 0..N-1 ids, `verb` one of the fixed fan-out verbs."""
+
+from __future__ import annotations
+
+from ...utils.metrics import REGISTRY
+
+_routed = REGISTRY.counter(
+    "authz_shard_routed_total",
+    "Requests/verbs routed to a single shard leader (router + "
+    "in-process sharded endpoint)", labels=("shard",))
+_fanout = REGISTRY.counter(
+    "authz_shard_fanout_total",
+    "Cross-shard fan-out operations by verb (read/delete_by_filter/"
+    "bulk/watch/health)", labels=("verb",))
+_cross_rejects = REGISTRY.counter(
+    "authz_shard_cross_write_rejects_total",
+    "Write batches rejected for spanning two shards (unroutable; the "
+    "footprint validation makes this unreachable for rule-generated "
+    "dual-writes)")
+
+
+def enabled() -> bool:
+    """Sharding gate accessor; unknown-gate errors fail CLOSED — a
+    stripped gate registry must behave exactly single-shard."""
+    try:
+        from ...utils.features import GATES
+        return GATES.enabled("Sharding")
+    except Exception:
+        return False
+
+
+def note_routed(shard: int) -> None:
+    if not enabled():
+        return
+    _routed.inc(shard=str(shard))
+
+
+def note_fanout(verb: str) -> None:
+    if not enabled():
+        return
+    _fanout.inc(verb=verb)
+
+
+def note_cross_write_reject() -> None:
+    if not enabled():
+        return
+    _cross_rejects.inc()

@@ -132,6 +132,13 @@ GATES.register("Replication", stage=ALPHA, default=True)
 # budgeted campaigns).  This gate is the killswitch for the recording
 # helpers; off, fuzz runs tick nothing.
 GATES.register("FuzzTelemetry", stage=ALPHA, default=True)
+# partitioned write scale-out (spicedb/sharding, docs/replication.md
+# "Sharding"): footprint-proven tuple-space sharding across N leaders
+# with a thin router and revision-vector ZedTokens.  This gate is the
+# killswitch: off, --shards/--partition-map are inert (single-shard
+# behavior exactly), the router degrades to a pass-through to the
+# default shard, and the authz_shard_* metrics tick nothing.
+GATES.register("Sharding", stage=ALPHA, default=True)
 
 
 def pipeline_enabled() -> bool:

@@ -480,7 +480,8 @@ class TestDebugSurfaces:
             surfaces = json.loads(resp.body)["surfaces"]
             assert set(surfaces) == {"/debug/traces", "/debug/decisions",
                                      "/debug/flight", "/debug/timeline",
-                                     "/debug/replication"}
+                                     "/debug/replication",
+                                     "/debug/sharding"}
             for desc in surfaces.values():
                 assert isinstance(desc, str) and desc
         run(go())

@@ -272,3 +272,90 @@ definition ns {
     sl006 = {f.where for f in findings if f.code == "SL006"}
     assert "org#admin" in sl006
     assert "ns#org" not in sl006
+
+
+# -- SL007/SL008: partition-map co-location (ISSUE 15 satellite) --------------
+
+SHARDED_SCHEMA = """
+definition user {}
+definition namespace {
+  relation viewer: user
+  permission view = viewer
+}
+definition pod {
+  relation namespace: namespace
+  relation viewer: user
+  permission view = viewer + namespace->view
+}
+definition island {
+  relation owner: user
+  permission own = owner
+}
+"""
+
+
+def test_sl007_permission_closure_spanning_shards():
+    """pod#view reaches namespace#viewer through the arrow: splitting
+    pod and namespace across shards is an unroutable evaluation."""
+    from spicedb_kubeapi_proxy_tpu.spicedb.sharding import PartitionMap
+    schema = sch.parse_schema(SHARDED_SCHEMA)
+    findings = lint_schema(schema, (), partition_map=PartitionMap.parse(
+        "pod=1", n_shards=2))
+    sl007 = [f for f in findings if f.code == "SL007"]
+    assert sl007 and all(f.severity == "error" for f in sl007)
+    assert any("pod#view" in f.where for f in sl007)
+    # co-locating the entangled pair clears it; the independent type
+    # may live anywhere
+    findings = lint_schema(schema, (), partition_map=PartitionMap.parse(
+        "pod=1,namespace=1", n_shards=2))
+    assert not [f for f in findings if f.code == "SL007"]
+
+
+def test_sl007_rule_template_spanning_shards():
+    """A rule checking one type and updating another is a dual-write:
+    both types must land on one shard."""
+    from spicedb_kubeapi_proxy_tpu.spicedb.sharding import PartitionMap
+    schema = sch.parse_schema(SHARDED_SCHEMA)
+    rules = proxyrule.parse("""
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-islands}
+match: [{apiVersion: v1, resource: islands, verbs: [create]}]
+check: [{tpl: "namespace:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "island:{{name}}#owner@user:{{user.name}}"
+""")
+    pm = PartitionMap.parse("island=1", n_shards=2)
+    findings = lint_schema(schema, rules, partition_map=pm)
+    sl007 = [f for f in findings if f.code == "SL007"]
+    assert any("create-islands" in f.where for f in sl007)
+    pm = PartitionMap.parse("island=0", n_shards=2)
+    findings = lint_schema(schema, rules, partition_map=pm)
+    assert not [f for f in findings if f.code == "SL007"]
+
+
+def test_sl008_unknown_partition_map_key_warns():
+    from spicedb_kubeapi_proxy_tpu.spicedb.sharding import PartitionMap
+    schema = sch.parse_schema(SHARDED_SCHEMA)
+    findings = lint_schema(schema, (), partition_map=PartitionMap.parse(
+        "podd=1", n_shards=2))
+    sl008 = [f for f in findings if f.code == "SL008"]
+    assert sl008 and all(f.severity == "warn" for f in sl008)
+    assert "podd" in sl008[0].message
+
+
+def test_cli_lint_schema_partition_map(tmp_path, capsys):
+    """--lint-schema + --partition-map/--shards engages SL007/SL008
+    through the CLI (the startup-validation exit contract)."""
+    bootstrap = tmp_path / "bootstrap.yaml"
+    bootstrap.write_text("schema: |\n" + "\n".join(
+        "  " + line for line in SHARDED_SCHEMA.splitlines()))
+    rc = cli_main(["--lint-schema", "--spicedb-bootstrap", str(bootstrap),
+                   "--shards", "2", "--partition-map", "pod=1"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "SL007" in out
+    rc = cli_main(["--lint-schema", "--spicedb-bootstrap", str(bootstrap),
+                   "--shards", "2", "--partition-map",
+                   "pod=1,namespace=1"])
+    assert rc == 0

@@ -1,0 +1,1067 @@
+"""Partitioned write scale-out (spicedb/sharding, ISSUE 15).
+
+Covers the whole subsystem with the embedded (no-jax) backend so the
+suite runs in seconds:
+
+- PartitionMap: parsing, routing (incl. internal bookkeeping types and
+  write-batch determinism), footprint validation (the SL007 condition),
+  schema-derived map construction;
+- RevisionVector: encode/decode round trips, legacy floor semantics,
+  merging;
+- ShardedEndpoint: bootstrap splitting, oracle parity, cross-shard
+  write rejection, fan-out reads/deletes, merged watch, internal-type
+  read fan-out;
+- the PR 4 x sharding seam: a retried dual-write lands on the SAME
+  shard and converges via that shard's idempotency key;
+- ShardRouter over two real in-process shard-leader proxies: routing
+  table, revision-vector translation (a token ahead of one shard
+  waits/503s on that shard ONLY), leader-down isolation, health
+  aggregation;
+- ProxyServer --shards mode: per-shard WAL lineages, vector stamping,
+  the in-process vector gate, restart recovery, and the Sharding
+  gate-off tripwire (single-shard behavior exactly).
+"""
+
+import asyncio
+import json
+import os
+import shutil
+import tempfile
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.config import proxyrule
+from spicedb_kubeapi_proxy_tpu.kubefake.apiserver import FakeKubeApiServer
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (
+    HandlerTransport,
+    Headers,
+    Request,
+)
+from spicedb_kubeapi_proxy_tpu.proxy.server import Options, ProxyServer
+from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import (
+    Bootstrap,
+    merge_internal_definitions,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+from spicedb_kubeapi_proxy_tpu.spicedb.replication import (
+    MIN_REVISION_HEADER,
+    REVISION_HEADER,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.schema_lint import lint_schema
+from spicedb_kubeapi_proxy_tpu.spicedb.sharding import (
+    CrossShardWriteError,
+    PartitionMap,
+    PartitionMapError,
+    RevisionVector,
+    RevisionVectorError,
+    RouterConfigError,
+    ShardRouter,
+    build_routing_table,
+    build_sharded_endpoint,
+    partition_map_for_schema,
+)
+from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+    CheckRequest,
+    ObjectRef,
+    Permissionship,
+    RelationshipFilter,
+    RelationshipUpdate,
+    SubjectRef,
+    UpdateOp,
+    parse_relationship,
+)
+from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+definition podns {
+  relation creator: user
+  permission view = creator
+}
+definition pod {
+  relation creator: user
+  relation viewer: user
+  permission view = viewer + creator
+}
+"""
+
+# pod rules touch only shard-1 types (pod + podns co-located); the
+# namespace rules touch only shard 0 — every rule routes to ONE shard
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: get-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [get]}]
+check: [{tpl: "namespace:{{name}}#view@user:{{user.name}}"}]
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-namespaces}
+match: [{apiVersion: v1, resource: namespaces, verbs: [list]}]
+prefilter:
+- fromObjectIDNameExpr: "{{resourceId}}"
+  lookupMatchingResources: {tpl: "namespace:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+lock: Optimistic
+check: [{tpl: "podns:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+"""
+
+PMAP_SPEC = "pod=1,podns=1"
+
+
+def parsed_schema():
+    return merge_internal_definitions(sch.parse_schema(SCHEMA))
+
+
+@pytest.fixture(autouse=True)
+def reset_gates():
+    yield
+    GATES.reset()
+
+
+@pytest.fixture
+def tmp():
+    d = tempfile.mkdtemp(prefix="shard-test-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+# -- PartitionMap -------------------------------------------------------------
+
+
+class TestPartitionMap:
+    def test_parse_and_route(self):
+        pm = PartitionMap.parse("pod=1, podns=1", n_shards=2)
+        assert pm.shard_for_type("pod") == 1
+        assert pm.shard_for_type("namespace") == 0  # default shard
+        assert pm.describe()["assignments"] == {"pod": 1, "podns": 1}
+
+    def test_parse_errors(self):
+        with pytest.raises(PartitionMapError):
+            PartitionMap.parse("pod", n_shards=2)          # no '='
+        with pytest.raises(PartitionMapError):
+            PartitionMap.parse("pod=x", n_shards=2)        # non-int
+        with pytest.raises(PartitionMapError):
+            PartitionMap.parse("pod=2", n_shards=2)        # out of range
+        with pytest.raises(PartitionMapError):
+            PartitionMap.parse("pod=0,pod=1", n_shards=2)  # conflict
+        with pytest.raises(PartitionMapError):
+            PartitionMap(0)                                # no shards
+
+    def test_parse_infers_shard_count(self):
+        pm = PartitionMap.parse("a=0,b=3")
+        assert pm.n_shards == 4
+
+    def test_internal_types_hash_by_id_deterministically(self):
+        pm = PartitionMap.parse(PMAP_SPEC, n_shards=2)
+        shards = {pm.shard_of("workflow", f"wf-{i}") for i in range(64)}
+        assert shards == {0, 1}  # spread, not pinned to one shard
+        for i in range(8):
+            assert (pm.shard_of("lock", f"l{i}")
+                    == pm.shard_of("lock", f"l{i}"))
+
+    def test_write_batch_routing(self):
+        pm = PartitionMap.parse(PMAP_SPEC, n_shards=2)
+        pod = RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            "pod:a/p#creator@user:u"))
+        ns = RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            "namespace:a#creator@user:u"))
+        key = RelationshipUpdate(UpdateOp.CREATE, parse_relationship(
+            "workflow:wf1#idempotency_key@activity:h1"))
+        # single-type batches route by type; internal tuples ride along
+        assert pm.shard_for_updates([pod]) == 1
+        assert pm.shard_for_updates([pod, key]) == 1
+        assert pm.shard_for_updates([ns, key]) == 0
+        # internal-only batches route by stable id hash — retries land
+        # on the SAME shard
+        lock = RelationshipUpdate(UpdateOp.CREATE, parse_relationship(
+            "lock:the-lock#workflow@workflow:wf1"))
+        assert (pm.shard_for_updates([lock])
+                == pm.shard_for_updates([lock])
+                == pm.shard_of("lock", "the-lock"))
+        with pytest.raises(CrossShardWriteError):
+            pm.shard_for_updates([pod, ns])
+
+    def test_footprint_validation_spanning_closure(self):
+        # pod#view reaches namespace#viewer through the arrow: pod and
+        # namespace must co-locate, or SL007
+        schema = merge_internal_definitions(sch.parse_schema("""
+definition user {}
+definition namespace {
+  relation viewer: user
+  permission view = viewer
+}
+definition pod {
+  relation namespace: namespace
+  permission view = namespace->view
+}
+"""))
+        split = PartitionMap.parse("pod=1", n_shards=2)
+        errors, _ = split.validate_schema(schema)
+        assert errors and "pod#view" in errors[0][0]
+        together = PartitionMap.parse("pod=1,namespace=1", n_shards=2)
+        errors, _ = together.validate_schema(schema)
+        assert errors == []
+
+    def test_rule_template_spanning_is_an_error(self):
+        schema = parsed_schema()
+        rules = proxyrule.parse(RULES)
+        # create-pods checks podns and creates pod; split them apart
+        bad = PartitionMap.parse("pod=1", n_shards=2)
+        errors, _ = bad.validate_schema(schema, rules)
+        assert any("create-pods" in where for where, _ in errors)
+        good = PartitionMap.parse(PMAP_SPEC, n_shards=2)
+        errors, _ = good.validate_schema(schema, rules)
+        assert errors == []
+
+    def test_unknown_map_key_warns(self):
+        pm = PartitionMap.parse("no_such_type=1", n_shards=2)
+        errors, warnings = pm.validate_schema(parsed_schema())
+        assert errors == []
+        assert any("no_such_type" in where for where, _ in warnings)
+
+    def test_partition_map_for_schema_colocates_closures(self):
+        schema = merge_internal_definitions(sch.parse_schema("""
+definition user {}
+definition group { relation member: user | group#member }
+definition doc {
+  relation org: org
+  relation viewer: user | group#member
+  permission view = viewer + org->admin
+}
+definition org {
+  relation admin: user
+}
+definition island {
+  relation owner: user
+  permission own = owner
+}
+"""))
+        pm = partition_map_for_schema(schema, 2)
+        errors, _ = pm.validate_schema(schema)
+        assert errors == []
+        # doc's closure entangles group and org: one shard for all three
+        assert (pm.shard_for_type("doc") == pm.shard_for_type("group")
+                == pm.shard_for_type("org"))
+        # the independent type takes the other shard
+        assert pm.shard_for_type("island") != pm.shard_for_type("doc")
+
+
+# -- RevisionVector -----------------------------------------------------------
+
+
+class TestRevisionVector:
+    def test_round_trip(self):
+        v = RevisionVector.decode("0:12,2:7")
+        assert v.component(0) == 12 and v.component(2) == 7
+        assert v.component(1) == 0
+        assert RevisionVector.decode(v.encode()) == v
+
+    def test_legacy_floor(self):
+        v = RevisionVector.decode("9")
+        assert v.floor == 9 and v.component(5) == 9
+        assert v.encode() == "9"  # legacy token round-trips byte-identically
+        mixed = RevisionVector.decode("*:3,1:8")
+        assert mixed.component(0) == 3 and mixed.component(1) == 8
+
+    def test_empty(self):
+        assert RevisionVector.decode("").is_empty
+        assert RevisionVector.decode(None).encode() == ""
+
+    def test_merge(self):
+        v = RevisionVector.decode("0:5")
+        assert v.merged(1, 7).encode() == "0:5,1:7"
+        assert v.merged(0, 3).component(0) == 5  # max, never backwards
+        a, b = RevisionVector.decode("0:5,1:1"), RevisionVector.decode("1:9")
+        assert a.merged_with(b).encode() == "0:5,1:9"
+
+    def test_decode_errors(self):
+        for bad in ("x", "0:abc", "a:1", "-1:2", "0"):
+            if bad == "0":
+                assert RevisionVector.decode(bad).floor == 0
+                continue
+            with pytest.raises(RevisionVectorError):
+                RevisionVector.decode(bad)
+
+
+# -- ShardedEndpoint ----------------------------------------------------------
+
+
+def make_sharded(rels_text: str = ""):
+    pm = PartitionMap.parse(PMAP_SPEC, n_shards=2)
+    stores = [TupleStore(), TupleStore()]
+    ep = build_sharded_endpoint(
+        "embedded://",
+        Bootstrap(schema_text=SCHEMA, relationships_text=rels_text),
+        pm, stores, rule_configs=proxyrule.parse(RULES))
+    return ep, stores, pm
+
+
+class TestShardedEndpoint:
+    def test_bootstrap_splits_by_shard(self):
+        ep, stores, _ = make_sharded(
+            "namespace:a#creator@user:alice\n"
+            "pod:a/p#creator@user:alice\n"
+            "podns:a#creator@user:alice")
+        assert {r.resource.type for r in stores[0].read(None)} == {
+            "namespace"}
+        assert {r.resource.type for r in stores[1].read(None)} == {
+            "pod", "podns"}
+
+    def test_parity_with_whole_store_oracle(self):
+        rels = ("namespace:a#creator@user:alice\n"
+                "namespace:b#viewer@user:bob\n"
+                "pod:a/p#creator@user:alice\n"
+                "pod:a/q#viewer@user:bob\n"
+                "podns:a#creator@user:alice")
+        ep, stores, _ = make_sharded(rels)
+        mirror = TupleStore()
+        mirror.bulk_load([parse_relationship(line)
+                          for line in rels.splitlines()])
+        oracle = Evaluator(parsed_schema(), mirror)
+
+        async def go():
+            for rtype in ("namespace", "pod", "podns"):
+                for user in ("alice", "bob", "nobody"):
+                    subject = SubjectRef("user", user)
+                    want = sorted(oracle.lookup_resources(rtype, "view",
+                                                          subject))
+                    got = sorted(await ep.lookup_resources(rtype, "view",
+                                                           subject))
+                    assert got == want, (rtype, user)
+                    for oid in mirror.object_ids_of_type(rtype):
+                        res = await ep.check_permission(CheckRequest(
+                            ObjectRef(rtype, oid), "view", subject))
+                        want3 = oracle.check3(ObjectRef(rtype, oid),
+                                              "view", subject)
+                        got3 = {Permissionship.NO_PERMISSION: 0,
+                                Permissionship.CONDITIONAL_PERMISSION: 1,
+                                Permissionship.HAS_PERMISSION: 2}[
+                                    res.permissionship]
+                        assert got3 == want3, (rtype, oid, user)
+
+        asyncio.run(go())
+
+    def test_bulk_check_spanning_shards_reassembles_in_order(self):
+        ep, _, _ = make_sharded(
+            "namespace:a#creator@user:alice\npod:a/p#creator@user:alice")
+
+        async def go():
+            reqs = [
+                CheckRequest(ObjectRef("pod", "a/p"), "view",
+                             SubjectRef("user", "alice")),
+                CheckRequest(ObjectRef("namespace", "a"), "view",
+                             SubjectRef("user", "alice")),
+                CheckRequest(ObjectRef("pod", "a/p"), "view",
+                             SubjectRef("user", "bob")),
+            ]
+            res = await ep.check_bulk_permissions(reqs)
+            assert [r.permissionship for r in res] == [
+                Permissionship.HAS_PERMISSION,
+                Permissionship.HAS_PERMISSION,
+                Permissionship.NO_PERMISSION]
+
+        asyncio.run(go())
+
+    def test_cross_shard_write_rejected(self):
+        ep, stores, _ = make_sharded()
+        ups = [RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(s))
+               for s in ("pod:a/p#creator@user:u",
+                         "namespace:a#creator@user:u")]
+
+        async def go():
+            with pytest.raises(CrossShardWriteError):
+                await ep.write_relationships(ups)
+
+        asyncio.run(go())
+        # neither shard advanced: the batch was rejected before any
+        # single-shard application could tear it
+        assert stores[0].revision == 0 and stores[1].revision == 0
+
+    def test_untyped_precondition_rejected(self):
+        """A precondition with no resource type could match tuples on a
+        foreign shard — evaluating it against only the routed shard's
+        subset would silently diverge from single-leader semantics, so
+        it is refused like a typed-foreign-shard filter.  Internal-type
+        filters (the pessimistic lock's must_not_match) stay shard-local
+        by design."""
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+            Precondition,
+            PreconditionOp,
+        )
+        ep, stores, _ = make_sharded()
+        pod = [RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+            "pod:a/p#creator@user:u"))]
+
+        async def go():
+            with pytest.raises(CrossShardWriteError, match="untyped"):
+                await ep.write_relationships(pod, [Precondition(
+                    op=PreconditionOp.MUST_NOT_MATCH,
+                    filter=RelationshipFilter(relation="creator"))])
+            # typed-on-foreign-shard still rejects; typed-on-own-shard
+            # and internal-type filters pass
+            with pytest.raises(CrossShardWriteError):
+                await ep.write_relationships(pod, [Precondition(
+                    op=PreconditionOp.MUST_NOT_MATCH,
+                    filter=RelationshipFilter(resource_type="namespace"))])
+            await ep.write_relationships(pod, [Precondition(
+                op=PreconditionOp.MUST_NOT_MATCH,
+                filter=RelationshipFilter(resource_type="pod",
+                                          resource_id="a/other"))])
+            await ep.write_relationships(pod, [Precondition(
+                op=PreconditionOp.MUST_NOT_MATCH,
+                filter=RelationshipFilter(resource_type="lock",
+                                          resource_id="nope"))])
+
+        asyncio.run(go())
+
+    def test_untyped_read_and_delete_fan_out(self):
+        ep, stores, _ = make_sharded(
+            "namespace:a#viewer@user:u\npod:a/p#viewer@user:u")
+
+        async def go():
+            rels = await ep.read_relationships(None)
+            assert {r.resource.type for r in rels} == {"namespace", "pod"}
+            await ep.delete_relationships(RelationshipFilter(
+                subject=None, resource_type="", relation="viewer"))
+            assert await ep.read_relationships(None) == []
+
+        asyncio.run(go())
+
+    def test_internal_type_reads_fan_out(self):
+        """An idempotency key rides its batch's shard; the later key
+        lookup (typed on `workflow`) must find it wherever it landed."""
+        ep, stores, _ = make_sharded()
+
+        import time as _time
+        from spicedb_kubeapi_proxy_tpu.spicedb.types import Relationship
+        key_rel = Relationship(
+            resource=ObjectRef("workflow", "wf-1"),
+            relation="idempotency_key",
+            subject=SubjectRef("activity", "h1"),
+            expires_at=_time.time() + 3600)
+
+        async def go():
+            await ep.write_relationships([
+                RelationshipUpdate(UpdateOp.TOUCH, parse_relationship(
+                    "pod:a/p#creator@user:u")),
+                RelationshipUpdate(UpdateOp.CREATE, key_rel),
+            ])
+            # the key landed on pod's shard (1), not hash(wf-1)'s shard
+            assert any(r.resource.type == "workflow"
+                       for r in stores[1].read(None))
+            found = await ep.read_relationships(RelationshipFilter(
+                resource_type="workflow", resource_id="wf-1",
+                relation="idempotency_key"))
+            assert len(found) == 1
+
+        asyncio.run(go())
+
+    def test_merged_watch_sees_both_shards(self):
+        ep, _, _ = make_sharded()
+        w = ep.watch(["pod", "namespace"])
+
+        async def go():
+            await ep.write_relationships([RelationshipUpdate(
+                UpdateOp.TOUCH,
+                parse_relationship("pod:a/p#viewer@user:u"))])
+            await ep.write_relationships([RelationshipUpdate(
+                UpdateOp.TOUCH,
+                parse_relationship("namespace:a#viewer@user:u"))])
+            seen = set()
+            for _ in range(2):
+                batch = await w.next(timeout=5.0)
+                assert batch is not None
+                seen.update(u.rel.resource.type for u in batch.updates)
+            assert seen == {"pod", "namespace"}
+            w.close()
+            assert await w.next(timeout=1.0) is None
+
+        asyncio.run(go())
+
+    def test_single_type_watch_routes_to_one_shard(self):
+        ep, _, _ = make_sharded()
+        w = ep.watch(["pod"])
+        # a plain shard watcher, not the merged fan-out
+        from spicedb_kubeapi_proxy_tpu.spicedb.sharding import MergedWatcher
+        assert not isinstance(w, MergedWatcher)
+        w.close()
+
+    def test_revision_vector_tracks_per_shard_writes(self):
+        ep, stores, _ = make_sharded()
+
+        async def go():
+            for _ in range(3):
+                await ep.write_relationships([RelationshipUpdate(
+                    UpdateOp.TOUCH,
+                    parse_relationship("pod:a/p#viewer@user:u"))])
+
+        asyncio.run(go())
+        vec = ep.revision_vector()
+        assert vec.component(1) == stores[1].revision == 3
+        assert vec.component(0) == stores[0].revision == 0
+
+
+# -- the PR 4 x sharding seam -------------------------------------------------
+
+
+class _NullTransport:
+    async def round_trip(self, req):  # pragma: no cover - never called
+        raise AssertionError("no kube traffic expected")
+
+
+class TestDualWriteSeam:
+    def test_retried_dual_write_converges_on_same_shard(self):
+        """write_to_spicedb attaches the idempotency key in the SAME
+        batch as the rule tuples; a retry routes to the SAME shard
+        (deterministic batch routing), the CREATE conflicts there, and
+        the error path finds the key — converged, exactly once."""
+        from spicedb_kubeapi_proxy_tpu.authz.distributedtx.activity import (
+            ActivityHandler,
+        )
+        ep, stores, pm = make_sharded("podns:a#creator@user:alice")
+        handler = ActivityHandler(ep, _NullTransport())
+        write_request = {
+            "updates": [{"op": "create",
+                         "rel": "pod:a/p#creator@user:alice"}],
+            "preconditions": [],
+        }
+
+        async def go():
+            first = await handler.write_to_spicedb(write_request, "wf-77")
+            assert first["written_at"] >= 1
+            # the key and the pod tuple landed together on shard 1
+            shard1_types = {r.resource.type for r in stores[1].read(None)}
+            assert {"pod", "workflow"} <= shard1_types
+            assert not any(r.resource.type == "workflow"
+                           for r in stores[0].read(None))
+            # the retry: same payload + workflow id -> same shard, the
+            # CREATE conflicts, the existing key proves it landed
+            second = await handler.write_to_spicedb(write_request, "wf-77")
+            assert second["written_at"] >= first["written_at"]
+            pods = await ep.read_relationships(RelationshipFilter(
+                resource_type="pod", resource_id="a/p"))
+            assert len(pods) == 1
+
+        asyncio.run(go())
+
+    def test_pessimistic_lock_release_lands_on_lock_shard(self):
+        """The pessimistic acquire batch rides the rule tuples to their
+        type's shard; the post-success release batch is internal-only
+        and must find the lock THERE — not on the stable-hash shard its
+        id alone would suggest.  A release landing elsewhere leaks the
+        lock and permanently 409s the object (the reviewed regression)."""
+        from spicedb_kubeapi_proxy_tpu.authz.distributedtx.activity import (
+            ActivityHandler,
+        )
+        from spicedb_kubeapi_proxy_tpu.spicedb.sharding.partition import (
+            _stable_shard,
+        )
+        ep, stores, pm = make_sharded("podns:a#creator@user:alice")
+        handler = ActivityHandler(ep, _NullTransport())
+        # a lock id whose hash routes to shard 0, while the acquiring
+        # batch's pod tuple pins the batch — lock included — to shard 1
+        lock_id = next(f"lk{i}" for i in range(64)
+                       if _stable_shard(f"lk{i}", 2) == 0)
+        lock_rel = f"lock:{lock_id}#workflow@workflow:wf-9"
+        precondition = {
+            "op": "must_not_match",
+            "filter": {"resource_type": "lock", "resource_id": lock_id,
+                       "relation": "workflow",
+                       "subject": {"type": "workflow", "id": "",
+                                   "relation": None}},
+        }
+        acquire = {
+            "updates": [
+                {"op": "create", "rel": "pod:a/p#creator@user:alice"},
+                {"op": "create", "rel": lock_rel},
+            ],
+            "preconditions": [precondition],
+        }
+        release = {"updates": [{"op": "delete", "rel": lock_rel}],
+                   "preconditions": []}
+
+        async def go():
+            await handler.write_to_spicedb(acquire, "wf-9")
+            assert any(r.resource.type == "lock"
+                       for r in stores[1].read(None))
+            await handler.write_to_spicedb(release, "wf-9-cleanup")
+            for k, st in enumerate(stores):
+                assert not any(r.resource.type == "lock"
+                               for r in st.read(None)), (
+                    f"lock leaked on shard {k}")
+            # the lock is free again: a second acquire's must_not_match
+            # precondition passes on the meeting shard
+            reacquire = {
+                "updates": [
+                    {"op": "touch", "rel": "pod:a/p#creator@user:alice"},
+                    {"op": "create", "rel": lock_rel},
+                ],
+                "preconditions": [precondition],
+            }
+            await handler.write_to_spicedb(reacquire, "wf-10")
+
+        asyncio.run(go())
+
+
+# -- schema lint SL007/SL008 --------------------------------------------------
+
+
+class TestShardingLint:
+    def test_sl007_error_on_spanning_rule(self):
+        schema = parsed_schema()
+        rules = proxyrule.parse(RULES)
+        findings = lint_schema(schema, rules,
+                               partition_map=PartitionMap.parse(
+                                   "pod=1", n_shards=2))
+        codes = {(f.code, f.severity) for f in findings}
+        assert ("SL007", "error") in codes
+        assert any(f.code == "SL007" and "create-pods" in f.where
+                   for f in findings)
+
+    def test_sl008_warn_on_unknown_type(self):
+        findings = lint_schema(parsed_schema(), (),
+                               partition_map=PartitionMap.parse(
+                                   "mystery=1", n_shards=2))
+        sl8 = [f for f in findings if f.code == "SL008"]
+        assert sl8 and sl8[0].severity == "warn"
+        assert not any(f.code == "SL007" for f in findings)
+
+    def test_clean_map_adds_no_sharding_findings(self):
+        findings = lint_schema(parsed_schema(), proxyrule.parse(RULES),
+                               partition_map=PartitionMap.parse(
+                                   PMAP_SPEC, n_shards=2))
+        assert not any(f.code in ("SL007", "SL008") for f in findings)
+
+    def test_no_map_no_sharding_passes(self):
+        findings = lint_schema(parsed_schema(), proxyrule.parse(RULES))
+        assert not any(f.code in ("SL007", "SL008") for f in findings)
+
+
+# -- HTTP router over real in-process shard leaders ---------------------------
+
+
+def make_shard_leader(tmp, subdir, seed_rels):
+    kube = FakeKubeApiServer()
+    kube.seed("", "v1", "namespaces", {"metadata": {"name": "team-a"}})
+    proxy = ProxyServer(Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(schema_text=SCHEMA),
+        rules_yaml=RULES,
+        upstream_transport=HandlerTransport(kube),
+        data_dir=os.path.join(tmp, subdir),
+        wal_fsync="never",
+        replica_wait_ms=50.0,
+    ))
+    if seed_rels and proxy.endpoint.store.revision == 0:
+        proxy.endpoint.store.bulk_load(
+            [parse_relationship(r) for r in seed_rels])
+    proxy.enable_dual_writes()
+    return proxy
+
+
+def make_router(tmp):
+    shard0 = make_shard_leader(tmp, "s0",
+                               ["namespace:team-a#creator@user:alice"])
+    shard1 = make_shard_leader(tmp, "s1",
+                               ["podns:team-a#creator@user:alice"])
+    pm = PartitionMap.parse(PMAP_SPEC, n_shards=2)
+    router = ShardRouter(
+        pm, [HandlerTransport(shard0.handler),
+             HandlerTransport(shard1.handler)],
+        rule_configs=proxyrule.parse(RULES), schema=parsed_schema())
+    return router, shard0, shard1
+
+
+async def router_req(router, method, target, user="alice", body=None,
+                     headers=()):
+    h = Headers(list(headers))
+    h.set("X-Remote-User", user)
+    h.set("Accept", "application/json")
+    data = b""
+    if body is not None:
+        data = json.dumps(body).encode()
+        h.set("Content-Type", "application/json")
+    return await router.handle(Request(method=method, target=target,
+                                       headers=h, body=data))
+
+
+class TestShardRouter:
+    def test_routing_table_from_rules(self):
+        pm = PartitionMap.parse(PMAP_SPEC, n_shards=2)
+        table = build_routing_table(pm, proxyrule.parse(RULES),
+                                    parsed_schema())
+        assert table == {"namespaces": 0, "pods": 1}
+
+    def test_spanning_rule_refuses_to_boot(self):
+        pm = PartitionMap.parse("pod=1", n_shards=2)  # podns left on 0
+        with pytest.raises(RouterConfigError):
+            build_routing_table(pm, proxyrule.parse(RULES),
+                                parsed_schema())
+
+    def test_conflicting_resource_pin_refuses_to_boot(self):
+        conflicting = RULES + """
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: pods-as-namespace}
+match: [{apiVersion: v1, resource: pods, verbs: [delete]}]
+check: [{tpl: "namespace:{{namespace}}#view@user:{{user.name}}"}]
+"""
+        pm = PartitionMap.parse(PMAP_SPEC, n_shards=2)
+        with pytest.raises(RouterConfigError):
+            build_routing_table(pm, proxyrule.parse(conflicting),
+                                parsed_schema())
+
+    def test_dual_write_routes_to_owning_shard(self, tmp):
+        router, shard0, shard1 = make_router(tmp)
+
+        async def go():
+            resp = await router_req(
+                router, "POST", "/api/v1/namespaces/team-a/pods",
+                body={"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": "p1", "namespace": "team-a"}})
+            assert resp.status in (200, 201), resp.body
+            assert resp.headers.get("X-Authz-Shard") == "1"
+            vec = RevisionVector.decode(
+                resp.headers.get(REVISION_HEADER))
+            assert vec.component(1) > 0 and vec.component(0) == 0
+            # the tuple landed on shard 1's store only
+            assert shard1.endpoint.store.has_exact(parse_relationship(
+                "pod:team-a/p1#creator@user:alice"))
+            assert not shard0.endpoint.store.has_exact(parse_relationship(
+                "pod:team-a/p1#creator@user:alice"))
+            # reads of namespaces route to shard 0
+            resp = await router_req(router, "GET",
+                                    "/api/v1/namespaces/team-a")
+            assert resp.headers.get("X-Authz-Shard") == "0"
+
+        asyncio.run(go())
+
+    def test_vector_token_gates_one_shard_only(self, tmp):
+        router, shard0, shard1 = make_router(tmp)
+
+        async def go():
+            future = shard1.endpoint.store.revision + 100
+            tok = [(MIN_REVISION_HEADER, f"1:{future}")]
+            # shard 0 has NO demand from this token: serves immediately
+            resp = await router_req(router, "GET",
+                                    "/api/v1/namespaces/team-a",
+                                    headers=tok)
+            assert resp.status == 200, resp.body
+            # shard 1 is behind the token's component: 503 after the
+            # bounded wait (the shard's own leader gate, unchanged)
+            resp = await router_req(
+                router, "GET", "/api/v1/namespaces/team-a/pods",
+                headers=tok)
+            assert resp.status == 503, resp.body
+            # a satisfied component serves
+            sat = [(MIN_REVISION_HEADER,
+                    f"1:{shard1.endpoint.store.revision}")]
+            resp = await router_req(
+                router, "GET", "/api/v1/namespaces/team-a/pods",
+                headers=sat)
+            assert resp.status == 200, resp.body
+
+        asyncio.run(go())
+
+    def test_legacy_bare_token_floors_every_shard(self, tmp):
+        router, shard0, _ = make_router(tmp)
+
+        async def go():
+            future = shard0.endpoint.store.revision + 100
+            resp = await router_req(
+                router, "GET", "/api/v1/namespaces/team-a",
+                headers=[(MIN_REVISION_HEADER, str(future))])
+            assert resp.status == 503, resp.body
+
+        asyncio.run(go())
+
+    def test_invalid_vector_is_400(self, tmp):
+        router, _, _ = make_router(tmp)
+
+        async def go():
+            resp = await router_req(
+                router, "GET", "/api/v1/namespaces/team-a",
+                headers=[(MIN_REVISION_HEADER, "bogus:::")])
+            assert resp.status == 400
+
+        asyncio.run(go())
+
+    def test_dead_shard_leaves_other_serving(self, tmp):
+        """The satellite's core assertion, in-process: with shard 1
+        unreachable, shard 0 keeps taking dual-writes."""
+        router, shard0, _ = make_router(tmp)
+
+        class Dead:
+            async def round_trip(self, req):
+                raise ConnectionError("kill -9")
+
+        router.transports[1] = Dead()
+
+        async def go():
+            resp = await router_req(
+                router, "GET", "/api/v1/namespaces/team-a/pods")
+            assert resp.status == 502
+            assert json.loads(resp.body)["details"]["shard"] == 1
+            resp = await router_req(router, "GET",
+                                    "/api/v1/namespaces/team-a")
+            assert resp.status == 200, resp.body
+            health = await router_req(router, "GET", "/readyz")
+            assert health.status == 200
+            assert b"[-] shard 1" in health.body
+            assert b"shard 0" in health.body
+
+        asyncio.run(go())
+
+    def test_gate_off_is_passthrough_to_default_shard(self, tmp):
+        router, shard0, shard1 = make_router(tmp)
+        GATES.set("Sharding", False)
+
+        async def go():
+            resp = await router_req(
+                router, "POST", "/api/v1/namespaces/team-a/pods",
+                body={"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": "p9", "namespace": "team-a"}})
+            # pass-through to shard 0 (default), untouched headers: the
+            # single-leader behavior exactly — shard 0 rejects the pod
+            # create (no podns grant there), proving no routing happened
+            assert not resp.headers.get("X-Authz-Shard")
+            rev = resp.headers.get(REVISION_HEADER) or ""
+            assert ":" not in rev  # bare integer stamp, not a vector
+            # health and /metrics pass through too — no aggregation
+            # fan-out, no router-local registry: what monitoring sees is
+            # shard 0's own surface
+            health = await router_req(router, "GET", "/readyz")
+            assert health.status == 200
+            assert b"shard 1" not in health.body
+
+        asyncio.run(go())
+
+
+# -- ProxyServer --shards mode ------------------------------------------------
+
+
+def make_sharded_proxy(tmp=None, rules_yaml_override=None, **opt_kw):
+    kube = FakeKubeApiServer()
+    kube.seed("", "v1", "namespaces", {"metadata": {"name": "team-a"}})
+    proxy = ProxyServer(Options(
+        spicedb_endpoint="embedded://",
+        bootstrap=Bootstrap(
+            schema_text=SCHEMA,
+            relationships_text=("namespace:team-a#creator@user:alice\n"
+                                "podns:team-a#creator@user:alice")),
+        rules_yaml=(rules_yaml_override if rules_yaml_override is not None
+                    else RULES),
+        upstream_transport=HandlerTransport(kube),
+        shards=2, partition_map=PMAP_SPEC,
+        **({"data_dir": tmp, "wal_fsync": "never"} if tmp else {}),
+        **opt_kw,
+    ))
+    proxy.enable_dual_writes()
+    return proxy
+
+
+class TestShardedProxyServer:
+    def test_dual_write_lands_on_owning_shard(self, tmp):
+        proxy = make_sharded_proxy(tmp)
+        client = proxy.get_embedded_client("alice")
+
+        async def go():
+            resp = await client.post(
+                "/api/v1/namespaces/team-a/pods",
+                {"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "p1", "namespace": "team-a"}})
+            assert resp.status in (200, 201), resp.body
+            vec = RevisionVector.decode(resp.headers.get(REVISION_HEADER))
+            assert vec.component(1) > 0
+            stores = proxy.endpoint.shard_stores()
+            assert stores[1].has_exact(parse_relationship(
+                "pod:team-a/p1#creator@user:alice"))
+            assert not stores[0].has_exact(parse_relationship(
+                "pod:team-a/p1#creator@user:alice"))
+            # the filtered list over pods touches shard 1 only
+            resp = await client.get("/api/v1/namespaces/team-a/pods")
+            assert resp.status == 200
+            names = [i["metadata"]["name"]
+                     for i in json.loads(resp.body).get("items", [])]
+            assert "p1" in names
+
+        asyncio.run(go())
+
+    def test_vector_gate_refuses_future_component(self, tmp):
+        proxy = make_sharded_proxy(tmp)
+        client = proxy.get_embedded_client("alice")
+
+        async def go():
+            resp = await client.get(
+                "/api/v1/namespaces/team-a",
+                headers=[(MIN_REVISION_HEADER, "0:999")])
+            assert resp.status == 503, resp.body
+            resp = await client.get(
+                "/api/v1/namespaces/team-a",
+                headers=[(MIN_REVISION_HEADER, "0:1")])
+            assert resp.status == 200, resp.body
+            resp = await client.get(
+                "/api/v1/namespaces/team-a",
+                headers=[(MIN_REVISION_HEADER, "junk:")])
+            assert resp.status == 400
+
+        asyncio.run(go())
+
+    def test_vector_gate_refuses_unknown_shard_component(self, tmp):
+        """A component naming a shard outside this fleet (a token from
+        another fleet or a larger map) is refused 503 — not silently
+        dropped, which would serve below the client's staleness bound."""
+        proxy = make_sharded_proxy(tmp)
+        client = proxy.get_embedded_client("alice")
+
+        async def go():
+            resp = await client.get(
+                "/api/v1/namespaces/team-a",
+                headers=[(MIN_REVISION_HEADER, "5:9")])
+            assert resp.status == 503, resp.body
+            assert b"shard(s) [5]" in resp.body
+            # a zero component demands nothing — serve
+            resp = await client.get(
+                "/api/v1/namespaces/team-a",
+                headers=[(MIN_REVISION_HEADER, "5:0")])
+            assert resp.status == 200, resp.body
+
+        asyncio.run(go())
+
+    def test_pessimistic_dual_write_releases_lock_on_owning_shard(self, tmp):
+        """Default lock mode: the lock rides the acquire batch to the
+        rule types' shard; its release (an internal-only delete) must
+        land on that SAME shard.  A leaked lock turns every retry of
+        the same path/name/verb into a permanent 409."""
+        proxy = make_sharded_proxy(
+            tmp, rules_yaml_override=RULES.replace("lock: Optimistic",
+                                                   "lock: Pessimistic"))
+        client = proxy.get_embedded_client("alice")
+
+        async def go():
+            # several names so at least one lock id hashes to shard 0
+            # while its acquire batch rides the pod tuples to shard 1
+            for name in ("p1", "p2", "p3", "p4"):
+                resp = await client.post(
+                    "/api/v1/namespaces/team-a/pods",
+                    {"apiVersion": "v1", "kind": "Pod",
+                     "metadata": {"name": name, "namespace": "team-a"}})
+                assert resp.status in (200, 201), resp.body
+            for k, st in enumerate(proxy.endpoint.shard_stores()):
+                leaked = [r for r in st.read(None)
+                          if r.resource.type == "lock"]
+                assert not leaked, f"locks leaked on shard {k}: {leaked}"
+
+        asyncio.run(go())
+
+    def test_per_shard_wal_lineages_and_recovery(self, tmp):
+        proxy = make_sharded_proxy(tmp)
+        client = proxy.get_embedded_client("alice")
+
+        async def go():
+            resp = await client.post(
+                "/api/v1/namespaces/team-a/pods",
+                {"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": "p1", "namespace": "team-a"}})
+            assert resp.status in (200, 201), resp.body
+
+        asyncio.run(go())
+        revs = [s.revision for s in proxy.endpoint.shard_stores()]
+        assert os.path.isdir(os.path.join(tmp, "shard-0"))
+        assert os.path.isdir(os.path.join(tmp, "shard-1"))
+        # a fresh server over the same data dir recovers each shard's
+        # lineage independently (bootstrap-once per shard store)
+        proxy2 = make_sharded_proxy(tmp)
+        revs2 = [s.revision for s in proxy2.endpoint.shard_stores()]
+        assert revs2 == revs
+        assert proxy2.endpoint.shard_stores()[1].has_exact(
+            parse_relationship("pod:team-a/p1#creator@user:alice"))
+
+    def test_spanning_partition_map_refuses_to_boot(self, tmp):
+        kube = FakeKubeApiServer()
+        with pytest.raises(RouterConfigError):
+            ProxyServer(Options(
+                spicedb_endpoint="embedded://",
+                bootstrap=Bootstrap(schema_text=SCHEMA),
+                rules_yaml=RULES,
+                upstream_transport=HandlerTransport(kube),
+                shards=2, partition_map="pod=1",  # podns left on shard 0
+            ))
+
+    def test_gate_off_tripwire_single_shard_exactly(self):
+        """Sharding=false: --shards is inert — no ShardedEndpoint, no
+        partition map, single store, bare-integer-free revision stamps
+        (no replication either), and the shard metrics tick nothing."""
+        GATES.set("Sharding", False)
+        from spicedb_kubeapi_proxy_tpu.spicedb.sharding import (
+            metrics as shard_metrics,
+        )
+        before = dict(shard_metrics._routed.snapshot())
+        proxy = make_sharded_proxy()
+        assert proxy.sharding is None
+        assert not hasattr(proxy.endpoint.inner, "shards")
+        client = proxy.get_embedded_client("alice")
+
+        async def go():
+            resp = await client.get("/api/v1/namespaces/team-a")
+            assert resp.status == 200
+            assert not resp.headers.get(REVISION_HEADER)
+
+        asyncio.run(go())
+        assert dict(shard_metrics._routed.snapshot()) == before
+
+    def test_router_cli_malformed_bootstrap_is_a_clean_error(self, tmp,
+                                                             capsys):
+        """Router mode: a YAML syntax error in --spicedb-bootstrap exits
+        1 with the uniform `error:` line, like every other config-error
+        path — not a raw yaml.YAMLError traceback."""
+        from spicedb_kubeapi_proxy_tpu import cli
+        bad = os.path.join(tmp, "bad.yaml")
+        with open(bad, "w") as f:
+            f.write("schema: [unclosed\n")
+        rc = cli.main(["--shard-leaders",
+                       "http://127.0.0.1:1,http://127.0.0.1:2",
+                       "--embedded-mode", "--spicedb-bootstrap", bad])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert err.startswith("error:"), err
+
+    def test_debug_sharding_surface(self, tmp):
+        proxy = make_sharded_proxy(tmp)
+        client = proxy.get_embedded_client("alice")
+
+        async def go():
+            resp = await client.get("/debug/sharding")
+            assert resp.status == 200
+            data = json.loads(resp.body)
+            assert data["enabled"] is True
+            assert data["partition_map"]["assignments"] == {
+                "pod": 1, "podns": 1}
+            assert set(data["shard_revisions"]) == {"0", "1"}
+
+        asyncio.run(go())
