@@ -33,7 +33,7 @@ MAX_LINE = 100
 ALLOWED_METRIC_LABELS = frozenset((
     "verb", "code", "phase", "backend", "resource", "reason", "stage",
     "decision", "generation", "kind", "le", "bucket", "slo", "window",
-    "cause", "mode", "shard",
+    "cause", "mode", "shard", "tier",
 ))
 _METRIC_FACTORIES = ("counter", "gauge", "histogram")
 _M001_PREFIX = "spicedb_kubeapi_proxy_tpu"

@@ -1,0 +1,73 @@
+"""A006 (internal HTTP hop without trace propagation).
+
+Fleet tracing (docs/observability.md, "Fleet tracing") only works when
+EVERY internal hop carries the `X-Authz-Trace-Id` /
+`X-Authz-Parent-Span` headers — one un-instrumented `round_trip` call
+and the merged `/debug/fleet` trace silently loses a tier.  The rule is
+lexical, matching the failure mode: someone adds a new outbound call
+and forgets the headers.
+
+A function that calls `*.round_trip(...)` must reference `hop_span` or
+`propagation_headers` (the two sanctioned ways to attach the headers)
+somewhere in the same function.  Exemptions:
+
+  * functions themselves named `round_trip` — transport wrappers
+    (retry/auth shims) delegate to a base transport and must pass the
+    caller's headers through untouched, not mint their own;
+  * `# noqa: A006(reason)` — for genuinely external hops (the upstream
+    kube apiserver does not speak our header contract) and client entry
+    points that originate rather than forward requests.
+"""
+
+from __future__ import annotations
+
+import ast
+
+_PROPAGATORS = frozenset(("hop_span", "propagation_headers"))
+
+
+def _references_propagator(func_node: ast.AST) -> bool:
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Name) and node.id in _PROPAGATORS:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _PROPAGATORS:
+            return True
+    return False
+
+
+def _enclosing_function(src, node):
+    cur = src.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = src.parents.get(cur)
+    return None
+
+
+def rule_a006(sources) -> list:
+    findings: list = []
+    for src in sources:
+        # cache the propagator check per function — fan-out helpers can
+        # hold several round_trip call sites
+        checked: dict = {}
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "round_trip"):
+                continue
+            fn = _enclosing_function(src, node)
+            if fn is not None and fn.name == "round_trip":
+                continue  # transport wrapper: pass-through by contract
+            scope = fn if fn is not None else src.tree
+            ok = checked.get(id(scope))
+            if ok is None:
+                ok = _references_propagator(scope)
+                checked[id(scope)] = ok
+            if ok:
+                continue
+            findings.append(src.finding(
+                "A006", node,
+                "outbound HTTP hop without trace propagation — attach "
+                "headers via hop_span()/propagation_headers(), or mark "
+                "external hops `# noqa: A006(reason)`"))
+    return findings

@@ -3,7 +3,7 @@
 
 ONE entry point for every static gate in the repo:
 
-  A001-A005  concurrency & hot-path rules (scripts/analysis/rules_*)
+  A001-A006  concurrency & hot-path rules (scripts/analysis/rules_*)
   M-rules    the historical scripts/lint.py families (legacy_lint)
   SL-rules   schema/rule lint, bridged via
              `python -m spicedb_kubeapi_proxy_tpu --lint-schema --lint-schema-json`
@@ -42,6 +42,7 @@ from analysis.rules_async import rule_a001, rule_a002  # noqa: E402
 from analysis.rules_gates import rule_a004  # noqa: E402
 from analysis.rules_jit import rule_a005  # noqa: E402
 from analysis.rules_locks import rule_a003  # noqa: E402
+from analysis.rules_trace import rule_a006  # noqa: E402
 
 RULES = {
     "A001": rule_a001,
@@ -49,6 +50,7 @@ RULES = {
     "A003": rule_a003,
     "A004": rule_a004,
     "A005": rule_a005,
+    "A006": rule_a006,
 }
 DEFAULT_PATHS = ["spicedb_kubeapi_proxy_tpu"]
 BASELINE = ROOT / "scripts" / "analysis" / "baseline.json"

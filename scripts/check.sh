@@ -69,7 +69,7 @@ echo "== crash-recovery smoke (kill -9 mid write-churn, restart, parity)"
 python scripts/crash_smoke.py
 
 echo "== replication smoke (leader + follower over localhost, kill -9,"
-echo "   promote, old leader rejoins as follower)"
+echo "   promote, rejoin; fleet trace through 3 tiers; sharded router)"
 # WAL-shipping read replicas + failover (docs/replication.md): write
 # through the leader, assert the follower serves the filtered list
 # within the lag bound, kill -9 the leader, assert bounded-staleness
@@ -77,7 +77,11 @@ echo "   promote, old leader rejoins as follower)"
 # follower (new incarnation), land a write locally with the pre-kill
 # write still readable (zero lost), resurrect the old leader and
 # assert the startup fence probe demotes it into a forwarding follower
-# (fast, embedded endpoint, no jax on the serving path)
+# (fast, embedded endpoint, no jax on the serving path).  Then fleet
+# tracing (docs/observability.md "Fleet tracing"): one dual-write
+# through router -> follower -> leader, asserting the merged
+# /debug/fleet trace spans all three tiers and reconciles with the
+# client-measured e2e latency; then the sharded write scale-out.
 JAX_PLATFORMS=cpu python scripts/replication_smoke.py
 
 echo "== device-telemetry smoke (/metrics + /debug/flight + /debug/timeline)"

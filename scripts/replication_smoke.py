@@ -22,6 +22,13 @@ Three REAL processes over localhost HTTP:
      and forwards new writes to the new leader.  Exactly one writable
      leader after the partition heals.
 
+Then the fleet tracing section (ISSUE 16): a one-shard CLI router in
+front of the rejoined follower drives a dual-write through THREE
+tiers (router -> follower -> promoted leader) and asserts the merged
+/debug/fleet view carries one trace spanning all three tiers whose
+per-tier attribution reconciles with the client-measured end-to-end
+latency (docs/observability.md "Fleet tracing").
+
 Then the sharded write scale-out section (ISSUE 15): TWO shard-leader
 proxies (pods+namespaces on shard 0, configmaps+cfgns on shard 1, each
 its own data dir) behind the CLI router (`--shard-leaders`):
@@ -472,6 +479,77 @@ def main() -> int:
             "GET", follower_url + "/api/v1/namespaces/team-a/pods", "alice")
         assert "healed-pod" in [i["metadata"]["name"]
                                 for i in json.loads(body)["items"]]
+
+        # -- fleet tracing (ISSUE 16): one request through THREE tiers,
+        # -- reconciled in the merged /debug/fleet view ------------------
+        print("== fleet tracing: router -> follower -> leader")
+        ftp = free_port()
+        fleet_url = f"http://127.0.0.1:{ftp}"
+        # a 1-shard CLI router fronting the rejoined ex-leader (now a
+        # follower): a dual-write travels router -> follower ->
+        # promoted leader — three processes, one trace id.
+        # --fleet-peers adds the promoted leader to the /debug/fleet
+        # fan-out so its segment lands in the merged view.
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "spicedb_kubeapi_proxy_tpu",
+             "--shard-leaders", old_leader_url,
+             "--rule-config", rules_path,
+             "--spicedb-bootstrap", boot_path,
+             "--fleet-peers", follower_url,
+             "--embedded-mode", "--bind-address", "127.0.0.1",
+             "--secure-port", str(ftp)], env=env))
+        wait_ready(fleet_url, 30.0)
+        # warm the router->follower connection so the timed write below
+        # measures the request, not TCP/interpreter cold start
+        status, _, _ = http(
+            "GET", fleet_url + "/api/v1/namespaces/team-a/pods", "alice")
+        assert status == 200, status
+
+        t0 = time.time()
+        status, headers, body = http(
+            "POST", fleet_url + "/api/v1/namespaces/team-a/pods", "alice",
+            body={"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "traced-pod",
+                               "namespace": "team-a"}})
+        e2e_ms = (time.time() - t0) * 1e3
+        assert status in (200, 201), (status, body)
+        # h11 lower-cases header names on the router's pass-through
+        lower = {k.lower(): v for k, v in headers.items()}
+        assert lower.get("x-authz-forwarded-to") == "leader", headers
+        tid = lower.get("x-trace-id", "")
+        assert tid, headers
+
+        print("== fleet tracing: merged /debug/fleet reconciles e2e")
+        status, _, body = http("GET", fleet_url + "/debug/fleet",
+                               "alice", timeout=10.0)
+        assert status == 200, (status, body)
+        merged = json.loads(body)
+        assert merged.get("enabled") is True, merged.get("reason")
+        assert all(m["error"] is None for m in merged["members"]), \
+            merged["members"]
+        trd = next((t for t in merged["traces"]
+                    if t["trace_id"] == tid), None)
+        assert trd is not None, (
+            f"trace {tid} absent from merged fleet view "
+            f"({[t['trace_id'] for t in merged['traces']]})")
+        tiers = set(trd["tiers"])
+        assert {"router", "follower", "leader"} <= tiers, tiers
+        assert trd["tier_count"] >= 3, trd
+        # per-tier self time + network must reconcile to the root
+        # (router) duration: the merged view accounts for the whole
+        # request, it neither invents nor loses time
+        assert abs(trd["attributed_ms"] - trd["duration_ms"]) <= (
+            0.10 * trd["duration_ms"] + 5.0), trd
+        # ...and the root duration must reconcile with what the CLIENT
+        # measured end to end (10% + absolute slack for client-side
+        # connection setup + encode/decode outside the router's trace)
+        assert trd["duration_ms"] <= e2e_ms + 1.0, (
+            trd["duration_ms"], e2e_ms)
+        assert e2e_ms - trd["duration_ms"] <= 0.10 * e2e_ms + 75.0, (
+            trd["duration_ms"], e2e_ms)
+        per_tier = {k: v["self_ms"] for k, v in trd["tiers"].items()}
+        print(f"   e2e {e2e_ms:.1f}ms, traced {trd['duration_ms']:.1f}ms: "
+              f"{per_tier} + network {trd['network_ms']}ms")
 
         # -- sharded write scale-out (ISSUE 15): 2 shard leaders + the
         # -- CLI router -------------------------------------------------

@@ -303,18 +303,25 @@ class StandardResponseFilterer(ResponseFilterer):
             raise FilterError(
                 f"unsupported media type {media} for gvk {gvk}")
 
+        from ..utils import timeline
         try:
-            decoded = json.loads(resp.body) if resp.body else {}
+            with timeline.serving_span("decode",
+                                       nbytes=len(resp.body or b"")):
+                decoded = json.loads(resp.body) if resp.body else {}
         except ValueError as e:
             raise FilterError(f"failed to decode response body: {e}") from e
 
         if len(info.parts) == 1:
             # list response
-            err = self._filter_list(decoded, result)
-            body = b"" if err else json.dumps(decoded).encode()
+            with timeline.serving_span("filter"):
+                err = self._filter_list(decoded, result)
+            with timeline.serving_span("serialize") as ser_attrs:
+                body = b"" if err else json.dumps(decoded).encode()
+                ser_attrs["nbytes"] = len(body)
             self._write_resp(resp, body, err)
         else:
-            err = self._filter_object(decoded, result)
+            with timeline.serving_span("filter"):
+                err = self._filter_object(decoded, result)
             self._write_resp(resp, resp.body if not err else b"", err)
 
     async def _gvk(self, info: RequestInfo):
@@ -442,6 +449,7 @@ class WatchResponseFilterer(ResponseFilterer):
         info = (self.input.request if self.input is not None
                 else None) or RequestInfo()
         tr = tracing.current_trace()
+        attrs = getattr(tr, "attrs", None)
         self.audit.emit(AuditEvent(
             stage="watch", decision=decision,
             user=user.name if user else "",
@@ -451,7 +459,10 @@ class WatchResponseFilterer(ResponseFilterer):
             namespace=namespace, names=(name,) if name else (), count=1,
             rule=self.watch_rule.name if self.watch_rule else "",
             backend=getattr(self.audit, "backend", ""),
-            trace_id=getattr(tr, "trace_id", ""), message=message))
+            trace_id=getattr(tr, "trace_id", ""),
+            tier_path=(str(attrs.get("tier_path") or "")
+                       if isinstance(attrs, dict) else ""),
+            message=message))
 
     def run_watcher(self) -> None:
         """Start the SpiceDB-side watch (reference responsefilterer.go:434-460)."""

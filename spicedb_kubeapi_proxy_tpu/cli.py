@@ -204,6 +204,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stable identity in elections and "
                         "/replication/status (default: minted per "
                         "process); the election tie-break orders on it")
+    p.add_argument("--fleet-peers", default="",
+                   help="comma-separated base URLs of fleet members "
+                        "whose /debug/traces + /debug/flight + /metrics "
+                        "this node merges at /debug/fleet (cross-process "
+                        "trace assembly + per-tier attribution; docs/"
+                        "observability.md \"Fleet tracing\").  On the "
+                        "--shard-leaders router the shard leaders are "
+                        "included implicitly")
 
     # partitioned write scale-out (spicedb/sharding, docs/replication.md
     # "Sharding"; killswitch: --feature-gates Sharding=false)
@@ -553,6 +561,10 @@ def validate(args: argparse.Namespace) -> list:
         if peer and not peer.startswith(("http://", "https://")):
             errs.append(f"--replica-peers entry {peer!r} must be an "
                         f"http(s) base URL")
+    for peer in (u.strip() for u in args.fleet_peers.split(",")):
+        if peer and not peer.startswith(("http://", "https://")):
+            errs.append(f"--fleet-peers entry {peer!r} must be an "
+                        f"http(s) base URL")
     if args.shed_replica_lag < 0:
         errs.append("--shed-replica-lag must be >= 0 (0 = disabled)")
     if args.shed_replica_lag > 0 and not args.replicate_from:
@@ -745,6 +757,8 @@ def complete(args: argparse.Namespace,
         replica_id=args.replica_id,
         shards=args.shards,
         partition_map=args.partition_map,
+        fleet_peers=[u.strip() for u in args.fleet_peers.split(",")
+                     if u.strip()],
     )
     return CompletedConfig(server_options=server_options,
                            bind_address=args.bind_address,
@@ -920,10 +934,11 @@ def run_router(args: argparse.Namespace) -> int:
                 cert_file, key_file = kubecfg.generate_self_signed_cert(
                     args.cert_dir, hosts=[args.bind_address])
             ssl_context = kubecfg.serving_ssl_context(cert_file, key_file)
-        server = sharding.RouterServer(pmap, urls,
-                                       rule_configs=rule_configs,
-                                       schema=schema,
-                                       ssl_context=ssl_context)
+        server = sharding.RouterServer(
+            pmap, urls, rule_configs=rule_configs, schema=schema,
+            ssl_context=ssl_context,
+            fleet_peers=[u.strip() for u in args.fleet_peers.split(",")
+                         if u.strip()])
     except (OSError, ValueError, yaml.YAMLError) as e:
         # yaml.YAMLError: Bootstrap.from_file / parse_file surface
         # malformed YAML directly, and it is not a ValueError subclass

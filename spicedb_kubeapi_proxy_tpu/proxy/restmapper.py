@@ -64,7 +64,7 @@ class CachingRESTMapper:
         path = (f"/apis/{group}/{version}" if group else f"/api/{version}")
         req = Request(method="GET", target=path, headers=Headers(
             [("Accept", "application/json")]))
-        resp = await self._transport.round_trip(req)
+        resp = await self._transport.round_trip(req)  # noqa: A006(external kube discovery)
         if resp.status != 200:
             raise NoKindMatchError(group, version, resource)
         try:

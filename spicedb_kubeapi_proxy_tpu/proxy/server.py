@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import contextvars
 import dataclasses
+import inspect
 import json
 import logging
 import os
@@ -254,6 +255,11 @@ class Options:
     # at construction (a closure spanning two shards refuses to boot).
     shards: int = 1
     partition_map: str = ""
+    # fleet tracing aggregation (docs/observability.md "Fleet tracing"):
+    # base URLs of the other fleet members; a node given peers serves
+    # the merged cross-process view at /debug/fleet (fans out to each
+    # member's /debug/traces + /debug/flight + /metrics)
+    fleet_peers: list = field(default_factory=list)
 
 
 class ProxyServer:
@@ -529,6 +535,19 @@ class ProxyServer:
         self._http: Optional[HttpServer] = None
         self._lag_probe = None
 
+    @property
+    def _tier(self) -> str:
+        """Fleet tracing tier (docs/observability.md "Fleet tracing"):
+        stamped on every trace this node records and appended to the
+        X-Authz-Tier-Path it forwards.  A fan-out hub is a follower
+        that also serves /replication/* to further followers.
+        Recomputed per read — promotion flips a follower to leader
+        in-place (promote_follower sets replication = None), and the
+        reported tier must follow the role, not the boot-time shape."""
+        return ("hub" if self.fanout_hub is not None
+                else "follower" if self.replication is not None
+                else "leader")
+
     def _make_flight_recorder(self):
         from ..utils import devtel
         slos = []
@@ -595,6 +614,10 @@ class ProxyServer:
             "sharding": ("partition map + per-shard revisions of the "
                          "in-process sharded endpoint (docs/replication"
                          ".md \"Sharding\")", self._debug_sharding),
+            "fleet": ("merged fleet view: cross-process trace assembly, "
+                      "per-tier latency attribution, SLO burn roll-up "
+                      "across --fleet-peers (docs/observability.md "
+                      "\"Fleet tracing\")", self._debug_fleet),
         }
         return surfaces
 
@@ -614,7 +637,7 @@ class ProxyServer:
                     for k, store in
                     enumerate(self.endpoint.shard_stores())}}
 
-    def _serve_debug(self, req: Request) -> Response:
+    async def _serve_debug(self, req: Request) -> Response:
         surfaces = self._debug_surfaces()
         if req.path == "/debug" or req.path == "/debug/":
             return json_response(200, {
@@ -631,7 +654,14 @@ class ProxyServer:
                            f"GET /debug for the index",
                 "reason": "NotFound", "code": 404})
         try:
-            return json_response(200, entry[1]())
+            fn = entry[1]
+            # most surfaces are cheap sync snapshots; the fleet surface
+            # fans out over HTTP and needs the request (identity
+            # re-assertion toward peers), so it opts in via markers
+            out = fn(req) if getattr(fn, "_wants_request", False) else fn()
+            if inspect.isawaitable(out):
+                out = await out
+            return json_response(200, out)
         except Exception as e:
             logger.exception("debug surface %s failed", req.path)
             return json_response(500, {
@@ -643,6 +673,38 @@ class ProxyServer:
     def _debug_traces(self) -> dict:
         return {"capacity": tracing.RECORDER.capacity,
                 "traces": tracing.RECORDER.snapshot()}
+
+    async def _debug_fleet(self, req: Request) -> dict:
+        from ..utils import fleet
+        peers = list(self.opts.fleet_peers)
+        if not peers:
+            return {"enabled": False,
+                    "reason": "no --fleet-peers configured",
+                    "tier": self._tier}
+        # re-assert the already-authenticated caller toward the peers
+        # (same trust model as _forward_to_leader: the peers trust this
+        # node's transport path)
+        headers = []
+        user = req.context.get("user")
+        if user is not None:
+            headers.append((REMOTE_USER_HEADER, user.name))
+            for g in user.groups:
+                headers.append((REMOTE_GROUP_HEADER, g))
+        members = await fleet.collect_fleet(
+            peers, headers=headers,
+            transports=self.opts.peer_transports)
+        local = {"url": "local", "error": None,
+                 "traces": self._debug_traces()["traces"],
+                 "flight": self._debug_flight(),
+                 "skew_s": (self.replication.clock_skew_s()
+                            if self.replication is not None else None),
+                 "lag_s": (self.replication.lag_seconds()
+                           if self.replication is not None else None)}
+        merged = fleet.merge_fleet([local] + members)
+        merged["enabled"] = True
+        merged["tier"] = self._tier
+        return merged
+    _debug_fleet._wants_request = True
 
     def _debug_decisions(self) -> dict:
         return {"level": self.audit.level,
@@ -934,9 +996,16 @@ class ProxyServer:
                 for v in values:
                     up.add(REMOTE_EXTRA_PREFIX + key, v)
         try:
-            resp = await self._leader_transport.round_trip(Request(
-                method=req.method, target=req.target, headers=up,
-                body=req.body))
+            # fleet tracing: the leader joins this request's trace, and
+            # the hop span separates network time from leader-side time
+            # (no-op, no headers, when the Timeline gate is off)
+            with tracing.hop_span("hop.forward_to_leader",
+                                  tier=self._tier, why=why) as hop:
+                for hk, hv in hop.headers.items():
+                    up.set(hk, hv)
+                resp = await self._leader_transport.round_trip(Request(
+                    method=req.method, target=req.target, headers=up,
+                    body=req.body))
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -1112,7 +1181,14 @@ class ProxyServer:
             audit=self.audit)
 
         async def authenticated(req: Request) -> Response:
-            user = self.authenticator.authenticate(req)
+            from ..utils import timeline
+            if _untraced(req.path):
+                # scrape/health authn stays out of the serving-stage
+                # accounting — it would dominate the histogram counts
+                user = self.authenticator.authenticate(req)
+            else:
+                with timeline.serving_span("authn"):
+                    user = self.authenticator.authenticate(req)
             if user is None:
                 return json_response(401, {
                     "kind": "Status", "apiVersion": "v1", "metadata": {},
@@ -1132,7 +1208,7 @@ class ProxyServer:
             # any authenticated principal may read them (one helper, so
             # auth and error handling stay uniform across every surface)
             if req.path == "/debug" or req.path.startswith("/debug/"):
-                return self._serve_debug(req)
+                return await self._serve_debug(req)
             # leader-side replication API (spicedb/replication): same
             # trust level as /metrics — any authenticated principal
             if (req.path == "/replication"
@@ -1274,10 +1350,17 @@ class ProxyServer:
                 "resolve, match, queue_wait, execute, upstream, "
                 "respfilter, workflow, ...)",
                 labels=("phase",))
+            tier_latency = REGISTRY.histogram(
+                "authz_tier_seconds",
+                "Per-tier request wall time (router, leader, follower, "
+                "hub) for fleet latency attribution (docs/observability"
+                ".md \"Fleet tracing\")",
+                labels=("tier",))
         else:
             request_counter = None
             request_latency = None
             phase_latency = None
+            tier_latency = None
 
         slow_threshold = self.opts.trace_slow_threshold
 
@@ -1286,11 +1369,30 @@ class ProxyServer:
             tr = token = None
             if not _untraced(req.path):
                 # trace-id assignment: honor a well-formed caller id so
-                # multi-hop traces correlate; anything else gets a fresh id
+                # multi-hop traces correlate; anything else gets a fresh
+                # id.  Fleet propagation (gate-on only): an internal hop
+                # carrying X-Authz-Trace-Id JOINS the caller's trace —
+                # same id, own span set, tier-stamped — instead of
+                # minting; gate-off never reads the fleet headers.
+                prop_id = None
+                if tracing.propagation_enabled():
+                    prop_id = tracing.clean_trace_id(
+                        req.headers.get(tracing.PROP_TRACE_HEADER))
                 tr, token = tracing.start_trace(
-                    trace_id=tracing.clean_trace_id(
+                    trace_id=prop_id or tracing.clean_trace_id(
                         req.headers.get(tracing.TRACE_ID_HEADER)),
                     method=req.method, target=req.target)
+                if tracing.propagation_enabled():
+                    incoming = tracing.clean_tier_path(
+                        req.headers.get(tracing.PROP_TIER_PATH_HEADER))
+                    tr.attrs["tier"] = self._tier
+                    tr.attrs["tier_path"] = (
+                        incoming + ">" + self._tier if incoming
+                        else self._tier)
+                    parent = tracing.clean_trace_id(
+                        req.headers.get(tracing.PROP_PARENT_HEADER))
+                    if prop_id and parent:
+                        tr.attrs["parent_span"] = parent
             start = time.monotonic()
             try:
                 resp = await with_request_info(req)
@@ -1323,6 +1425,9 @@ class ProxyServer:
                 if phase_latency is not None:
                     for phase, secs in tr.phase_durations().items():
                         phase_latency.observe(secs, phase=phase)
+                if (tier_latency is not None
+                        and tracing.propagation_enabled()):
+                    tier_latency.observe(elapsed, tier=self._tier)
                 tracing.RECORDER.record(tr)
                 if slow_threshold and tr.duration >= slow_threshold:
                     logger.warning("slow request trace: %s",
@@ -1367,8 +1472,12 @@ class ProxyServer:
                 up_headers.add(k, v)
             up_req = Request(method=req.method, target=req.target,
                              headers=up_headers, body=req.body)
-            with tracing.span("upstream", phase=True):
-                resp = await upstream.round_trip(up_req)
+            from ..utils import timeline
+            with tracing.span("upstream", phase=True), \
+                    timeline.serving_span("kube_upstream"):
+                # the kube-apiserver is OUTSIDE the fleet: the internal
+                # X-Authz-* propagation headers must not leak upstream
+                resp = await upstream.round_trip(up_req)  # noqa: A006(external kube hop)
 
             filterer = req.context.get(FILTERER_KEY)
             if filterer is not None:
@@ -1531,8 +1640,8 @@ class EmbeddedClient:
             h.set("Accept", "application/json")
         if body and "Content-Type" not in h:
             h.set("Content-Type", "application/json")
-        return await self._transport.round_trip(Request(
-            method=method, target=target, headers=h, body=body))
+        return await self._transport.round_trip(  # noqa: A006(client entry, originates trace)
+            Request(method=method, target=target, headers=h, body=body))
 
     # convenience verbs
     async def get(self, target: str, **kw) -> Response:

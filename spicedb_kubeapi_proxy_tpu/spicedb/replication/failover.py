@@ -101,8 +101,14 @@ async def _peer_json(transport, identity: str, method: str, target: str,
     """One authenticated JSON round trip to a peer proxy."""
     import json
     from ...proxy.httpcore import Headers, Request
+    from ...utils import tracing
     h = Headers([("Accept", "application/json"),
                  ("X-Remote-User", identity)])
+    # fleet tracing: election/fence/repoint control calls carry
+    # provenance too (empty when the Timeline gate is off)
+    for pk, pv in tracing.propagation_headers(
+            default_tier="follower").items():
+        h.set(pk, pv)
     data = b""
     if body is not None:
         data = json.dumps(body).encode()
@@ -528,6 +534,10 @@ class FanoutHub:  # noqa: A004(built behind gate)
             "chain": {"path": chain_path + [f.replica_id],
                       "lag_revisions": max(0.0, f.lag_revisions()),
                       "lag_seconds": max(0.0, f.lag_seconds())},
+            # THIS hub's wall clock (not the root leader's): the skew a
+            # chained follower estimates is per-hop, matching the
+            # per-hop chain lag it inherits
+            "server_time_unix": time.time(),
         }
 
     async def serve_manifest(self, req) -> "Response":

@@ -423,6 +423,10 @@ class ReplicationHub:  # noqa: A004(built behind gate)
             # chain (the leader is the root: zero lag by definition)
             "chain": {"path": [self.leader_id],
                       "lag_revisions": 0.0, "lag_seconds": 0.0},
+            # wall-clock sample for the follower's clock-skew estimate
+            # (authz_clock_skew_seconds); stamped at build time, i.e.
+            # just before the response is written
+            "server_time_unix": time.time(),
         }
 
     async def serve_manifest(self, req) -> "Response":

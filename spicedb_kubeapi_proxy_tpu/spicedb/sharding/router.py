@@ -495,7 +495,9 @@ class ShardRouter:
 
     def __init__(self, pmap: PartitionMap, transports: list,
                  rule_configs: Iterable = (),
-                 schema: Optional[sch.Schema] = None):
+                 schema: Optional[sch.Schema] = None,
+                 fleet_peers: Iterable = (),
+                 fleet_transports: Optional[dict] = None):
         if len(transports) != pmap.n_shards:
             raise RouterConfigError(
                 f"{pmap.n_shards} shard(s) configured but "
@@ -503,6 +505,12 @@ class ShardRouter:
         self.pmap = pmap
         self.transports = list(transports)
         self.table = build_routing_table(pmap, rule_configs, schema)
+        # fleet tracing aggregation: member base URLs this router fans
+        # /debug/fleet out to (typically the shard-leader URLs plus any
+        # --fleet-peers); fleet_transports (url -> Transport) is the
+        # test seam mirroring Options.peer_transports
+        self.fleet_peers = list(fleet_peers)
+        self.fleet_transports = dict(fleet_transports or {})
         self.stats = {"routed": 0, "route_errors": 0, "health_fanouts": 0}
 
     # the router IS a Handler (proxy/httpcore.py)
@@ -537,6 +545,8 @@ class ShardRouter:
             resp.headers.set("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
             return resp
+        if req.path in ("/debug/traces", "/debug/fleet"):
+            return await self._serve_debug(req)
         shard = self.shard_for_request(req)
         raw_token = req.headers.get(repl.MIN_REVISION_HEADER)
         try:
@@ -548,7 +558,45 @@ class ShardRouter:
                 "status": "Failure", "code": 400,
                 "message": f"invalid {repl.MIN_REVISION_HEADER} "
                            f"revision-vector token: {e}"})
-        return await self._forward(req, shard, vector=vec)
+        # fleet tracing: the router is the fleet's front tier — it
+        # starts (or joins) the request trace so the merged view can
+        # attribute router time and the routed hop's network share
+        # separately from the shard leader's time.  Gate-off: no trace,
+        # no headers — the forward is byte-identical to today.
+        from ...utils import tracing
+        tr = token = None
+        if tracing.propagation_enabled():
+            tr, token = tracing.start_trace(
+                trace_id=(tracing.clean_trace_id(
+                    req.headers.get(tracing.PROP_TRACE_HEADER))
+                    or tracing.clean_trace_id(
+                        req.headers.get(tracing.TRACE_ID_HEADER))),
+                method=req.method, target=req.target)
+            incoming = tracing.clean_tier_path(
+                req.headers.get(tracing.PROP_TIER_PATH_HEADER))
+            tr.attrs["tier"] = "router"
+            tr.attrs["tier_path"] = (incoming + ">router" if incoming
+                                     else "router")
+            parent = tracing.clean_trace_id(
+                req.headers.get(tracing.PROP_PARENT_HEADER))
+            if parent and tracing.clean_trace_id(
+                    req.headers.get(tracing.PROP_TRACE_HEADER)):
+                tr.attrs["parent_span"] = parent
+        try:
+            resp = await self._forward(req, shard, vector=vec)
+        except BaseException:
+            if tr is not None:
+                tracing.end_trace(token)
+                tr.finish()
+                tracing.RECORDER.record(tr)
+            raise
+        if tr is not None:
+            tracing.end_trace(token)
+            tr.finish()
+            tr.attrs["status"] = resp.status
+            tracing.RECORDER.record(tr)
+            resp.headers.set(tracing.TRACE_ID_HEADER, tr.trace_id)
+        return resp
 
     async def _forward(self, req, shard: int, rewrite: bool = True,
                        vector: Optional[RevisionVector] = None):
@@ -568,10 +616,19 @@ class ShardRouter:
                 # the shard leader sees a plain integer: its existing
                 # wait-or-forward gate enforces ONLY its own component
                 up.set(repl.MIN_REVISION_HEADER, str(component))
+        from ...utils import tracing
         try:
-            resp = await self.transports[shard].round_trip(Request(
-                method=req.method, target=req.target, headers=up,
-                body=req.body))
+            # fleet tracing: the shard leader joins this trace; the hop
+            # span isolates network time from leader-side time.  With no
+            # active trace (killswitch pass-through, Timeline gate off)
+            # this yields empty headers — byte-identical forward.
+            with tracing.hop_span("hop.shard_forward", tier="router",
+                                  shard=shard) as hop:
+                for hk, hv in hop.headers.items():
+                    up.set(hk, hv)
+                resp = await self.transports[shard].round_trip(Request(
+                    method=req.method, target=req.target, headers=up,
+                    body=req.body))
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -592,6 +649,46 @@ class ShardRouter:
             resp.headers.set("X-Authz-Shard", str(shard))
         return resp
 
+    async def _serve_debug(self, req):
+        """Router-side observability: /debug/traces (this process's
+        recorder) and /debug/fleet (the merged cross-process view over
+        `fleet_peers`).  Authenticated to the fleet's trust level: the
+        caller must present SOME identity (X-Remote-User from a trusted
+        transport path, or an Authorization header the shard leaders
+        will verify) — the router itself runs no authenticator."""
+        from ...proxy.httpcore import json_response
+        from ...utils import tracing
+        if not (req.headers.get("X-Remote-User")
+                or req.headers.get("Authorization")):
+            return json_response(401, {
+                "kind": "Status", "apiVersion": "v1", "metadata": {},
+                "status": "Failure", "message": "Unauthorized",
+                "reason": "Unauthorized", "code": 401})
+        if req.path == "/debug/traces":
+            return json_response(200, {
+                "capacity": tracing.RECORDER.capacity,
+                "traces": tracing.RECORDER.snapshot()})
+        from ...utils import fleet as fleetmod
+        peers = self.fleet_peers
+        if not peers:
+            return json_response(200, {
+                "enabled": False, "tier": "router",
+                "reason": "no fleet peers configured"})
+        # forward the caller's identity/authorization verbatim — the
+        # members authenticate it exactly as they would a direct scrape
+        fwd = [(k, v) for k, v in req.headers.items()
+               if k.lower().startswith("x-remote-")
+               or k.lower() == "authorization"]
+        members = await fleetmod.collect_fleet(
+            peers, headers=fwd, transports=self.fleet_transports)
+        local = {"url": "router", "error": None,
+                 "traces": tracing.RECORDER.snapshot(),
+                 "flight": {}, "skew_s": None, "lag_s": None}
+        merged = fleetmod.merge_fleet([local] + members)
+        merged["enabled"] = True
+        merged["tier"] = "router"
+        return json_response(200, merged)
+
     async def _aggregate_health(self, req):
         from ...proxy.httpcore import Request, Response
         self.stats["health_fanouts"] += 1
@@ -599,8 +696,8 @@ class ShardRouter:
 
         async def probe(k: int):
             try:
-                return await self.transports[k].round_trip(Request(
-                    method="GET", target=req.path))
+                return await self.transports[k].round_trip(  # noqa: A006(untraced health probe)
+                    Request(method="GET", target=req.path))
             except asyncio.CancelledError:
                 raise
             except Exception as e:
@@ -637,13 +734,22 @@ class RouterServer:
     def __init__(self, pmap: PartitionMap, leader_urls: list,
                  rule_configs: Iterable = (),
                  schema: Optional[sch.Schema] = None,
-                 transports: Optional[list] = None, ssl_context=None):
+                 transports: Optional[list] = None, ssl_context=None,
+                 fleet_peers: Iterable = ()):
         if transports is None:
             from ...proxy.httpcore import H11Transport
             transports = [H11Transport(u) for u in leader_urls]
         self.leader_urls = list(leader_urls)
-        self.router = ShardRouter(pmap, transports,
-                                  rule_configs=rule_configs, schema=schema)
+        # /debug/fleet members: every shard leader plus any extra
+        # --fleet-peers (e.g. followers behind the leaders); the shard
+        # transports are reused so the test seam (HandlerTransport)
+        # carries over to the fleet fan-out
+        members = list(leader_urls) + [u for u in fleet_peers
+                                       if u not in leader_urls]
+        self.router = ShardRouter(
+            pmap, transports, rule_configs=rule_configs, schema=schema,
+            fleet_peers=members,
+            fleet_transports=dict(zip(leader_urls, transports)))
         self._ssl_context = ssl_context
         self._http = None
 

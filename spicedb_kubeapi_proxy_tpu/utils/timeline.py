@@ -98,9 +98,21 @@ _COMPUTE_STAGES = frozenset(("kernel",))
 # "registered bytes / rebuild seconds" is not a bandwidth
 _BANDWIDTH_STAGES = frozenset(("pack", "transpose", "transfer", "kernel"))
 
+# serving-tier (non-kernel) stages: the full proxy path a request walks
+# outside the dispatch/kernel machinery — authn, rule match, the
+# upstream kube round-trip, list JSON decode, filter evaluation,
+# re-serialization.  They land on their own "serving" track with the
+# same event/overlap accounting the kernel stages get, and export as
+# authz_serving_stage_seconds{stage=} (PAPER.md §7: the serving-shim
+# escalation is only justified once these spans prove proxy overhead
+# dominates).
+_SERVING_STAGES = ("authn", "rule_match", "kube_upstream", "decode",
+                   "filter", "serialize")
+
 # chrome-trace track layout: one synthetic tid per named track (the
 # real recording thread id rides in args.thread)
-_TRACK_TIDS = {"host": 1, "dispatcher": 2, "device": 3, "rebuild": 4}
+_TRACK_TIDS = {"host": 1, "dispatcher": 2, "device": 3, "rebuild": 4,
+               "serving": 5}
 
 # published HBM peaks (GB/s) by detected jax platform; the CLI flag
 # overrides.  v5e is the hardware this repo benches on; unknown
@@ -287,6 +299,46 @@ class _Span:
         return False
 
 
+_trace_current = None  # resolved lazily; False => tracing unavailable
+
+
+def _note_trace_span(stage: str, start: float, end: float) -> None:
+    """Mirror a serving-stage span into the active request trace (as a
+    forensic `serving.<stage>` span, never a phase — the phases already
+    tile the wall time).  This is what lets the fleet merge attribute
+    serving stages per tier: the timeline ring is process-wide, but the
+    trace travels with the request.  Lazy-bound, same discipline as the
+    tracing->timeline hook in the other direction."""
+    global _trace_current
+    if _trace_current is None:
+        try:
+            from .tracing import current_trace
+            _trace_current = current_trace  # noqa: A004(import cache, not gated state)
+        except Exception:
+            _trace_current = False  # noqa: A004(import cache, not gated state)
+    if _trace_current:
+        tr = _trace_current()
+        if tr is not None:
+            try:
+                tr.add_span("serving." + stage, start, end)
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+
+class _ServingSpan(_Span):  # noqa: A004(built behind gate)
+    """Serving-track span: records the timeline event, feeds the
+    per-stage serving histogram, and mirrors into the request trace in
+    one exit."""
+    __slots__ = ()
+
+    def __exit__(self, *exc):
+        end = time.perf_counter()
+        self._tl.record(self.stage, self.track, self.t0, end, **self.kw)
+        self._tl._serving.observe(end - self.t0, stage=self.stage)
+        _note_trace_span(self.stage, self.t0, end)
+        return False
+
+
 class Timeline:
     """Bounded event ring + derived dispatch telemetry (module singleton
     `TIMELINE`; an isolated instance is constructible for tests)."""
@@ -339,6 +391,12 @@ class Timeline:
             "ring (0 = fully serialized pipeline, ~1 = transfers hidden "
             "behind another batch's kernel)",
             callback=self._overlap_gauge)
+        self._serving = registry.histogram(
+            "authz_serving_stage_seconds",
+            "Serving-tier (non-kernel) stage latency: authn, rule_match, "
+            "kube_upstream, decode, filter, serialize (docs/"
+            "observability.md 'Fleet tracing')",
+            labels=("stage",))
 
     # -- configuration -------------------------------------------------------
 
@@ -442,6 +500,16 @@ class Timeline:
         if not enabled():
             return _NULL_SPAN
         return _Span(self, stage, track, kw)
+
+    def serving_span(self, stage: str, **kw):
+        """Span on the serving track (authn, rule_match, kube_upstream,
+        decode, filter, serialize): the timeline event rides the normal
+        ring/chrome-trace machinery AND the duration feeds the
+        authz_serving_stage_seconds{stage=} histogram.  Same gate-off
+        contract as span(): the shared null context, nothing ticks."""
+        if not enabled():
+            return _NULL_SPAN
+        return _ServingSpan(self, stage, "serving", kw)
 
     def time_first_call(self, fn, bucket: Optional[int] = None,
                         stage: str = "compile", track: str = "device",
@@ -644,6 +712,10 @@ def record(stage: str, track: str, start: float,
 
 def span(stage: str, track: str, **kw):
     return TIMELINE.span(stage, track, **kw)
+
+
+def serving_span(stage: str, **kw):
+    return TIMELINE.serving_span(stage, **kw)
 
 
 def time_first_call(fn, bucket: Optional[int] = None,

@@ -50,6 +50,20 @@ from typing import Iterable, Optional
 
 TRACE_ID_HEADER = "X-Trace-Id"
 
+# fleet-internal trace propagation (docs/observability.md "Fleet
+# tracing").  Every internal hop — router -> shard leader, follower ->
+# leader forward, replication control calls — carries these so the
+# receiving proxy JOINS the caller's trace instead of minting a fresh
+# one.  The Timeline feature gate is the killswitch: off, no headers
+# are sent and receivers mint locally, byte-identical to the
+# single-process behavior.
+PROP_TRACE_HEADER = "X-Authz-Trace-Id"
+PROP_PARENT_HEADER = "X-Authz-Parent-Span"
+PROP_TIER_PATH_HEADER = "X-Authz-Tier-Path"
+
+# tier vocabulary for per-tier latency attribution (authz_tier_seconds)
+TIERS = ("router", "leader", "follower", "hub")
+
 # per-trace span cap: a runaway loop recording spans must not grow a
 # request's memory without bound (the slowest traces are retained)
 _MAX_SPANS = 512
@@ -218,6 +232,111 @@ def clean_trace_id(raw: str) -> Optional[str]:
     if any(c.isspace() or c in '"\\' or not c.isprintable() for c in raw):
         return None
     return raw
+
+
+# -- fleet propagation (cross-process trace continuity) ----------------------
+
+_gates_enabled = None  # resolved lazily; False => gates unavailable
+
+
+def propagation_enabled() -> bool:
+    """True when fleet trace propagation is on (the `Timeline` feature
+    gate doubles as the killswitch — one flag turns off both the
+    serving-stage spans and the cross-process headers).  Fails open:
+    this module stays importable standalone."""
+    global _gates_enabled
+    if _gates_enabled is None:
+        try:
+            from .features import GATES
+            _gates_enabled = GATES.enabled
+        except Exception:
+            _gates_enabled = False
+    if _gates_enabled:
+        try:
+            return _gates_enabled("Timeline")
+        except Exception:
+            return True
+    return True
+
+
+_TIER_PATH_OK = frozenset(
+    "abcdefghijklmnopqrstuvwxyz0123456789_->|,")
+
+
+def clean_tier_path(raw: str) -> str:
+    """Sanitize a caller-supplied tier path header: bounded, lowercase
+    tier names joined by `>` — anything else is dropped (the path is
+    advisory provenance, not a trust input)."""
+    raw = (raw or "").strip().lower()
+    if not raw or len(raw) > 128:
+        return ""
+    if any(c not in _TIER_PATH_OK for c in raw):
+        return ""
+    return raw
+
+
+def propagation_headers(default_tier: str = "") -> dict:
+    """Headers an outbound *fleet-internal* hop should carry so the
+    receiving proxy joins this trace instead of minting its own.
+    Empty when propagation is gated off; without an active trace the
+    tier path still travels (background hops such as follower sync
+    keep provenance even though they have no request trace)."""
+    if not propagation_enabled():
+        return {}
+    headers = {}
+    tr = _current.get()
+    trace_id = getattr(tr, "trace_id", "") if tr is not None else ""
+    if trace_id:
+        headers[PROP_TRACE_HEADER] = trace_id
+    path = ""
+    if tr is not None:
+        attrs = getattr(tr, "attrs", None)
+        if isinstance(attrs, dict):
+            path = str(attrs.get("tier_path") or "")
+    path = path or default_tier
+    if path:
+        headers[PROP_TIER_PATH_HEADER] = path
+    return headers
+
+
+class _Hop:
+    """Yielded by hop_span: `.headers` is what the caller copies onto
+    the outbound request; `.span_id` is the client-side span a
+    downstream trace names as its parent."""
+    __slots__ = ("headers", "span_id")
+
+    def __init__(self, headers: dict, span_id: str = ""):
+        self.headers = headers
+        self.span_id = span_id
+
+
+_NULL_HOP = _Hop({})
+
+
+@contextlib.contextmanager
+def hop_span(name: str, tier: str = "", **attrs):
+    """Client-side span around ONE outbound internal HTTP hop (router ->
+    shard leader, follower -> leader forward, ...).  Yields a `_Hop`
+    whose `.headers` carry X-Authz-Trace-Id / X-Authz-Parent-Span /
+    X-Authz-Tier-Path for the outbound request.  The recorded span's
+    `span_id` attr is what the downstream trace names as its parent, so
+    the fleet merge (utils/fleet.py) aligns the child trace inside this
+    hop and attributes hop network time separately from downstream
+    server time.  Degrades to a no-op with empty headers when
+    propagation is gated off or no trace is active."""
+    tr = _current.get()
+    if tr is None or not propagation_enabled():
+        yield _NULL_HOP
+        return
+    span_id = uuid.uuid4().hex[:16]
+    headers = propagation_headers(default_tier=tier)
+    headers[PROP_PARENT_HEADER] = span_id
+    t0 = time.perf_counter()
+    try:
+        yield _Hop(headers, span_id)
+    finally:
+        tr.add_span(name, t0, time.perf_counter(), span_id=span_id,
+                    **attrs)
 
 
 # -- TPU profiler bridge -----------------------------------------------------

@@ -1,0 +1,57 @@
+"""A006 near-misses: hops that DO carry the propagation headers (via
+hop_span / propagation_headers), transport wrappers that pass the
+caller's headers through by contract, and non-call references."""
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def hop_span(name, tier=""):
+    yield type("H", (), {"headers": {}})()
+
+
+def propagation_headers(default_tier=""):
+    return {}
+
+
+class tracing:
+    hop_span = hop_span
+    propagation_headers = propagation_headers
+
+
+async def forward_with_hop_span(transport, req):
+    with hop_span("hop.forward", tier="leader") as hop:
+        for k, v in hop.headers.items():
+            req.headers.set(k, v)
+        return await transport.round_trip(req)        # covered: hop_span
+
+
+async def forward_with_headers(transport, req):
+    for k, v in propagation_headers(default_tier="follower").items():
+        req.headers.set(k, v)
+    return await transport.round_trip(req)            # covered: headers
+
+
+async def forward_via_module_attr(transport, req):
+    with tracing.hop_span("hop.forward") as hop:
+        req.headers.update(hop.headers)
+        return await transport.round_trip(req)        # covered: attr ref
+
+
+class RetryTransport:
+    def __init__(self, base):
+        self.base = base
+
+    async def round_trip(self, req):
+        # wrapper contract: the CALLER attached the headers; this layer
+        # must forward them untouched, not mint its own
+        return await self.base.round_trip(req)
+
+
+def reference_only(transport):
+    # passing the bound method around is not a hop
+    return transport.round_trip
+
+
+async def external_hop(kube, req):
+    return await kube.round_trip(req)  # noqa: A006(external kube hop)

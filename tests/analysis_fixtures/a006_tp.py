@@ -1,0 +1,33 @@
+"""A006 true positives: outbound HTTP hops that drop the fleet-tracing
+headers on the floor — the receiving tier mints a fresh trace and the
+merged /debug/fleet view silently loses the hop."""
+
+
+async def forward_no_headers(transport, req):
+    return await transport.round_trip(req)           # A006
+
+
+async def fanout_no_headers(transports, req):
+    out = []
+    for t in transports:
+        out.append(await t.round_trip(req))          # A006
+    resp = await transports[0].round_trip(req)       # A006
+    out.append(resp)
+    return out
+
+
+class Client:
+    async def fetch(self, req):
+        return await self.transport.round_trip(req)  # A006
+
+
+def sync_hop(transport, req):
+    return transport.round_trip(req)                 # A006
+
+
+def _boot_transport():
+    return None
+
+
+BOOT_REF = _boot_transport  # bare reference, not a hop
+BOOT_RESP = _boot_transport().round_trip(None)       # A006 (module scope)

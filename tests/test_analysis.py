@@ -26,6 +26,7 @@ from analysis.rules_async import rule_a001, rule_a002  # noqa: E402
 from analysis.rules_gates import rule_a004  # noqa: E402
 from analysis.rules_jit import rule_a005  # noqa: E402
 from analysis.rules_locks import rule_a003  # noqa: E402
+from analysis.rules_trace import rule_a006  # noqa: E402
 
 
 def load(*names):
@@ -156,6 +157,32 @@ class TestA005:
         # shape-range unrolls, static pytree iteration, dtype scalars,
         # and unreached host helpers
         assert rule_a005(load(self.NEG)) == []
+
+
+class TestA006:
+    def test_true_positives(self):
+        findings = rule_a006(load("a006_tp.py"))
+        # 33 is the module-scope hop (symbol "")
+        assert lines(findings) == [7, 13, 14, 21, 25, 33]
+        assert all(f.rule == "A006" for f in findings)
+        assert all("trace propagation" in f.message for f in findings)
+        by_line = {f.line: f.symbol for f in findings}
+        assert by_line[21] == "Client.fetch"
+        assert by_line[33] == ""
+        # both round_trip calls in the fan-out helper are flagged —
+        # coverage is per call site, not per function
+        assert by_line[13] == by_line[14] == "fanout_no_headers"
+
+    def test_near_misses(self):
+        # hop_span / propagation_headers coverage (name and attribute
+        # forms), the `round_trip`-wrapper exemption, bare references,
+        # and the noqa'd external hop
+        sources = load("a006_neg.py")
+        kept, suppressed = core.apply_noqa(rule_a006(sources), sources)
+        assert kept == []
+        # the external-kube hop is suppressed WITH a reason, not clean
+        assert len(suppressed) == 1
+        assert "external kube" in suppressed[0].reason
 
 
 class TestSuppression:

@@ -187,6 +187,16 @@ class TestLedgerRebuildRegression:
         ep.store.write(touch("doc:d0#viewer@user:*"))
         run(ep.check_permission(CheckRequest(
             ObjectRef("doc", "d0"), "view", SubjectRef("user", "zz"))))
+        # the wildcard delta quarantines its pairs and rebuilds OFF-LOOP
+        # (AsyncRebuild default): the old generation keeps serving until
+        # the candidate installs, so wait for the swap instead of racing
+        # the background executor
+        deadline = time.time() + 10.0
+        while ep._devtel_gen == gen1 and time.time() < deadline:
+            time.sleep(0.01)
+            run(ep.check_permission(CheckRequest(
+                ObjectRef("doc", "d0"), "view",
+                SubjectRef("user", "zz"))))
         gen2 = ep._devtel_gen
         assert gen2 > gen1
         assert devtel.LEDGER.generation_bytes(gen1) == 0
@@ -481,7 +491,7 @@ class TestDebugSurfaces:
             assert set(surfaces) == {"/debug/traces", "/debug/decisions",
                                      "/debug/flight", "/debug/timeline",
                                      "/debug/replication",
-                                     "/debug/sharding"}
+                                     "/debug/sharding", "/debug/fleet"}
             for desc in surfaces.values():
                 assert isinstance(desc, str) and desc
         run(go())
