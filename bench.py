@@ -469,7 +469,12 @@ def roofline_probe(ep, workload, batch: int) -> dict:
         q_arr, cols, _ = ep._encode_subjects(graph, subjects)
     n_words = max(1, len(q_arr) // 32)
     kern = graph.kernel
-    _, run_lookup = kern._fns(n_words)
+    _, run_lookup, intro = kern._fns(n_words)
+    if intro:
+        # KernelIntrospect builds return (out, sweep_telemetry); the
+        # probe times the raw jitted fn, so strip telemetry here
+        _rl = run_lookup
+        run_lookup = lambda *a: _rl(*a)[0]  # noqa: E731
     args = [rng_slot[0], rng_slot[1], jnp.asarray(q_arr),
             graph.dev_main, graph.dev_aux]
     if kern.planes:
@@ -1940,6 +1945,189 @@ def bench_scenario_ephemeral_grants(args) -> dict:
 # scenario matrix configs (ISSUE 12 / ROADMAP item 5): the three
 # workload shapes the sweep was missing, each with a host-oracle parity
 # referee (docs/performance.md "Scenario matrix")
+def bench_sweep_telemetry(args) -> dict:
+    """KernelIntrospect A/B (ISSUE 17): the 1M-tuple depth-4 headline
+    shape run with the sweep-telemetry gate OFF (byte-identical
+    pre-introspection jits) and ON (iteration counter + frontier trace
+    threaded through the fixpoint carry), interleaved so allocator
+    drift lands on both modes equally.  Reports the per-round overhead
+    of the telemetry (acceptance: within run-to-run noise), the
+    measured-basis roofline from a dedicated introspect-on window
+    (`kernel_bytes_basis` must read "measured"), and the /debug/workload
+    attribution payload the traffic produced."""
+    import asyncio
+
+    from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+    from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+    from spicedb_kubeapi_proxy_tpu.utils import workload as wk
+    from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+
+    workload = wl.multitenant_1m()
+    batch = args.batch
+    rounds = max(3, args.rounds // 2)
+    max_batch = max(1, batch // 4)
+    subjects = workload.subjects
+    modes = (("introspect-off", False), ("introspect-on", True))
+    out: dict = {"modes": {}, "batch": batch, "rounds": rounds,
+                 "max_batch": max_batch}
+    eps: dict = {}
+    acc = {name: [] for name, _gate in modes}
+
+    async def one_round(ep, r):
+        async def caller(i):
+            s = SubjectRef(
+                "user", subjects[(r * batch + i) % len(subjects)])
+            return await ep.lookup_resources(
+                workload.resource_type, workload.permission, s)
+        t0 = time.time()
+        await asyncio.gather(*[caller(i) for i in range(batch)])
+        return time.time() - t0
+
+    try:
+        # `introspect` is resolved at jit BUILD time, so each mode gets
+        # its own endpoint, built and warmed under its gate state — the
+        # off mode runs the exact pre-introspection functions
+        for name, gate in modes:
+            GATES.set("KernelIntrospect", gate)
+            stage(f"sweep-telemetry build + load + warm ({name})")
+            inner = build_endpoint(workload, "jax")
+            eps[name] = BatchingEndpoint(inner, max_batch=max_batch,
+                                         pipeline_depth=2)
+            asyncio.run(one_round(eps[name], 0))  # warm: compiles+arenas
+        stage("sweep-telemetry interleaved rounds")
+        for r in range(rounds):
+            for name, gate in modes:
+                GATES.set("KernelIntrospect", gate)
+                acc[name].append(asyncio.run(one_round(eps[name], r + 1)))
+        # dedicated introspect-on window for the measured-basis roofline:
+        # only introspect-built kernels dispatch inside it, so the
+        # summary's kernel byte tags are all iterations x one-sweep
+        GATES.set("KernelIntrospect", True)
+        mark = timeline_mark()
+        asyncio.run(one_round(eps["introspect-on"], rounds + 1))
+        tl = timeline_summary(mark) or {}
+    finally:
+        GATES.set("KernelIntrospect", True)
+
+    n_obj = len(eps["introspect-on"].inner.store.object_ids_of_type(
+        workload.resource_type))
+    for name, _gate in modes:
+        per_round = statistics.median(acc[name])
+        out["modes"][name] = {
+            "checks_per_s": round(batch * n_obj / per_round, 1),
+            "per_round_ms": round(per_round * 1e3, 2),
+            "p99_ms": round(p99(acc[name]) * 1e3, 2),
+        }
+    off_med = statistics.median(acc["introspect-off"])
+    on_med = statistics.median(acc["introspect-on"])
+    noise = (statistics.stdev(acc["introspect-off"])
+             if len(acc["introspect-off"]) > 1 else 0.0)
+    out["overhead_pct"] = round((on_med / off_med - 1) * 100, 2)
+    out["noise_pct"] = round(noise / off_med * 100, 2) if off_med else None
+    out["overhead_within_noise"] = bool(abs(on_med - off_med)
+                                        <= max(2 * noise, 0.02 * off_med))
+    out["roofline_fraction"] = tl.get("roofline_fraction")
+    out["kernel_bytes_basis"] = tl.get("kernel_bytes_basis")
+    out["workload_attribution"] = wk.WORKLOAD.payload()
+    log(f"sweep-telemetry: overhead={out['overhead_pct']}% "
+        f"(noise {out['noise_pct']}%), basis={out['kernel_bytes_basis']}, "
+        f"roofline={out['roofline_fraction']}")
+    return out
+
+
+def bench_cpu_microbench(args) -> dict:
+    """Deterministic pure-python microbench for the perf-regression
+    sentinel (scripts/benchdiff.py + the check.sh gate): NO jax import,
+    fixed seeds and fixed work, per-round wall times recorded so the
+    comparator can derive noise-aware thresholds, and a pure-python
+    calibration loop riding the artifact so two runs on
+    differently-loaded machines compare ratio-normalized.  Exercises
+    the dispatch drain hot loop (spicedb/dispatch.py) and the recursive
+    oracle — the two CPU paths a slowdown is most likely to hide in."""
+    import asyncio
+
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import EmbeddedEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+        CheckRequest, ObjectRef, SubjectRef)
+
+    schema_text = """
+definition user {}
+definition team {
+  relation member: user | team#member
+  permission view = member
+}
+definition doc {
+  relation owner: user
+  relation reader: user | team#member
+  permission view = owner + reader
+}
+"""
+    n_docs, n_users, n_teams = 120, 24, 6
+    rels = []
+    for t in range(n_teams):
+        for u in range(t, n_users, n_teams):
+            rels.append(f"team:t{t}#member@user:u{u}")
+        if t:
+            rels.append(f"team:t{t}#member@team:t{t - 1}#member")
+    for d in range(n_docs):
+        rels.append(f"doc:d{d}#owner@user:u{d % n_users}")
+        rels.append(f"doc:d{d}#reader@team:t{d % n_teams}#member")
+    inner = EmbeddedEndpoint(sch.parse_schema(schema_text))
+    inner.store.bulk_load_text("\n".join(rels))
+    ep = BatchingEndpoint(inner, max_batch=8)
+
+    def calib() -> float:
+        t0 = time.perf_counter()
+        x = 0
+        for i in range(200_000):
+            x = (x * 31 + i) % 1_000_003
+        return time.perf_counter() - t0
+
+    calibration_s = min(calib() for _ in range(3))
+    rounds = max(5, args.rounds)
+    batch = min(args.batch, 64)
+
+    async def check_round(r):
+        reqs = [CheckRequest(ObjectRef("doc", f"d{(r * batch + i) % n_docs}"),
+                             "view", SubjectRef("user", f"u{i % n_users}"))
+                for i in range(batch)]
+        await asyncio.gather(*[ep.check_permission(q) for q in reqs])
+
+    async def lookup_round(r):
+        await asyncio.gather(*[
+            ep.lookup_resources("doc", "view",
+                                SubjectRef("user", f"u{(r + i) % n_users}"))
+            for i in range(batch)])
+
+    async def oracle_round(r):
+        reqs = [CheckRequest(ObjectRef("doc", f"d{(r * batch + i) % n_docs}"),
+                             "view", SubjectRef("user", f"u{i % n_users}"))
+                for i in range(batch)]
+        await inner.check_bulk_permissions(reqs)
+
+    configs: dict = {}
+    for name, fn in (("dispatch-check", check_round),
+                     ("dispatch-lookup", lookup_round),
+                     ("oracle-eval", oracle_round)):
+        asyncio.run(fn(0))  # warm
+        times = []
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            asyncio.run(fn(r + 1))
+            times.append(time.perf_counter() - t0)
+        configs[name] = {
+            "per_round_s": [round(t, 6) for t in times],
+            "median_s": round(statistics.median(times), 6),
+        }
+        log(f"cpu-microbench {name}: median "
+            f"{configs[name]['median_s'] * 1e3:.2f} ms/round")
+    return {"calibration_s": round(calibration_s, 6), "rounds": rounds,
+            "batch": batch, "tuples": len(rels), "configs": configs}
+
+
 SCENARIO_CONFIGS = {
     "caveat-heavy": bench_scenario_caveat_heavy,
     "wildcard-public": bench_scenario_wildcard_public,
@@ -1949,6 +2137,15 @@ SCENARIO_CONFIGS = {
 # device-resident pipeline A/B (ISSUE 7): same contract as CACHE_CONFIGS
 PIPELINE_CONFIGS = {
     "pipeline-depth": bench_pipeline_depth,
+}
+
+# kernel introspection & regression sentinel (ISSUE 17): sweep-telemetry
+# needs jax; cpu-microbench deliberately does NOT (it short-circuits in
+# main() before the backend probe so the check.sh benchdiff gate stays
+# fast and deterministic)
+OBS_CONFIGS = {
+    "sweep-telemetry": bench_sweep_telemetry,
+    "cpu-microbench": bench_cpu_microbench,
 }
 
 # WAL-shipping replication scale-out (ISSUE 9): same contract
@@ -1999,6 +2196,7 @@ def _config_registry() -> dict:
         "replication": list(REPLICATION_CONFIGS),
         "write sharding": list(SHARDING_CONFIGS),
         "scenario matrix": list(SCENARIO_CONFIGS),
+        "observability": list(OBS_CONFIGS),
     }
 
 
@@ -2053,6 +2251,11 @@ def main() -> None:
     ap.add_argument("--direct-only", action="store_true",
                     help="headline = direct batched call instead of the "
                          "concurrent dispatcher path")
+    ap.add_argument("--baseline", default="", metavar="ARTIFACT",
+                    help="compare this run's artifact against a prior "
+                         "bench JSON via scripts/benchdiff.py and exit "
+                         "with its verdict (0 ok, 1 regression); "
+                         "currently honored by --config cpu-microbench")
     ap.add_argument("--replica-worker", default="", help=argparse.SUPPRESS)
     ap.add_argument("--shard-worker", default="", help=argparse.SUPPRESS)
     args = ap.parse_args()
@@ -2069,6 +2272,37 @@ def main() -> None:
         return
 
     start_watchdog(args.deadline)
+
+    if args.config == "cpu-microbench":
+        # perf-regression sentinel config: pure python, runs BEFORE the
+        # backend probe / jax import so the check.sh benchdiff gate is
+        # fast, deterministic, and immune to device bring-up weather
+        stage("cpu-microbench (no jax)")
+        _STATE["metric"] = "cpu-microbench"
+        res = bench_cpu_microbench(args)
+        payload = {
+            "metric": "cpu-microbench",
+            "value": res["configs"]["dispatch-check"]["median_s"],
+            "unit": "s/round", "platform": "cpu-python",
+            "baseline": "committed benchdiff baseline artifact "
+                        "(scripts/benchdiff_baseline.json)",
+            **res}
+        emit(payload)
+        if args.baseline:
+            import importlib.util
+            spec = importlib.util.spec_from_file_location(
+                "benchdiff",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts", "benchdiff.py"))
+            bd = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(bd)
+            with open(args.baseline) as f:
+                base = json.load(f)
+            verdict = bd.compare(base, payload)
+            bd.print_report(verdict, file=sys.stderr)
+            sys.exit(1 if verdict["regressions"] else 0)
+        return
+
     path_desc = (f"{args.batch}-subject direct batched call"
                  if args.direct_only else
                  f"{args.batch} concurrent list requests, batched dispatch")
@@ -2135,6 +2369,25 @@ def main() -> None:
               "platform": _STATE["platform"],
               "baseline": "DevicePipeline gate off (host-pack serial "
                           "dispatch, the pre-PR path)",
+              **res})
+        return
+
+    if args.config in OBS_CONFIGS:
+        # kernel-introspection A/B: the headline value is the telemetry
+        # overhead (acceptance: within noise), the gate-off byte-
+        # identical jits are the baseline
+        stage(f"observability config {args.config}")
+        tel_before = devtel_snapshot()
+        res = OBS_CONFIGS[args.config](args)
+        tel = devtel_delta(tel_before)
+        if tel:
+            res["device_telemetry"] = tel
+        _STATE["metric"] = f"kernel-introspection {args.config}"
+        emit({"metric": _STATE["metric"],
+              "value": res.get("overhead_pct", 0.0), "unit": "%",
+              "platform": _STATE["platform"],
+              "baseline": "KernelIntrospect gate off (byte-identical "
+                          "pre-introspection jits, interleaved rounds)",
               **res})
         return
 

@@ -34,6 +34,9 @@ ALLOWED_METRIC_LABELS = frozenset((
     "verb", "code", "phase", "backend", "resource", "reason", "stage",
     "decision", "generation", "kind", "le", "bucket", "slo", "window",
     "cause", "mode", "shard", "tier",
+    # sweep telemetry: which fixpoint kernel produced the measurement
+    # (ell | segment — bounded by the code, not by traffic)
+    "kernel",
 ))
 _METRIC_FACTORIES = ("counter", "gauge", "histogram")
 _M001_PREFIX = "spicedb_kubeapi_proxy_tpu"

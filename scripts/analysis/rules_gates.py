@@ -43,6 +43,12 @@ GATED_MODULES = (
     # router/endpoint compositions, and the authz_shard_* recording
     # helpers) under the `Sharding` killswitch
     ("spicedb/sharding/", "Sharding"),
+    # kernel introspection & cost attribution: the sweep-telemetry
+    # accounting plane (authz_sweep_* metrics + /debug/workload) rides
+    # the KernelIntrospect gate; the sampling profiler has its own
+    # killswitch because a blocking capture is a heavier hammer
+    ("utils/workload.py", "KernelIntrospect"),
+    ("utils/profiler.py", "Profiler"),
 )
 
 _MUTATOR_METHODS = ("inc", "observe", "dec")

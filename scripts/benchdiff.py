@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel: noise-aware comparison of two bench
+artifacts (docs/performance.md "Regression sentinel").
+
+Usage:
+
+  python scripts/benchdiff.py BASELINE.json CURRENT.json [--threshold X]
+
+Both artifacts are `bench.py --config cpu-microbench` JSON lines (or any
+artifact with the same shape): a top-level `calibration_s` plus
+`configs: {name: {per_round_s: [...], median_s: N}}`.
+
+Methodology — every number below exists to avoid a flaky gate:
+
+- **Calibration-normalized**: each run's medians are divided by its own
+  pure-python calibration loop time, so a baseline recorded on a fast
+  machine does not flag a slower CI box (and vice versa).  What's
+  compared is "work units per benchmark round", not wall seconds.
+- **Paired per-config deltas**: each config is compared only against the
+  same config in the baseline; configs present on one side only are
+  reported but never fail the gate.
+- **Variance-derived thresholds**: the allowed ratio is
+  `max(floor, 1 + K * (cv_base + cv_cur))` where cv is the per-round
+  coefficient of variation of each run.  A noisy pair of runs earns a
+  wider band; two tight runs earn a narrow one.  The floor (default
+  1.8x) keeps the gate deliberately generous — it exists to catch
+  injected-sleep-sized regressions, not 5% drift.
+
+Exit codes: 0 = no regression, 1 = regression (every offending config
+named on stderr), 2 = usage / unreadable artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+
+# generous default ratio floor: the gate targets real slowdowns (an
+# injected per-drain sleep roughly doubles dispatch rounds), not drift
+DEFAULT_FLOOR = 1.8
+# noise multiplier: threshold widens by K x (cv_base + cv_cur)
+NOISE_K = 4.0
+
+
+def _cv(per_round: list) -> float:
+    """Coefficient of variation of one run's per-round times."""
+    if not per_round or len(per_round) < 2:
+        return 0.0
+    med = statistics.median(per_round)
+    if med <= 0:
+        return 0.0
+    return statistics.stdev(per_round) / med
+
+
+def compare(base: dict, cur: dict, floor: float = DEFAULT_FLOOR) -> dict:
+    """Pure comparison of two artifacts; returns the verdict dict
+    (unit-tested in tests/test_workload.py, reused by bench.py
+    --baseline)."""
+    b_cal = float(base.get("calibration_s") or 0.0)
+    c_cal = float(cur.get("calibration_s") or 0.0)
+    b_cfgs = base.get("configs") or {}
+    c_cfgs = cur.get("configs") or {}
+    rows = []
+    regressions = []
+    unpaired = sorted(set(b_cfgs) ^ set(c_cfgs))
+    for name in sorted(set(b_cfgs) & set(c_cfgs)):
+        b, c = b_cfgs[name], c_cfgs[name]
+        b_med = float(b.get("median_s") or 0.0)
+        c_med = float(c.get("median_s") or 0.0)
+        if b_med <= 0 or c_med <= 0:
+            continue
+        # calibration-normalize when both sides carry a calibration;
+        # fall back to raw wall ratio when either is missing
+        if b_cal > 0 and c_cal > 0:
+            ratio = (c_med / c_cal) / (b_med / b_cal)
+        else:
+            ratio = c_med / b_med
+        thresh = max(floor, 1.0 + NOISE_K * (_cv(b.get("per_round_s"))
+                                             + _cv(c.get("per_round_s"))))
+        row = {"config": name, "ratio": round(ratio, 3),
+               "threshold": round(thresh, 3),
+               "baseline_median_s": b_med, "current_median_s": c_med,
+               "regression": ratio > thresh}
+        rows.append(row)
+        if row["regression"]:
+            regressions.append(name)
+    return {"rows": rows, "regressions": regressions,
+            "unpaired": unpaired,
+            "calibration_ratio": (round(c_cal / b_cal, 3)
+                                  if b_cal > 0 and c_cal > 0 else None)}
+
+
+def print_report(verdict: dict, file=sys.stderr) -> None:
+    for row in verdict["rows"]:
+        flag = "REGRESSION" if row["regression"] else "ok"
+        print(f"benchdiff: {row['config']}: "
+              f"{row['baseline_median_s'] * 1e3:.2f}ms -> "
+              f"{row['current_median_s'] * 1e3:.2f}ms "
+              f"(normalized ratio {row['ratio']}x, "
+              f"threshold {row['threshold']}x) {flag}", file=file)
+    for name in verdict["unpaired"]:
+        print(f"benchdiff: {name}: present on one side only (ignored)",
+              file=file)
+    if verdict["regressions"]:
+        print("benchdiff: FAIL — regression in: "
+              + ", ".join(verdict["regressions"]), file=file)
+    else:
+        print("benchdiff: ok — no regression", file=file)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="noise-aware bench artifact comparison")
+    ap.add_argument("baseline")
+    ap.add_argument("current")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_FLOOR,
+                    help=f"ratio floor (default {DEFAULT_FLOOR}x); the "
+                         "effective threshold also widens with measured "
+                         "per-round variance")
+    args = ap.parse_args()
+    try:
+        with open(args.baseline) as f:
+            base = json.load(f)
+        with open(args.current) as f:
+            cur = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"benchdiff: cannot read artifact: {e}", file=sys.stderr)
+        return 2
+    if not (base.get("configs") and cur.get("configs")):
+        print("benchdiff: artifacts must carry a configs map "
+              "(bench.py --config cpu-microbench output)", file=sys.stderr)
+        return 2
+    verdict = compare(base, cur, floor=args.threshold)
+    print_report(verdict)
+    return 1 if verdict["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,19 @@ echo "== device-telemetry smoke (/metrics + /debug/flight + /debug/timeline)"
 # with --fast
 JAX_PLATFORMS=cpu python scripts/devtel_smoke.py
 
+echo "== perf-regression sentinel (cpu-microbench vs committed baseline)"
+# noise-aware benchdiff gate (docs/performance.md "Regression
+# sentinel"): a deterministic pure-python microbench (no jax import,
+# ~3s) over the dispatch drain + recursive oracle, compared against
+# scripts/benchdiff_baseline.json calibration-normalized with
+# variance-derived ratio thresholds — an injected slowdown in the
+# drain hot loop (SPICEDB_TPU_BENCHDIFF_INJECT_MS) fails HERE, exit 1,
+# with the offending config named (the tripwire proving the gate can
+# fire lives in tests/test_workload.py::TestBenchdiffGate).  Runs even
+# with --fast.
+python bench.py --config cpu-microbench \
+    --baseline scripts/benchdiff_baseline.json > /tmp/benchdiff_current.json
+
 echo "== churn soak gate (deterministic CPU, small graph, SLO-asserted)"
 # tail-latency hardening acceptance (docs/performance.md "Overload &
 # rebuild behavior"): sustained create/delete churn + list-heavy reads

@@ -15,6 +15,11 @@ What it proves end to end:
   into multiple fused batches and `authz_dispatch_overlap_ratio` goes
   positive, while `stall{cause=pack|transpose}` stays ~0 relative to
   kernel time (the host encode/word-transpose moved on-device);
+- kernel introspection & workload attribution: after real mixed
+  traffic the measured sweep histograms carry samples, `/debug/workload`
+  attributes device seconds per (type, permission) and its total
+  reconciles with cumulative `authz_kernel_time_seconds` within 5%,
+  and `/debug/profile` returns non-empty collapsed stacks;
 - admission control (second server, `--shed-queue-depth` +
   `jax://?max_queue_depth=`): driving concurrent read waves past the
   queue bound yields kube-style 429 Status responses carrying a
@@ -92,6 +97,9 @@ REQUIRED_FAMILIES = (
     "authz_dispatch_bandwidth_bytes_per_sec",
     "authz_roofline_fraction",
     "authz_dispatch_overlap_ratio",
+    # kernel introspection & workload attribution (utils/workload.py)
+    "authz_sweep_iterations",
+    "authz_frontier_decay",
 )
 
 # stages that prove a real device dispatch landed on the timeline
@@ -269,12 +277,62 @@ async def main() -> None:
                  f"onto the hot path (device-resident pipeline regression; "
                  f"see lint M003)")
 
+        # -- workload attribution & profiling ------------------------
+        # the waves above pushed real check + lookup traffic through
+        # the kernels: the measured sweep histograms must carry samples
+        resp = await alice.get("/metrics")
+        text = resp.body.decode()
+        if "authz_sweep_iterations_bucket{" not in text:
+            fail("authz_sweep_iterations has no samples after kernel "
+                 "traffic (sweep telemetry never read back a trace)")
+        resp = await alice.get("/debug/workload")
+        if resp.status != 200:
+            fail(f"/debug/workload -> {resp.status}")
+        wl = json.loads(resp.body)
+        if not wl.get("enabled"):
+            fail(f"/debug/workload reports disabled: {wl}")
+        pairs = {(r["resource_type"], r["permission"]): r
+                 for r in wl.get("rows", [])}
+        pod_view = pairs.get(("pod", "view"))
+        if not pod_view:
+            fail(f"/debug/workload has no (pod, view) row: {sorted(pairs)}")
+        if pod_view["kernel_rows"] + pod_view["oracle_rows"] <= 0:
+            fail(f"(pod, view) row attributes no routed rows: {pod_view}")
+        # total device seconds must reconcile with the cumulative
+        # kernel-time histogram (same hook, same seconds) within 5%
+        metric_s = 0.0
+        for line in text.splitlines():
+            if (line.startswith("authz_kernel_time_seconds_sum{")
+                    and ('phase="kernel.device"' in line
+                         or 'phase="kernel.dispatch"' in line)):
+                metric_s += float(line.split()[-1])
+        total_s = wl.get("total_device_s", 0.0)
+        if metric_s <= 0 or total_s <= 0:
+            fail(f"no device seconds to reconcile (metric {metric_s}, "
+                 f"workload {total_s})")
+        if abs(total_s - metric_s) > 0.05 * metric_s:
+            fail(f"/debug/workload total_device_s {total_s:.4f}s does not "
+                 f"reconcile with authz_kernel_time_seconds {metric_s:.4f}s "
+                 f"(> 5% apart)")
+        resp = await alice.get("/debug/profile?seconds=0.2")
+        if resp.status != 200:
+            fail(f"/debug/profile -> {resp.status}")
+        prof = json.loads(resp.body)
+        if not prof.get("enabled"):
+            fail(f"/debug/profile reports disabled: {prof}")
+        if prof.get("samples", 0) <= 0 or not prof.get("collapsed"):
+            fail(f"/debug/profile captured nothing: samples="
+                 f"{prof.get('samples')}, "
+                 f"{len(prof.get('collapsed', []))} collapsed stacks")
+        if not prof.get("chrome_trace", {}).get("traceEvents"):
+            fail("/debug/profile chrome_trace is empty")
+
         resp = await alice.get("/debug")
         if resp.status != 200:
             fail(f"/debug -> {resp.status}")
         surfaces = json.loads(resp.body).get("surfaces", {})
         for path in ("/debug/traces", "/debug/decisions", "/debug/flight",
-                     "/debug/timeline"):
+                     "/debug/timeline", "/debug/workload", "/debug/profile"):
             if path not in surfaces:
                 fail(f"/debug index missing {path}: {surfaces}")
         resp = await alice.get("/debug/nonesuch")
@@ -291,6 +349,9 @@ async def main() -> None:
           f"{len(flight['windows'])} flight windows, "
           f"{len(slices)} timeline dispatch slices, "
           f"pipeline overlap {overlap:.3f}, "
+          f"workload attribution reconciled "
+          f"({total_s:.4f}s vs {metric_s:.4f}s), "
+          f"{prof['samples']} profile samples, "
           f"{rejected} overload rejections)")
 
 

@@ -48,7 +48,11 @@ def main():
             stages["encode"].append(time.perf_counter() - t0)
 
             n_words = max(1, len(q_arr) // 32)
-            _, run_lookup = graph.kernel._fns(n_words)
+            _, run_lookup, intro = graph.kernel._fns(n_words)
+            if intro:
+                # introspect builds return (out, sweep_telemetry)
+                _rl = run_lookup
+                run_lookup = lambda *a: _rl(*a)[0]  # noqa: E731
             t0 = time.perf_counter()
             import jax.numpy as jnp
             if graph.kernel.planes:

@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import devtel, timeline
+from ..utils import devtel, timeline, workload
 from ..utils.failpoints import fail_point
 from .graph_compile import (
     GraphProgram,
@@ -587,7 +587,8 @@ def init_packed_state(prog: GraphProgram, n_aux_rows: int, q_idx,
 def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
                       num_iters: int, use_while: bool = True,
                       planes: bool = False, aux_passes: int = 1,
-                      stages: Optional[tuple] = None, arena: bool = False):
+                      stages: Optional[tuple] = None, arena: bool = False,
+                      introspect: bool = False):
     """fn(q_idx, idx_main, idx_aux[, idx_cav]) -> packed x_final
     [NT, W] uint32 ([NT, 2W] on the tri-state plane path).
 
@@ -595,7 +596,15 @@ def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
     fn(state, q_idx, idx_main, idx_aux[, idx_cav]): `state` is the
     previous call's x_final, donated (jax.jit donate_argnums) so XLA
     aliases its buffer to this call's state output — the persistent
-    sweep state updates in place instead of allocating per call."""
+    sweep state updates in place instead of allocating per call.
+
+    With `introspect=True` (KernelIntrospect gate, resolved at jit-build
+    time) the return value becomes (x_final, tel): tel is an int32
+    [1 + num_iters] sweep trace — tel[0] the executed iteration count,
+    tel[1:1+tel[0]] the per-iteration frontier population (bits that
+    changed, via popcount of x1 ^ x).  The trace rides the carry and is
+    read back with the result D2H, so it adds no device sync; off, the
+    carry is byte-identical to the pre-introspection build."""
     step = make_ell_step(prog, n_aux_rows,
                          half=n_words if planes else None,
                          aux_passes=aux_passes,
@@ -603,6 +612,24 @@ def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
 
     def fixpoint(x0, idx_main, idx_aux, idx_cav):
         if use_while:
+            if introspect:
+                def cond(state):
+                    x, prev_changed, i, trace = state
+                    return jnp.logical_and(prev_changed, i < num_iters)
+
+                def body(state):
+                    x, _, i, trace = state
+                    x1 = step(x, x0, idx_main, idx_aux, idx_cav)
+                    delta = jnp.sum(
+                        jax.lax.population_count(x1 ^ x)).astype(jnp.int32)
+                    return (x1, delta > jnp.int32(0), i + 1,
+                            trace.at[i].set(delta))
+
+                x_final, _, i, trace = jax.lax.while_loop(
+                    cond, body, (x0, jnp.bool_(True), jnp.int32(0),
+                                 jnp.zeros((num_iters,), jnp.int32)))
+                return x_final, jnp.concatenate([i[None], trace])
+
             def cond(state):
                 x, prev_changed, i = state
                 return jnp.logical_and(prev_changed, i < num_iters)
@@ -615,6 +642,17 @@ def make_ell_evaluate(prog: GraphProgram, n_aux_rows: int, n_words: int,
             x_final, _, _ = jax.lax.while_loop(
                 cond, body, (x0, jnp.bool_(True), jnp.int32(0)))
             return x_final
+
+        if introspect:
+            def body(x, _):
+                x1 = step(x, x0, idx_main, idx_aux, idx_cav)
+                delta = jnp.sum(
+                    jax.lax.population_count(x1 ^ x)).astype(jnp.int32)
+                return x1, delta
+
+            x_final, deltas = jax.lax.scan(body, x0, None, length=num_iters)
+            return x_final, jnp.concatenate(
+                [jnp.full((1,), num_iters, jnp.int32), deltas])
 
         def body(x, _):
             return step(x, x0, idx_main, idx_aux, idx_cav), None
@@ -644,6 +682,9 @@ class EllKernelCache:
     {0,1,2} (NO / CONDITIONAL / HAS), lookups return the DEFINITE plane
     only (LookupResources skips conditional results, reference
     lookups.go:85-88)."""
+
+    # metric label for authz_sweep_iterations / authz_frontier_decay
+    kernel_name = "ell"
 
     def __init__(self, prog: GraphProgram, n_aux_rows: int, tree_depth: int,
                  num_iters: Optional[int] = None, planes: bool = False,
@@ -717,39 +758,52 @@ class EllKernelCache:
             devtel.KERNELS.note_jit_hit(n_words * 32)
             return fns
         devtel.KERNELS.note_compile(n_words * 32)
+        # introspection is resolved at jit-BUILD time: gate off, the
+        # functions below are exactly the pre-introspection build (no
+        # trace in the carry, scalar return shapes) — the killswitch is
+        # byte-identical, not merely quiet
+        intro = workload.enabled()
         evaluate = make_ell_evaluate(self.prog, self.n_aux_rows, n_words,
                                      self.num_iters, planes=self.planes,
                                      aux_passes=self.aux_passes,
-                                     stages=self.stages)
+                                     stages=self.stages, introspect=intro)
         if self.planes:
             def run_checks(q_idx, gather_idx, gather_word, gather_bit,
                            idx_main, idx_aux, idx_cav):
-                x = evaluate(q_idx, idx_main, idx_aux, idx_cav)
+                xe = evaluate(q_idx, idx_main, idx_aux, idx_cav)
+                x, tel = xe if intro else (xe, None)
                 dw = x[gather_idx, gather_word]
                 mw = x[gather_idx, n_words + gather_word]
                 d = (dw >> gather_bit) & jnp.uint32(1)
                 m = (mw >> gather_bit) & jnp.uint32(1)
                 # 2=HAS, 1=CONDITIONAL (maybe without definite), 0=NO
-                return d * 2 + (m & (d ^ jnp.uint32(1)))
+                out = d * 2 + (m & (d ^ jnp.uint32(1)))
+                return (out, tel) if intro else out
 
             def run_lookup(slot_offset, slot_length, q_idx,
                            idx_main, idx_aux, idx_cav):
-                x = evaluate(q_idx, idx_main, idx_aux, idx_cav)
-                return jax.lax.dynamic_slice(
+                xe = evaluate(q_idx, idx_main, idx_aux, idx_cav)
+                x, tel = xe if intro else (xe, None)
+                out = jax.lax.dynamic_slice(
                     x, (slot_offset, 0), (slot_length, n_words))
+                return (out, tel) if intro else out
         else:
             def run_checks(q_idx, gather_idx, gather_word, gather_bit,
                            idx_main, idx_aux):
-                x = evaluate(q_idx, idx_main, idx_aux)
+                xe = evaluate(q_idx, idx_main, idx_aux)
+                x, tel = xe if intro else (xe, None)
                 words = x[gather_idx, gather_word]
-                return (words >> gather_bit) & jnp.uint32(1)
+                out = (words >> gather_bit) & jnp.uint32(1)
+                return (out, tel) if intro else out
 
             def run_lookup(slot_offset, slot_length, q_idx, idx_main, idx_aux):
-                x = evaluate(q_idx, idx_main, idx_aux)
+                xe = evaluate(q_idx, idx_main, idx_aux)
+                x, tel = xe if intro else (xe, None)
                 # return PACKED words: device->host transfer is the dominant
                 # cost (32x fewer bytes than a bool bitmap); host unpacks
-                return jax.lax.dynamic_slice_in_dim(
+                out = jax.lax.dynamic_slice_in_dim(
                     x, slot_offset, slot_length, axis=0)       # [L, W] uint32
+                return (out, tel) if intro else out
 
         # XLA compiles lazily inside the first execution; the
         # first-call-per-compile-key wrapper records each such window
@@ -766,7 +820,8 @@ class EllKernelCache:
                                         shape_args=True),
                timeline.time_first_call(
                    jax.jit(run_lookup, static_argnums=(0, 1)),
-                   bucket=n_words * 32, static_args=2, shape_args=True))
+                   bucket=n_words * 32, static_args=2, shape_args=True),
+               intro)
         self._jits[n_words] = fns
         return fns
 
@@ -785,10 +840,15 @@ class EllKernelCache:
             devtel.KERNELS.note_jit_hit(n_words * 32)
             return fns
         devtel.KERNELS.note_compile(n_words * 32)
+        # introspection resolved at jit-build time (see _fns); when on,
+        # the pipelined entries return (out, state, tel) and the sweep
+        # trace rides the same async D2H the result does
+        intro = workload.enabled()
         evaluate = make_ell_evaluate(self.prog, self.n_aux_rows, n_words,
                                      self.num_iters, planes=self.planes,
                                      aux_passes=self.aux_passes,
-                                     stages=self.stages, arena=True)
+                                     stages=self.stages, arena=True,
+                                     introspect=intro)
         if self.planes:
             def run_checks(q_idx, gather_idx, gather_col, state,
                            idx_main, idx_aux, idx_cav):
@@ -796,37 +856,43 @@ class EllKernelCache:
                 # the host uploads plain int32 column ids
                 gw = gather_col // 32
                 gb = (gather_col % 32).astype(jnp.uint32)
-                x = evaluate(state, q_idx, idx_main, idx_aux, idx_cav)
+                xe = evaluate(state, q_idx, idx_main, idx_aux, idx_cav)
+                x, tel = xe if intro else (xe, None)
                 d = (x[gather_idx, gw] >> gb) & jnp.uint32(1)
                 m = (x[gather_idx, n_words + gw] >> gb) & jnp.uint32(1)
                 # 2=HAS, 1=CONDITIONAL (maybe without definite), 0=NO
-                return d * 2 + (m & (d ^ jnp.uint32(1))), x
+                out = d * 2 + (m & (d ^ jnp.uint32(1)))
+                return (out, x, tel) if intro else (out, x)
 
             def run_lookup(slot_offset, slot_length, q_idx, state,
                            idx_main, idx_aux, idx_cav):
-                x = evaluate(state, q_idx, idx_main, idx_aux, idx_cav)
+                xe = evaluate(state, q_idx, idx_main, idx_aux, idx_cav)
+                x, tel = xe if intro else (xe, None)
                 sl = jax.lax.dynamic_slice(
                     x, (slot_offset, 0), (slot_length, n_words))
                 # transpose ON DEVICE: the D2H lands [W, L] contiguous
                 # per word row, so host extraction is row indexing with
                 # no 51MB host transpose copy (DEFINITE plane only)
-                return sl.T, x
+                return (sl.T, x, tel) if intro else (sl.T, x)
         else:
             def run_checks(q_idx, gather_idx, gather_col, state,
                            idx_main, idx_aux):
                 gw = gather_col // 32
                 gb = (gather_col % 32).astype(jnp.uint32)
-                x = evaluate(state, q_idx, idx_main, idx_aux)
+                xe = evaluate(state, q_idx, idx_main, idx_aux)
+                x, tel = xe if intro else (xe, None)
                 # tri-state encoding ({0, 2}) so every kernel variant
                 # hands the endpoint the same value space
-                return ((x[gather_idx, gw] >> gb) & jnp.uint32(1)) * 2, x
+                out = ((x[gather_idx, gw] >> gb) & jnp.uint32(1)) * 2
+                return (out, x, tel) if intro else (out, x)
 
             def run_lookup(slot_offset, slot_length, q_idx, state,
                            idx_main, idx_aux):
-                x = evaluate(state, q_idx, idx_main, idx_aux)
+                xe = evaluate(state, q_idx, idx_main, idx_aux)
+                x, tel = xe if intro else (xe, None)
                 sl = jax.lax.dynamic_slice_in_dim(
                     x, slot_offset, slot_length, axis=0)
-                return sl.T, x
+                return (sl.T, x, tel) if intro else (sl.T, x)
 
         # donate_argnums=3 = the state arena (positions count the full
         # signature, statics included); donation is a no-op on backends
@@ -837,7 +903,8 @@ class EllKernelCache:
                timeline.time_first_call(
                    jax.jit(run_lookup, static_argnums=(0, 1),
                            donate_argnums=(3,)),
-                   bucket=n_words * 32, static_args=2, shape_args=True))
+                   bucket=n_words * 32, static_args=2, shape_args=True),
+               intro)
         self._jits[("pipe", n_words)] = fns
         return fns
 
@@ -892,36 +959,40 @@ class EllKernelCache:
                       gather_idx: np.ndarray, gather_col: np.ndarray,
                       idx_main, idx_aux, idx_cav=None):
         """Dispatch-only tri-state checks ({0,2}, or {0,1,2} with
-        planes): returns the un-materialized device array; the caller
-        owns the blocking readback."""
-        run_checks, _ = self._pipe_fns(n_words)
+        planes): returns (out, tel) — the un-materialized device result
+        plus the sweep-trace device array (None when KernelIntrospect
+        was off at jit build); the caller owns the blocking readback."""
+        run_checks, _, intro = self._pipe_fns(n_words)
         state = self.take_arena(n_words)
         args = [jnp.asarray(q_idx), jnp.asarray(gather_idx),
                 jnp.asarray(gather_col), state, idx_main, idx_aux]
         if self.planes:
-            out, x = run_checks(*args, idx_cav)
+            res = run_checks(*args, idx_cav)
         else:
-            out, x = run_checks(*args)
+            res = run_checks(*args)
+        out, x, tel = res if intro else (res[0], res[1], None)
         self.put_arena(n_words, x)
-        return out
+        return out, tel
 
     def lookup_packed_T_device(self, slot_offset: int, slot_length: int,
                                q_idx: np.ndarray, n_words: int,
                                idx_main, idx_aux, idx_cav=None):
         """Dispatch-only packed lookup, word-transposed on device:
-        returns the un-materialized [n_words, slot_length] uint32 device
-        array (bit b of word row w = query column w*32+b; DEFINITE plane
-        when planes are active)."""
-        _, run_lookup = self._pipe_fns(n_words)
+        returns (out, tel) — out the un-materialized
+        [n_words, slot_length] uint32 device array (bit b of word row w
+        = query column w*32+b; DEFINITE plane when planes are active),
+        tel the sweep trace (None when KernelIntrospect was off)."""
+        _, run_lookup, intro = self._pipe_fns(n_words)
         state = self.take_arena(n_words)
         if self.planes:
-            out, x = run_lookup(slot_offset, slot_length, jnp.asarray(q_idx),
-                                state, idx_main, idx_aux, idx_cav)
+            res = run_lookup(slot_offset, slot_length, jnp.asarray(q_idx),
+                             state, idx_main, idx_aux, idx_cav)
         else:
-            out, x = run_lookup(slot_offset, slot_length, jnp.asarray(q_idx),
-                                state, idx_main, idx_aux)
+            res = run_lookup(slot_offset, slot_length, jnp.asarray(q_idx),
+                             state, idx_main, idx_aux)
+        out, x, tel = res if intro else (res[0], res[1], None)
         self.put_arena(n_words, x)
-        return out
+        return out, tel
     # hotpath: end
 
     def iterations(self, q_idx: np.ndarray, n_words: int, idx_main, idx_aux,
@@ -972,16 +1043,19 @@ class EllKernelCache:
                idx_cav=None) -> np.ndarray:
         """bool allowed per gather slot — or int {0,1,2} tri-state when the
         plane path is active."""
-        run_checks, _ = self._fns(n_words)
+        run_checks, _, intro = self._fns(n_words)
         gcol = np.asarray(gather_col, np.int64)
         args = [jnp.asarray(q_idx), jnp.asarray(gather_idx),
                 jnp.asarray(gcol // 32),
                 jnp.asarray((gcol % 32).astype(np.uint32)),
                 idx_main, idx_aux]
+        out = run_checks(*args, idx_cav) if self.planes else run_checks(*args)
+        if intro:
+            out, tel = out
+            workload.note_sweep("ell", "check", np.asarray(tel))
         if self.planes:
-            out = run_checks(*args, idx_cav)
             return np.asarray(out).astype(np.int8)
-        return np.asarray(run_checks(*args)) != 0
+        return np.asarray(out) != 0
 
     def lookup_packed(self, slot_offset: int, slot_length: int,
                       q_idx: np.ndarray, n_words: int, idx_main, idx_aux,
@@ -991,14 +1065,17 @@ class EllKernelCache:
         active).  The packed form is what the device computes and what the
         host should consume: per-column extraction is a shift/AND/nonzero
         over one word column, 32x less memory traffic than a bool bitmap."""
-        _, run_lookup = self._fns(n_words)
+        _, run_lookup, intro = self._fns(n_words)
         if self.planes:
-            return np.ascontiguousarray(
-                run_lookup(slot_offset, slot_length,
-                           jnp.asarray(q_idx), idx_main, idx_aux, idx_cav))
-        return np.ascontiguousarray(
-            run_lookup(slot_offset, slot_length,
-                       jnp.asarray(q_idx), idx_main, idx_aux))
+            out = run_lookup(slot_offset, slot_length,
+                             jnp.asarray(q_idx), idx_main, idx_aux, idx_cav)
+        else:
+            out = run_lookup(slot_offset, slot_length,
+                             jnp.asarray(q_idx), idx_main, idx_aux)
+        if intro:
+            out, tel = out
+            workload.note_sweep("ell", "lookup", np.asarray(tel))
+        return np.ascontiguousarray(out)
 
     def lookup(self, slot_offset: int, slot_length: int, q_idx: np.ndarray,
                n_words: int, idx_main, idx_aux, idx_cav=None) -> np.ndarray:

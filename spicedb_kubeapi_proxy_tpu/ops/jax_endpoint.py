@@ -57,7 +57,7 @@ from ..utils.features import pipeline_enabled as _pipeline_on
 from ..utils.failpoints import fail_point
 
 from ..spicedb import schema as sch
-from ..utils import devtel, timeline, tracing
+from ..utils import devtel, timeline, tracing, workload
 from ..spicedb.endpoints import (
     Bootstrap,
     DEFAULT_BOOTSTRAP_SCHEMA,
@@ -239,12 +239,15 @@ def _register_graph_buffers(graph, gen: int) -> int:
 
 def _sweep_bytes(graph, lanes: int) -> int:
     """Modeled HBM bytes for ONE fixpoint sweep of `graph` at `lanes`
-    query lanes — the dispatch timeline's kernel-stage byte tag (feeds
-    `authz_roofline_fraction`).  Counts each gather slot's packed-state
-    read plus one state write per row, scaled by the batch width; the
-    same accounting as bench.py's roofline model but WITHOUT the
-    iteration count (not host-visible per call), so the resulting
-    bandwidth is a strict lower bound on true traffic.  The static row
+    query lanes.  Counts each gather slot's packed-state read plus one
+    state write per row, scaled by the batch width — the same accounting
+    as bench.py's roofline model.  With KernelIntrospect on, the kernels
+    read back the EXECUTED iteration count and the timeline's kernel
+    byte tag becomes measured `iterations x this value` (basis
+    "measured" in `/debug/timeline`); gate off — or on paths without a
+    readback trace (sharded kernel, pre-first-readback) — this one-sweep
+    value is used alone and the resulting bandwidth keeps its historical
+    strict-lower-bound semantics (basis "modeled").  The static row
     factor is cached on the graph (shapes are fixed per generation)."""
     cached = getattr(graph, "_timeline_sweep", None)
     if cached is None:
@@ -353,11 +356,20 @@ class _GenState:
 
 
 def _start_readback(dev, batch_id, bucket: int, sweep_bytes: int,
-                    kind: str, on_error=None):
+                    kind: str, on_error=None, tel=None, verb=None,
+                    comp=None, kernel="ell"):
     """Submit the async readback of a dispatched device result; returns
     a concurrent.futures.Future resolving to the host numpy array.
     `on_error` (e.g. discarding the donated arena chain) runs before the
-    exception propagates to the waiter."""
+    exception propagates to the waiter.
+
+    `tel` (KernelIntrospect) is the sweep-trace device array the
+    pipelined kernels return alongside the result: it is materialized
+    AFTER block_until_ready (no extra sync — the whole computation is
+    already done) and turns the kernel slice's byte tag from the modeled
+    one-sweep floor into measured `iterations x sweep_bytes`.  `comp`
+    (the batch's (type, permission, rows) composition) rides the device
+    window attrs into the workload cost-attribution plane."""
     t0 = timeline.now()
 
     def wait_and_fetch():
@@ -368,14 +380,23 @@ def _start_readback(dev, batch_id, bucket: int, sweep_bytes: int,
             fail_point("readbackWaiter")
             dev.block_until_ready()
             t_ready = timeline.now()
+            nbytes, measured = sweep_bytes, False
+            if tel is not None:
+                rec = workload.note_sweep(kernel, verb or kind,
+                                          np.asarray(tel))
+                if rec is not None and rec.iterations > 0:
+                    nbytes = rec.iterations * sweep_bytes
+                    measured = True
+                    workload.WORKLOAD.note_depth(comp, rec.iterations)
             # the true device window: dispatch -> results ready (includes
             # queueing behind earlier batches on the device stream, same
             # contract as the serial path's host window)
             timeline.record("kernel", "device", t0, t_ready,
                             batch=batch_id, bucket=bucket,
-                            nbytes=sweep_bytes)
+                            nbytes=nbytes, measured=measured)
             tracing.note_device_window(
-                "kernel.device", {"kind": kind, "bucket": bucket},
+                "kernel.device", {"kind": kind, "bucket": bucket,
+                                  "workload": comp},
                 t_ready - t0)
             if hasattr(dev, "copy_to_host_async"):
                 dev.copy_to_host_async()
@@ -497,7 +518,7 @@ class _PrewarmMixin:
                 gc = np.zeros(g, np.int32)
                 t0 = timeline.now()
                 if pipelined:
-                    dev, _ = self.run_checks3_device(q, gi, gc, snap=snap)
+                    dev, _, _ = self.run_checks3_device(q, gi, gc, snap=snap)
                     np.asarray(dev)
                 else:
                     self.run_checks3(q, gi, gc, snap=snap)
@@ -509,7 +530,7 @@ class _PrewarmMixin:
             for (off, length) in slot_ranges:
                 t0 = timeline.now()
                 if pipelined:
-                    dev, _ = lookup(off, length, q, snap=snap)
+                    dev, _, _ = lookup(off, length, q, snap=snap)
                     np.asarray(dev)
                 else:
                     lookup(off, length, q, snap=snap)
@@ -705,12 +726,14 @@ class _SegmentGraph(_PrewarmMixin):
         # same bucket unification as run_checks (prewarm-diagonal keys)
         q_arr, gi, gc = _unify_check_buckets(
             q_arr, gather_idx, gather_col, self.prog.dead_index)
-        return kern.checks3_device(q_arr, gi, gc, src, dst), kern
+        dev, tel = kern.checks3_device(q_arr, gi, gc, src, dst)
+        return dev, tel, kern
 
     def run_lookup_T_device(self, offset: int, length: int, q_arr,
                             snap=None):
         kern, src, dst = snap if snap is not None else self.snapshot()
-        return kern.lookup_T_device(offset, length, q_arr, src, dst), kern
+        dev, tel = kern.lookup_T_device(offset, length, q_arr, src, dst)
+        return dev, tel, kern
 
     # no MAYBE plane: removals are vacuous, insertions force a rebuild
     def remove_cav_key(self, key: tuple) -> bool:
@@ -1056,15 +1079,17 @@ class _EllGraph(_PrewarmMixin):
         q_arr, gi, gc = _unify_check_buckets(
             q_arr, gather_idx, gather_col, self.prog.dead_index)
         n_words = max(1, len(q_arr) // 32)
-        return self.kernel.checks_device(q_arr, n_words, gi, gc,
-                                         main, aux, cav), self.kernel
+        dev, tel = self.kernel.checks_device(q_arr, n_words, gi, gc,
+                                             main, aux, cav)
+        return dev, tel, self.kernel
 
     def run_lookup_packed_T_device(self, offset: int, length: int, q_arr,
                                    snap=None):
         main, aux, cav = snap if snap is not None else self.snapshot()
         n_words = max(1, len(q_arr) // 32)
-        return self.kernel.lookup_packed_T_device(
-            offset, length, q_arr, n_words, main, aux, cav), self.kernel
+        dev, tel = self.kernel.lookup_packed_T_device(
+            offset, length, q_arr, n_words, main, aux, cav)
+        return dev, tel, self.kernel
 
 class _ShardedEllGraph(_EllGraph):
     """Multi-chip ELL graph: same positionless host tables and tree-walk
@@ -1183,6 +1208,9 @@ class JaxEndpoint(PermissionsEndpoint):
                  mesh=None):
         self.schema = schema
         self.store = store if store is not None else TupleStore()
+        # workload attribution resolves footprint closures (the Leopard
+        # nesting detector) against the serving schema
+        workload.WORKLOAD.note_schema(schema)
         # oracle fallback for query endpoints outside the compiled universe
         self._oracle = Evaluator(schema, self.store)
         self._num_iters = num_iters
@@ -2229,10 +2257,19 @@ class JaxEndpoint(PermissionsEndpoint):
         # queueing behind a hundreds-of-ms kernel hold.
         ctx = {"reqs": reqs, "results": results, "kernel_rows": kernel_rows,
                "oracle_rows": oracle_rows, "rev": rev, "batch_id": bid}
+        if oracle_rows:
+            workload.WORKLOAD.note_oracle(
+                workload.comp_rows([reqs[i] for i in oracle_rows]))
         if kernel_rows:
+            # (type, permission) composition of the kernel-served rows:
+            # rides the device-window span attrs into the workload
+            # cost-attribution plane (utils/workload.py)
+            comp = workload.comp_rows([reqs[i] for i in kernel_rows])
+            occ = used / len(q_arr) if len(q_arr) else None
             pipe = (getattr(graph, "run_checks3_device", None)
                     if _pipeline_on() else None)
             if pipe is not None:
+                workload.WORKLOAD.note_batch(comp, "check", occupancy=occ)
                 # hotpath: begin pipelined check dispatch (device does the
                 # word/bit split and the readback is async — reintroducing
                 # host numpy staging here is the regression M003 guards)
@@ -2240,14 +2277,16 @@ class JaxEndpoint(PermissionsEndpoint):
                                          rows=len(kernel_rows),
                                          bucket=len(q_arr)) as a:
                     a["batch_id"] = bid
-                    dev, kern = pipe(q_arr, gather_idx, gather_col,
-                                     snap=snap)
+                    dev, tel, kern = pipe(q_arr, gather_idx, gather_col,
+                                          snap=snap)
                 key = kern.arena_key(len(q_arr))
                 ctx["readback"] = _start_readback(
                     dev, bid, bucket=len(q_arr),
                     sweep_bytes=_sweep_bytes(graph, len(q_arr)),
                     kind="check",
-                    on_error=lambda: kern.discard_arena(key))
+                    on_error=lambda: kern.discard_arena(key),
+                    tel=tel, verb="check", comp=comp,
+                    kernel=getattr(kern, "kernel_name", "ell"))
                 # hotpath: end
             else:
                 with tracing.kernel_span("kernel.device", kind="check",
@@ -2258,8 +2297,20 @@ class JaxEndpoint(PermissionsEndpoint):
                     # attrs into the device track
                     a["batch_id"] = bid
                     a["nbytes"] = _sweep_bytes(graph, len(q_arr))
+                    a["workload"] = comp
+                    workload.take_last_sweep()  # drop any stale record
                     ctx["out"] = graph.run_checks3(q_arr, gather_idx,
                                                    gather_col, snap=snap)
+                    # serial path: the sweep record is available
+                    # synchronously (same thread) — upgrade the span's
+                    # byte tag to measured iterations x one-sweep bytes
+                    rec = workload.take_last_sweep()
+                    if rec is not None and rec.iterations > 0:
+                        a["nbytes"] *= rec.iterations
+                        a["measured"] = True
+                    workload.WORKLOAD.note_batch(
+                        comp, "check",
+                        rec.iterations if rec is not None else None, occ)
         return ctx
 
     def _check_batch_finish(self, ctx: dict) -> list:
@@ -2437,16 +2488,20 @@ class JaxEndpoint(PermissionsEndpoint):
                     self.stats["kernel_calls"] += 1
         if oracle:
             # host evaluation outside the lock (reads the live store)
+            workload.WORKLOAD.note_oracle([(resource_type, permission, 1)])
             with tracing.span("kernel.oracle", kind="lookup"):
                 return AnnotatedIds(
                     self._oracle.lookup_resources(resource_type, permission,
                                                   subject),
                     source="oracle"), 0
         # kernel + extraction outside the lock (immutable snapshot)
+        comp = [(resource_type, permission, 1)]
         with tracing.kernel_span("kernel.device", kind="lookup",
                                  bucket=len(q_arr)) as a:
             a["batch_id"] = bid
             a["nbytes"] = _sweep_bytes(graph, len(q_arr))
+            a["workload"] = comp
+            workload.take_last_sweep()  # drop any stale record
             if hasattr(graph, "run_lookup_packed"):
                 packed = graph.run_lookup_packed(rng[0], rng[1], q_arr,
                                                  snap=snap)
@@ -2455,6 +2510,14 @@ class JaxEndpoint(PermissionsEndpoint):
             else:
                 bitmap = graph.run_lookup(rng[0], rng[1], q_arr, snap=snap)
                 idx = np.nonzero(bitmap[:, col])[0]
+            rec = workload.take_last_sweep()
+            if rec is not None and rec.iterations > 0:
+                a["nbytes"] *= rec.iterations
+                a["measured"] = True
+            workload.WORKLOAD.note_batch(
+                comp, "lookup",
+                rec.iterations if rec is not None else None,
+                1 / len(q_arr) if len(q_arr) else None)
         t_ext = timeline.now()
         out, bad_n, bad_sample = _ids_for(ids, idx, ph, mask)
         timeline.record("extract", "host", t_ext, batch=bid)
@@ -2544,14 +2607,19 @@ class JaxEndpoint(PermissionsEndpoint):
         ctx = {"rt": resource_type, "perm": permission, "subjects": subjects,
                "batch_id": bid}
         if all_oracle:
+            workload.WORKLOAD.note_oracle(
+                [(resource_type, permission, len(subjects))])
             ctx["all_oracle"] = True
             return ctx
         # kernel dispatch outside the lock (immutable snapshot)
+        comp = [(resource_type, permission, len(subjects))]
+        occ = used / len(q_arr) if len(q_arr) else None
         pipe = None
         if _pipeline_on():
             pipe = (getattr(graph, "run_lookup_packed_T_device", None)
                     or getattr(graph, "run_lookup_T_device", None))
         if pipe is not None:
+            workload.WORKLOAD.note_batch(comp, "lookup", occupancy=occ)
             # hotpath: begin pipelined lookup dispatch — bitplane pack,
             # word transpose, and final-slice all fused in-jit; the
             # device array reads back asynchronously (reintroducing the
@@ -2561,13 +2629,15 @@ class JaxEndpoint(PermissionsEndpoint):
                                      batch=len(subjects),
                                      bucket=len(q_arr)) as a:
                 a["batch_id"] = bid
-                dev, kern = pipe(rng[0], rng[1], q_arr, snap=snap)
+                dev, tel, kern = pipe(rng[0], rng[1], q_arr, snap=snap)
             key = kern.arena_key(len(q_arr))
             ctx["readback"] = _start_readback(
                 dev, bid, bucket=len(q_arr),
                 sweep_bytes=_sweep_bytes(graph, len(q_arr)),
                 kind="lookup_batch",
-                on_error=lambda: kern.discard_arena(key))
+                on_error=lambda: kern.discard_arena(key),
+                tel=tel, verb="lookup", comp=comp,
+                kernel=getattr(kern, "kernel_name", "ell"))
             # hotpath: end
         else:
             with tracing.kernel_span("kernel.dispatch", kind="lookup_batch",
@@ -2575,6 +2645,8 @@ class JaxEndpoint(PermissionsEndpoint):
                                      bucket=len(q_arr)) as a:
                 a["batch_id"] = bid
                 a["nbytes"] = _sweep_bytes(graph, len(q_arr))
+                a["workload"] = comp
+                workload.take_last_sweep()  # drop any stale record
                 if hasattr(graph, "run_lookup_packed"):
                     # packed fast path: per-column shift/AND/nonzero over
                     # one uint32 word column — never materializes the 32x
@@ -2589,6 +2661,13 @@ class JaxEndpoint(PermissionsEndpoint):
                 else:
                     ctx["bitmap"] = graph.run_lookup(rng[0], rng[1], q_arr,
                                                      snap=snap)
+                rec = workload.take_last_sweep()
+                if rec is not None and rec.iterations > 0:
+                    a["nbytes"] *= rec.iterations
+                    a["measured"] = True
+                workload.WORKLOAD.note_batch(
+                    comp, "lookup",
+                    rec.iterations if rec is not None else None, occ)
         ctx.update(cols=cols, unknown=unknown, ids=ids, mask=mask, ph=ph,
                    forensic=_forensic)
         return ctx

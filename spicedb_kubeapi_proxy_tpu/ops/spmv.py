@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils import devtel, timeline
+from ..utils import devtel, timeline, workload
 from .graph_compile import (
     GraphProgram,
     PExclude,
@@ -169,7 +169,7 @@ def make_evaluate(prog: GraphProgram, num_iters: int, use_while: bool = True,
                   indices_sorted: bool = True,
                   combine: Optional[Callable] = None,
                   changed_reduce: Optional[Callable] = None,
-                  arena: bool = False):
+                  arena: bool = False, introspect: bool = False):
     """Build fn(q_idx, edge_src, edge_dst) -> x_final of shape [N, B].
 
     q_idx: int32 [B] state index of each query's one-hot (dead index for
@@ -181,11 +181,38 @@ def make_evaluate(prog: GraphProgram, num_iters: int, use_while: bool = True,
     fn(state, q_idx, edge_src, edge_dst): `state` is the previous call's
     x_final, donated so XLA aliases its buffer to this call's state —
     the sweep state updates in place instead of allocating per call.
+
+    With `introspect=True` (KernelIntrospect gate, resolved at jit-build
+    time) the return becomes (x_final, tel): tel is an int32
+    [1 + num_iters] sweep trace — tel[0] the executed iteration count,
+    tel[1:1+tel[0]] the per-iteration frontier population (state entries
+    that changed).  The trace rides the carry and the existing result
+    D2H; off, the carry is byte-identical to the pre-introspection
+    build.
     """
     step = make_step(prog, indices_sorted=indices_sorted, combine=combine)
 
     def fixpoint(x0, edge_src, edge_dst):
         if use_while:
+            if introspect:
+                def cond(state):
+                    x, prev_changed, i, trace = state
+                    return jnp.logical_and(prev_changed, i < num_iters)
+
+                def body(state):
+                    x, _, i, trace = state
+                    x1 = step(x, x0, edge_src, edge_dst)
+                    delta = jnp.sum(x1 != x).astype(jnp.int32)
+                    changed = delta > jnp.int32(0)
+                    if changed_reduce is not None:
+                        changed = changed_reduce(changed)
+                    return (x1, changed, i + 1, trace.at[i].set(delta))
+
+                x_final, _, i, trace = jax.lax.while_loop(
+                    cond, body, (x0, jnp.bool_(True), jnp.int32(0),
+                                 jnp.zeros((num_iters,), jnp.int32)))
+                return x_final, jnp.concatenate([i[None], trace])
+
             def cond(state):
                 x, prev_changed, i = state
                 return jnp.logical_and(prev_changed, i < num_iters)
@@ -201,6 +228,15 @@ def make_evaluate(prog: GraphProgram, num_iters: int, use_while: bool = True,
             x_final, _, _ = jax.lax.while_loop(
                 cond, body, (x0, jnp.bool_(True), jnp.int32(0)))
             return x_final
+
+        if introspect:
+            def body(x, _):
+                x1 = step(x, x0, edge_src, edge_dst)
+                return x1, jnp.sum(x1 != x).astype(jnp.int32)
+
+            x_final, deltas = jax.lax.scan(body, x0, None, length=num_iters)
+            return x_final, jnp.concatenate(
+                [jnp.full((1,), num_iters, jnp.int32), deltas])
 
         def body(x, _):
             return step(x, x0, edge_src, edge_dst), None
@@ -228,23 +264,34 @@ class KernelCache:
     invalidates the cache wholesale.
     """
 
+    # metric label for authz_sweep_iterations / authz_frontier_decay
+    kernel_name = "segment"
+
     def __init__(self, prog: GraphProgram, num_iters: Optional[int] = None,
                  use_while: bool = True, indices_sorted: bool = True):
         self.prog = prog
         self.num_iters = num_iters or MAX_ITERATIONS
         self._use_while = use_while
         self._indices_sorted = indices_sorted
+        # introspection resolved at jit-build time (KernelIntrospect
+        # gate): off, these are exactly the pre-introspection functions
+        intro = self._intro = workload.enabled()
         evaluate = make_evaluate(prog, self.num_iters, use_while=use_while,
-                                 indices_sorted=indices_sorted)
+                                 indices_sorted=indices_sorted,
+                                 introspect=intro)
 
         def run_checks(q_idx, gather_idx, gather_col, edge_src, edge_dst):
-            x = evaluate(q_idx, edge_src, edge_dst)
-            return x[gather_idx, gather_col] > 0
+            xe = evaluate(q_idx, edge_src, edge_dst)
+            x, tel = xe if intro else (xe, None)
+            out = x[gather_idx, gather_col] > 0
+            return (out, tel) if intro else out
 
         def run_lookup(slot_offset, slot_length, q_idx, edge_src, edge_dst):
-            x = evaluate(q_idx, edge_src, edge_dst)
-            return jax.lax.dynamic_slice_in_dim(
+            xe = evaluate(q_idx, edge_src, edge_dst)
+            x, tel = xe if intro else (xe, None)
+            out = jax.lax.dynamic_slice_in_dim(
                 x, slot_offset, slot_length, axis=0) > 0
+            return (out, tel) if intro else out
 
         # first-call-per-compile-key wrappers record each lazy XLA
         # compile as a `compile` slice on the dispatch timeline
@@ -276,26 +323,30 @@ class KernelCache:
             devtel.KERNELS.note_jit_hit(batch)
             return fns
         devtel.KERNELS.note_compile(batch)
+        intro = workload.enabled()
         evaluate = make_evaluate(self.prog, self.num_iters,
                                  use_while=self._use_while,
                                  indices_sorted=self._indices_sorted,
-                                 arena=True)
+                                 arena=True, introspect=intro)
 
         def run_checks3(q_idx, gather_idx, gather_col, state,
                         edge_src, edge_dst):
-            x = evaluate(state, q_idx, edge_src, edge_dst)
+            xe = evaluate(state, q_idx, edge_src, edge_dst)
+            x, tel = xe if intro else (xe, None)
             # tri-state {0, 2} encoding (the segment kernel has no MAYBE
             # plane) so every kernel hands the endpoint one value space
-            return (x[gather_idx, gather_col] > 0).astype(jnp.int32) * 2, x
+            out = (x[gather_idx, gather_col] > 0).astype(jnp.int32) * 2
+            return (out, x, tel) if intro else (out, x)
 
         def run_lookup_T(slot_offset, slot_length, q_idx, state,
                          edge_src, edge_dst):
-            x = evaluate(state, q_idx, edge_src, edge_dst)
+            xe = evaluate(state, q_idx, edge_src, edge_dst)
+            x, tel = xe if intro else (xe, None)
             sl = jax.lax.dynamic_slice_in_dim(
                 x, slot_offset, slot_length, axis=0) > 0
             # transpose ON DEVICE: the D2H lands [B, L] with one
             # contiguous row per query column
-            return sl.T, x
+            return (sl.T, x, tel) if intro else (sl.T, x)
 
         fns = (timeline.time_first_call(
                    jax.jit(run_checks3, donate_argnums=(3,)),
@@ -303,7 +354,8 @@ class KernelCache:
                timeline.time_first_call(
                    jax.jit(run_lookup_T, static_argnums=(0, 1),
                            donate_argnums=(3,)),
-                   bucket=batch, static_args=2, shape_args=True))
+                   bucket=batch, static_args=2, shape_args=True),
+               intro)
         self._jits[batch] = fns
         return fns
 
@@ -339,26 +391,32 @@ class KernelCache:
     # lint M003 flags host numpy materialization / per-item loops here)
     def checks3_device(self, q_idx: np.ndarray, gather_idx: np.ndarray,
                        gather_col: np.ndarray, edge_src, edge_dst):
-        """Dispatch-only tri-state checks ({0, 2}): un-materialized
-        device array; the caller owns the blocking readback."""
-        run_checks3, _ = self._pipe_fns(len(q_idx))
+        """Dispatch-only tri-state checks ({0, 2}): (out, tel) — the
+        un-materialized device result plus the sweep-trace device array
+        (None when KernelIntrospect was off at jit build); the caller
+        owns the blocking readback."""
+        run_checks3, _, intro = self._pipe_fns(len(q_idx))
         state = self.take_arena(len(q_idx))
-        out, x = run_checks3(jnp.asarray(q_idx), jnp.asarray(gather_idx),
-                             jnp.asarray(gather_col), state,
-                             edge_src, edge_dst)
+        res = run_checks3(jnp.asarray(q_idx), jnp.asarray(gather_idx),
+                          jnp.asarray(gather_col), state,
+                          edge_src, edge_dst)
+        out, x, tel = res if intro else (res[0], res[1], None)
         self.put_arena(len(q_idx), x)
-        return out
+        return out, tel
 
     def lookup_T_device(self, slot_offset: int, slot_length: int,
                         q_idx: np.ndarray, edge_src, edge_dst):
-        """Dispatch-only lookup, transposed on device: un-materialized
-        bool [B, slot_length] device array (row per query column)."""
-        _, run_lookup_T = self._pipe_fns(len(q_idx))
+        """Dispatch-only lookup, transposed on device: (out, tel) — out
+        the un-materialized bool [B, slot_length] device array (row per
+        query column), tel the sweep trace (None when KernelIntrospect
+        was off)."""
+        _, run_lookup_T, intro = self._pipe_fns(len(q_idx))
         state = self.take_arena(len(q_idx))
-        out, x = run_lookup_T(slot_offset, slot_length, jnp.asarray(q_idx),
-                              state, edge_src, edge_dst)
+        res = run_lookup_T(slot_offset, slot_length, jnp.asarray(q_idx),
+                           state, edge_src, edge_dst)
+        out, x, tel = res if intro else (res[0], res[1], None)
         self.put_arena(len(q_idx), x)
-        return out
+        return out, tel
     # hotpath: end
 
     # -- host-facing --------------------------------------------------------
@@ -366,12 +424,19 @@ class KernelCache:
     def checks(self, q_idx: np.ndarray, gather_idx: np.ndarray,
                gather_col: np.ndarray, edge_src, edge_dst) -> np.ndarray:
         """gather_idx/gather_col: per-check state index and query column."""
-        return np.asarray(self._checks(
-            jnp.asarray(q_idx), jnp.asarray(gather_idx),
-            jnp.asarray(gather_col), edge_src, edge_dst))
+        out = self._checks(jnp.asarray(q_idx), jnp.asarray(gather_idx),
+                           jnp.asarray(gather_col), edge_src, edge_dst)
+        if self._intro:
+            out, tel = out
+            workload.note_sweep("segment", "check", np.asarray(tel))
+        return np.asarray(out)
 
     def lookup(self, slot_offset: int, slot_length: int, q_idx: np.ndarray,
                edge_src, edge_dst) -> np.ndarray:
         """Returns bool [slot_length, B] allowed bitmap."""
-        return np.asarray(self._lookup(
-            slot_offset, slot_length, jnp.asarray(q_idx), edge_src, edge_dst))
+        out = self._lookup(slot_offset, slot_length, jnp.asarray(q_idx),
+                           edge_src, edge_dst)
+        if self._intro:
+            out, tel = out
+            workload.note_sweep("segment", "lookup", np.asarray(tel))
+        return np.asarray(out)

@@ -618,6 +618,17 @@ class ProxyServer:
                       "per-tier latency attribution, SLO burn roll-up "
                       "across --fleet-peers (docs/observability.md "
                       "\"Fleet tracing\")", self._debug_fleet),
+            "workload": ("per-(resource type, permission) cost "
+                         "attribution: device time, measured sweep "
+                         "depth, occupancy, cache hit rate, oracle "
+                         "fraction, Leopard-index candidates (docs/"
+                         "observability.md \"Workload attribution & "
+                         "profiling\")", self._debug_workload),
+            "profile": ("on-demand sampling profiler: ?seconds=N "
+                        "(capped) wall-clock stack capture across all "
+                        "threads, collapsed-stack + Perfetto output "
+                        "(docs/observability.md \"Workload attribution "
+                        "& profiling\")", self._debug_profile),
         }
         return surfaces
 
@@ -696,6 +707,7 @@ class ProxyServer:
         local = {"url": "local", "error": None,
                  "traces": self._debug_traces()["traces"],
                  "flight": self._debug_flight(),
+                 "workload": self._debug_workload(),
                  "skew_s": (self.replication.clock_skew_s()
                             if self.replication is not None else None),
                  "lag_s": (self.replication.lag_seconds()
@@ -705,6 +717,35 @@ class ProxyServer:
         merged["tier"] = self._tier
         return merged
     _debug_fleet._wants_request = True
+
+    def _debug_workload(self) -> dict:
+        from ..utils import workload
+        if not workload.enabled():
+            return {"enabled": False,
+                    "reason": "KernelIntrospect feature gate disabled"}
+        return dict(workload.WORKLOAD.payload(), enabled=True)
+
+    async def _debug_profile(self, req: Request) -> dict:
+        from urllib.parse import parse_qs, urlsplit
+
+        from ..utils import profiler
+        if not profiler.enabled():
+            return {"enabled": False,
+                    "reason": "Profiler feature gate disabled"}
+        q = parse_qs(urlsplit(req.target).query)
+        try:
+            seconds = float((q.get("seconds") or ["1"])[0])
+        except ValueError:
+            seconds = 1.0
+        try:
+            # blocking capture on a worker thread: the event loop —
+            # usually the most interesting thread — keeps serving and
+            # gets sampled doing real work
+            out = await asyncio.to_thread(profiler.capture, seconds)
+        except profiler.ProfilerBusy as e:
+            return {"enabled": True, "error": str(e)}
+        return dict(out, enabled=True)
+    _debug_profile._wants_request = True
 
     def _debug_decisions(self) -> dict:
         return {"level": self.audit.level,

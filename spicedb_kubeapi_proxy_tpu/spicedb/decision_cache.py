@@ -54,7 +54,7 @@ from collections import OrderedDict
 from typing import Iterable
 
 from ..ops.graph_compile import relation_footprint
-from ..utils import tracing
+from ..utils import tracing, workload
 from .endpoints import PermissionsEndpoint
 from .store import Watcher
 from .types import (
@@ -328,10 +328,13 @@ class DecisionCacheEndpoint(PermissionsEndpoint):  # noqa: A004(built behind gat
         miss_rows: list = []
         tokens: dict = {}  # row -> (key, token)
         hits = 0
+        pair_stats: dict = {}  # (type, permission) -> [hits, misses]
         with tracing.span("cache_lookup", phase=True, verb="check") as attrs:
             for i, r in enumerate(reqs):
                 key = ("chk", r.resource.type, r.resource.id,
                        r.permission, r.subject)
+                st = pair_stats.setdefault(
+                    (r.resource.type, r.permission), [0, 0])
                 cached = self.cache.get(key, now)
                 if cached is not _MISS:
                     perm, at = cached
@@ -339,12 +342,16 @@ class DecisionCacheEndpoint(PermissionsEndpoint):  # noqa: A004(built behind gat
                                              checked_at=at,
                                              source=SOURCE_CACHE)
                     hits += 1
+                    st[0] += 1
                     continue
                 fp = self._footprint(r.resource.type, r.permission)
                 tokens[i] = (key, self.cache.snapshot_epochs(fp, now))
                 miss_rows.append(i)
+                st[1] += 1
             attrs["hits"] = hits
             attrs["misses"] = len(miss_rows)
+        for (rt, p), (h, ms) in pair_stats.items():
+            workload.WORKLOAD.note_cache(rt, p, h, ms)
         if hits:
             self._hits.inc(hits, verb="check")
         if miss_rows:
@@ -390,6 +397,8 @@ class DecisionCacheEndpoint(PermissionsEndpoint):  # noqa: A004(built behind gat
                 miss_rows.append(i)
             attrs["hits"] = hits
             attrs["misses"] = len(miss_rows)
+        workload.WORKLOAD.note_cache(resource_type, permission, hits,
+                                     len(miss_rows))
         if hits:
             self._hits.inc(hits, verb="lookup")
         if miss_rows:

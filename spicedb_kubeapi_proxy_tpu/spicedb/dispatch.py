@@ -32,6 +32,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import contextlib
+import os
 import time
 from typing import Iterable, Optional
 
@@ -46,6 +47,15 @@ from .types import (
     RelationshipUpdate,
     SubjectRef,
 )
+
+
+# regression-sentinel proof hook (scripts/check.sh): a per-drain sleep
+# armed via env var injects a deterministic slowdown into the dispatch
+# hot loop so the benchdiff gate can be shown to catch one.  Read once
+# at import; 0 in any real deployment.
+_BENCHDIFF_INJECT_S = (
+    float(os.environ.get("SPICEDB_TPU_BENCHDIFF_INJECT_MS", "0") or 0)
+    / 1e3)
 
 
 def _trace_ctx() -> Optional[dict]:
@@ -283,6 +293,8 @@ class BatchingEndpoint(PermissionsEndpoint):
         try:
             while self._check_queue or self._lr_queue or pending:
                 fail_point("dispatchDrain")
+                if _BENCHDIFF_INJECT_S > 0:
+                    await asyncio.sleep(_BENCHDIFF_INJECT_S)
                 self._stats["drains"] += 1
                 # alternate which queue goes first each iteration so
                 # sustained traffic on one verb cannot push the other

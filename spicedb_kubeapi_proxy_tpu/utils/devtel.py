@@ -463,6 +463,13 @@ def next_generation() -> int:
 def note_kernel_span(name: str, attrs: dict, seconds: float) -> None:
     """Hook target for tracing.kernel_span (lazy-bound there)."""
     KERNELS.note_kernel_span(name, attrs, seconds)
+    comp = attrs.get("workload")
+    if comp:
+        # same wall-clock seconds that feed authz_kernel_time_seconds —
+        # /debug/workload reconciles against that cumulative sum by
+        # construction (utils/workload.py splits by row share)
+        from . import workload
+        workload.note_device_time(comp, name, seconds)
 
 
 def snapshot() -> dict:

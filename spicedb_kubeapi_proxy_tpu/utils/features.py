@@ -139,6 +139,23 @@ GATES.register("FuzzTelemetry", stage=ALPHA, default=True)
 # behavior exactly), the router degrades to a pass-through to the
 # default shard, and the authz_shard_* metrics tick nothing.
 GATES.register("Sharding", stage=ALPHA, default=True)
+# kernel introspection & workload cost attribution (ops/ell.py,
+# ops/spmv.py, utils/workload.py): measured sweep-iteration counters and
+# per-iteration frontier-population traces threaded through the fixpoint
+# carry, read back with the existing result D2H; feeds
+# authz_sweep_iterations / authz_frontier_decay and the per-(type,
+# permission) /debug/workload attribution rows, and upgrades the
+# timeline roofline from modeled one-sweep bytes to measured
+# iterations x per-sweep bytes.  This gate is the killswitch: off, the
+# kernels build exactly the pre-introspection jitted functions
+# (byte-identical carry shape), no sweep metrics tick, and the roofline
+# keeps its modeled lower-bound semantics.
+GATES.register("KernelIntrospect", stage=BETA, default=True)
+# on-demand sampling profiler (utils/profiler.py): authed
+# /debug/profile?seconds=N thread sampler with collapsed-stack and
+# chrome-trace output.  This gate is the killswitch: off, capture
+# requests are refused and the sampler thread never starts.
+GATES.register("Profiler", stage=ALPHA, default=True)
 
 
 def pipeline_enabled() -> bool:

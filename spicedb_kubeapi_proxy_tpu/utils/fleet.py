@@ -45,7 +45,8 @@ _LAG_RE = re.compile(
     re.MULTILINE)
 
 # paths the fan-out scrapes per member
-MEMBER_PATHS = ("/debug/traces", "/debug/flight", "/metrics")
+MEMBER_PATHS = ("/debug/traces", "/debug/flight", "/debug/workload",
+                "/metrics")
 
 
 def parse_metric(text: str, pattern: re.Pattern) -> Optional[float]:
@@ -66,7 +67,7 @@ async def fetch_member(url: str, headers: Iterable = (),
     from ..proxy.httpcore import H11Transport, Headers, Request
     from . import tracing
     member = {"url": url, "error": None, "traces": [], "flight": {},
-              "skew_s": None, "lag_s": None}
+              "workload": {}, "skew_s": None, "lag_s": None}
     t = transport if transport is not None else H11Transport(url)
     for path in MEMBER_PATHS:
         h = Headers(list(headers))
@@ -102,6 +103,8 @@ async def fetch_member(url: str, headers: Iterable = (),
             break
         if path == "/debug/traces":
             member["traces"] = list(payload.get("traces") or [])
+        elif path == "/debug/workload":
+            member["workload"] = payload
         else:
             member["flight"] = payload
     return member
@@ -317,10 +320,59 @@ def merged_chrome_trace(assembled: list) -> dict:
                           "tracks": len(tracks)}}
 
 
+def merge_workload(members: list) -> dict:
+    """Fleet-wide workload roll-up (pure): per-(type, permission) rows
+    summed across members, Leopard candidates deduped keeping each
+    pair's deepest observation (tagged with the member that saw it)."""
+    rows: dict = {}
+    candidates: dict = {}
+    total = attributed = 0.0
+    reporting = 0
+    for m in members:
+        wl = m.get("workload") or {}
+        if not wl or wl.get("enabled") is False or "rows" not in wl:
+            continue
+        reporting += 1
+        total += float(wl.get("total_device_s") or 0.0)
+        attributed += float(wl.get("attributed_device_s") or 0.0)
+        for r in wl.get("rows") or []:
+            key = (str(r.get("resource_type")), str(r.get("permission")))
+            agg = rows.setdefault(key, {
+                "device_s": 0.0, "kernel_rows": 0, "oracle_rows": 0,
+                "cache_hits": 0, "cache_misses": 0})
+            agg["device_s"] += float(r.get("device_s") or 0.0)
+            for f in ("kernel_rows", "oracle_rows", "cache_hits",
+                      "cache_misses"):
+                agg[f] += int(r.get(f) or 0)
+        for c in wl.get("leopard_candidates") or []:
+            key = (str(c.get("resource_type")), str(c.get("permission")))
+            cur = candidates.get(key)
+            if (cur is None or (c.get("mean_sweep_depth") or 0)
+                    > (cur.get("mean_sweep_depth") or 0)):
+                candidates[key] = dict(c, url=m.get("url", ""))
+    out_rows = []
+    for (t, p), agg in rows.items():
+        row = {"resource_type": t, "permission": p}
+        row.update(agg)
+        row["device_s"] = round(agg["device_s"], 6)
+        out_rows.append(row)
+    out_rows.sort(key=lambda r: -r["device_s"])
+    return {
+        "members_reporting": reporting,
+        "rows": out_rows,
+        "total_device_s": round(total, 6),
+        "attributed_device_s": round(attributed, 6),
+        "leopard_candidates": sorted(
+            candidates.values(),
+            key=lambda c: -(c.get("mean_sweep_depth") or 0)),
+    }
+
+
 def merge_fleet(members: list) -> dict:
     """The /debug/fleet payload: assembled cross-process traces (multi-
     process trace ids only), ONE merged chrome-trace, per-tier p50/p99
-    attribution, SLO burn roll-up, and per-member skew/lag/errors."""
+    attribution, fleet workload roll-up, SLO burn roll-up, and
+    per-member skew/lag/errors."""
     by_trace = _segments_by_trace(members)
     assembled = [assemble_trace(segs)
                  for _tid, segs in sorted(by_trace.items())
@@ -352,5 +404,6 @@ def merge_fleet(members: list) -> dict:
         "traces": assembled,
         "chrome_trace": merged_chrome_trace(assembled),
         "tiers": tier_stats,
+        "workload": merge_workload(members),
         "slo_burning": burning,
     }

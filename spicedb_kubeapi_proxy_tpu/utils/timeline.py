@@ -50,10 +50,15 @@ one gate check and `span()` returns a shared module-level null context
 — no event objects, no ring writes, no counter updates (asserted by
 tests/test_timeline.py).
 
-Kernel-stage bytes are a *modeled lower bound* (one fixpoint sweep's
-gather traffic; executed iterations are not host-visible per call), so
-`authz_roofline_fraction` under-reports true achieved bandwidth — fine
-for a before/after instrument, wrong for absolute marketing numbers.
+Kernel-stage bytes are **measured** when KernelIntrospect is on: the
+kernels thread an iteration counter through the sweep and the byte tag
+becomes `iterations x one-sweep gather traffic` (utils/workload.py).
+With the gate off — and on paths that cannot read telemetry back
+(sharded mesh, pre-first-readback) — the tag falls back to the
+*modeled lower bound* of one fixpoint sweep, so
+`authz_roofline_fraction` under-reports true achieved bandwidth there.
+`summary()["kernel_bytes_basis"]` says which basis
+(measured/modeled/mixed) the window's roofline number rests on.
 
 Thread-safe: events are recorded from asyncio handlers and executor
 threads concurrently.
@@ -377,14 +382,16 @@ class Timeline:
         self._bw = registry.gauge(
             "authz_dispatch_bandwidth_bytes_per_sec",
             "Achieved bytes/sec of the most recent dispatch-pipeline "
-            "event per stage (kernel bytes are a modeled one-sweep "
-            "lower bound)",
+            "event per stage (kernel bytes are measured iterations x "
+            "one-sweep traffic with KernelIntrospect on, else a "
+            "modeled one-sweep lower bound)",
             labels=("stage",))
         self._roofline = registry.gauge(
             "authz_roofline_fraction",
-            "Most recent kernel dispatch's modeled achieved HBM "
-            "bandwidth as a fraction of the configured device peak "
-            "(--device-hbm-peak-gbps; 0 = peak unknown or no dispatch)")
+            "Most recent kernel dispatch's achieved HBM bandwidth as a "
+            "fraction of the configured device peak (byte basis: "
+            "measured sweep telemetry when KernelIntrospect is on, else "
+            "modeled one-sweep floor; 0 = peak unknown or no dispatch)")
         registry.gauge(
             "authz_dispatch_overlap_ratio",
             "Transfer/compute overlap ratio over the recent timeline "
@@ -578,6 +585,7 @@ class Timeline:
         bw_agg: dict = {}     # bandwidth-stage -> [seconds, bytes]
         by_batch: dict = {}   # batch -> {stage: seconds}
         stalls: dict = {}
+        basis_bytes = [0, 0]  # [measured, modeled] kernel bytes
         for e in evs:
             agg = by_stage.setdefault(e.stage, [0.0, 0, 0])
             agg[0] += e.duration
@@ -591,6 +599,11 @@ class Timeline:
                 b = bw_agg.setdefault(e.stage, [0.0, 0])
                 b[0] += e.duration
                 b[1] += e.nbytes
+                if e.stage in _COMPUTE_STAGES:
+                    if e.attrs and e.attrs.get("measured"):
+                        basis_bytes[0] += e.nbytes
+                    else:
+                        basis_bytes[1] += e.nbytes
             cause = _STALL_CAUSE.get(e.stage)
             if cause is not None:
                 stalls[cause] = stalls.get(cause, 0.0) + e.duration
@@ -608,6 +621,18 @@ class Timeline:
         # scale (CPU backend, modeled lower bound) and must not read 0.0
         roofline = (round(k_bytes / k_secs / peak, 12)
                     if k_secs > 0 and k_bytes and peak else None)
+        # roofline honesty label: "measured" when every kernel byte tag
+        # came from sweep telemetry (iterations x per-sweep bytes),
+        # "modeled" when all are the one-sweep lower bound (gate off,
+        # sharded path, or pre-readback), "mixed" otherwise
+        if basis_bytes[0] and basis_bytes[1]:
+            bytes_basis = "mixed"
+        elif basis_bytes[0]:
+            bytes_basis = "measured"
+        elif basis_bytes[1]:
+            bytes_basis = "modeled"
+        else:
+            bytes_basis = None
         worst = None
         if by_batch:
             wid, stages = max(by_batch.items(),
@@ -635,6 +660,7 @@ class Timeline:
             "overlap": ov,
             "overlap_ratio": ov["ratio"] if ov else None,
             "roofline_fraction": roofline,
+            "kernel_bytes_basis": bytes_basis,
             "hbm_peak_gbps": round(peak / 1e9, 1) if peak else None,
             "bandwidth_bytes_per_s": bandwidth,
             "stall_s": {c: round(v, 6) for c, v in sorted(stalls.items())},
@@ -746,7 +772,12 @@ def note_kernel_span(name: str, attrs: dict, start: float,
     stage = attrs.get("timeline_stage") or _KERNEL_SPAN_STAGES.get(name)
     if stage is None:
         return
+    extra = {}
+    if attrs.get("measured"):
+        # byte tag upgraded from the modeled one-sweep floor to measured
+        # iterations x per-sweep bytes (KernelIntrospect sweep telemetry)
+        extra["measured"] = True
     TIMELINE.record(stage, "device", start, end,
                     batch=attrs.get("batch_id"),
                     bucket=attrs.get("bucket") or None,
-                    nbytes=int(attrs.get("nbytes", 0) or 0))
+                    nbytes=int(attrs.get("nbytes", 0) or 0), **extra)

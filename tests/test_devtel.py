@@ -491,7 +491,8 @@ class TestDebugSurfaces:
             assert set(surfaces) == {"/debug/traces", "/debug/decisions",
                                      "/debug/flight", "/debug/timeline",
                                      "/debug/replication",
-                                     "/debug/sharding", "/debug/fleet"}
+                                     "/debug/sharding", "/debug/fleet",
+                                     "/debug/workload", "/debug/profile"}
             for desc in surfaces.values():
                 assert isinstance(desc, str) and desc
         run(go())
@@ -513,7 +514,8 @@ class TestDebugSurfaces:
 
         async def go():
             for path in ("/debug", "/debug/traces", "/debug/decisions",
-                         "/debug/flight", "/debug/timeline"):
+                         "/debug/flight", "/debug/timeline",
+                         "/debug/workload", "/debug/profile"):
                 resp = await anon.get(path)
                 assert resp.status == 401, path
         run(go())

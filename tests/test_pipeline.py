@@ -104,11 +104,11 @@ class TestDevicePackParity:
             # lookup: serial [L, W/B] then host .T  vs  device-transposed
             if packed:
                 host = g.run_lookup_packed(off, length, q, snap=snap)
-                dev, _ = g.run_lookup_packed_T_device(off, length, q,
-                                                      snap=snap)
+                dev, _, _ = g.run_lookup_packed_T_device(off, length, q,
+                                                         snap=snap)
             else:
                 host = g.run_lookup(off, length, q, snap=snap)
-                dev, _ = g.run_lookup_T_device(off, length, q, snap=snap)
+                dev, _, _ = g.run_lookup_T_device(off, length, q, snap=snap)
             np.testing.assert_array_equal(np.asarray(dev), host.T,
                                           err_msg=f"lanes={lanes}")
             # checks: serial host split of col -> (word, bit) vs on-device
@@ -117,7 +117,7 @@ class TestDevicePackParity:
                                 dtype=np.int32)
             gcol = rng.integers(0, lanes, n_gather, dtype=np.int32)
             serial = g.run_checks3(q, gidx, gcol, snap=snap)
-            dev, _ = g.run_checks3_device(q, gidx, gcol, snap=snap)
+            dev, _, _ = g.run_checks3_device(q, gidx, gcol, snap=snap)
             np.testing.assert_array_equal(
                 np.asarray(dev)[: len(serial)].astype(np.int64),
                 np.asarray(serial).astype(np.int64),
