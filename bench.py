@@ -2128,6 +2128,127 @@ definition doc {
             "batch": batch, "tuples": len(rels), "configs": configs}
 
 
+def bench_mesh_scale(args) -> dict:
+    """Multi-chip mesh scaling, MEASURED (not projected) on the local
+    device set: the same depth-4 workload served by the single-chip
+    kernels and by sharded 1x1 / 1x2 / 1x4 (data x graph) meshes, with
+    rounds interleaved across all modes so allocator and load drift
+    land on every mode equally.  Reports per-mode lookup-round medians
+    and paired scaling ratios vs the single-chip baseline, the
+    per-device HBM ledger rows each mesh registered, and the pipelined
+    dispatch overlap measured on the largest sharded graph.  On a CPU
+    host (forced virtual devices) the numbers measure STRUCTURAL
+    scaling — partition, collective, and dispatch overheads are real,
+    FLOPS scaling is not; on a TPU slice the same config measures the
+    physical thing.  Needs >= 2 devices; single-device hosts record a
+    skip marker instead of projecting."""
+    import asyncio
+
+    import jax
+
+    from spicedb_kubeapi_proxy_tpu.models import workloads as wl
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+    from spicedb_kubeapi_proxy_tpu.parallel.sharding import make_mesh
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.dispatch import BatchingEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import SubjectRef
+    from spicedb_kubeapi_proxy_tpu.utils import devtel
+
+    devices = jax.devices()
+    sizes = [n for n in (1, 2, 4) if n <= len(devices)]
+    if len(devices) < 2:
+        return {"skipped": True,
+                "reason": f"mesh-scale needs >= 2 devices, have "
+                          f"{len(devices)} (force a virtual mesh with "
+                          f"XLA_FLAGS=--xla_force_host_platform_device_"
+                          f"count=N)"}
+    workload = wl.nested_groups(n_pods=4_000, n_users=1_000, n_groups=120,
+                                n_teams=24, n_namespaces=60)
+    schema = sch.parse_schema(workload.schema_text)
+    batch = args.batch
+    rounds = max(3, args.rounds // 2)
+    subjects = workload.subjects
+    modes = [("single", None)] + [(f"mesh-1x{n}", n) for n in sizes]
+
+    def batch_subjects(r):
+        return [SubjectRef("user", subjects[(r * batch + i) % len(subjects)])
+                for i in range(batch)]
+
+    async def one_round(ep, r):
+        t0 = time.perf_counter()
+        await ep.lookup_resources_batch(
+            workload.resource_type, workload.permission, batch_subjects(r))
+        return time.perf_counter() - t0
+
+    eps: dict = {}
+    shard_rows: dict = {}
+    acc = {name: [] for name, _n in modes}
+    for name, n in modes:
+        stage(f"mesh-scale build + load + warm ({name})")
+        before = dict(devtel.LEDGER.device_totals())
+        mesh = (make_mesh(devices[:n], data=1, graph=n)
+                if n is not None else None)
+        ep = JaxEndpoint(schema, kernel="ell", mesh=mesh)
+        ep.store.bulk_load_text("\n".join(workload.relationships))
+        asyncio.run(one_round(ep, 0))  # warm: build + compiles + arenas
+        after = devtel.LEDGER.device_totals()
+        shard_rows[name] = {
+            f"{kind}:d{dev}": b - before.get((kind, dev), 0)
+            for (kind, dev), b in sorted(after.items())
+            if b - before.get((kind, dev), 0) > 0}
+        eps[name] = ep
+
+    stage("mesh-scale interleaved rounds")
+    for r in range(rounds):
+        for name, _n in modes:
+            acc[name].append(asyncio.run(one_round(eps[name], r + 1)))
+
+    n_obj = len(eps["single"].store.object_ids_of_type(
+        workload.resource_type))
+    out: dict = {"devices": len(devices), "batch": batch, "rounds": rounds,
+                 "basis": "measured", "modes": {}, "scaling": {}}
+    single_med = statistics.median(acc["single"])
+    for name, _n in modes:
+        med = statistics.median(acc[name])
+        out["modes"][name] = {
+            "per_round_ms": round(med * 1e3, 2),
+            "p99_ms": round(p99(acc[name]) * 1e3, 2),
+            "checks_per_s": round(batch * n_obj / med, 1),
+            "device_shard_bytes": shard_rows[name],
+        }
+        if name != "single":
+            out["scaling"][name] = round(single_med / med, 3)
+
+    # pipelined dispatch on the largest sharded graph: concurrent
+    # per-subject lists must fan into overlapping fused batches (the
+    # acceptance is overlap > 0 — the sharded kernels keep the PR 7
+    # pipelined drain instead of degrading to serial dispatch)
+    big = f"mesh-1x{sizes[-1]}"
+    bep = BatchingEndpoint(eps[big], max_batch=max(8, batch // 8),
+                           pipeline_depth=2)
+
+    async def wave(r):
+        await asyncio.gather(*[
+            bep.lookup_resources(
+                workload.resource_type, workload.permission,
+                SubjectRef("user", subjects[(r + i) % len(subjects)]))
+            for i in range(batch)])
+
+    overlap = None
+    for attempt in range(6):
+        mark = timeline_mark()
+        asyncio.run(wave(attempt))
+        tl = timeline_summary(mark) or {}
+        overlap = tl.get("overlap_ratio")
+        if overlap:
+            break
+    out["pipelined_overlap_ratio"] = overlap
+    out["mesh_scaling"] = out["scaling"].get(big, 0.0)
+    log(f"mesh-scale: {out['scaling']} vs single-chip "
+        f"(basis=measured, overlap={overlap})")
+    return out
+
+
 SCENARIO_CONFIGS = {
     "caveat-heavy": bench_scenario_caveat_heavy,
     "wildcard-public": bench_scenario_wildcard_public,
@@ -2156,6 +2277,12 @@ REPLICATION_CONFIGS = {
 # partitioned write scale-out (ISSUE 15): same contract
 SHARDING_CONFIGS = {
     "write-shard-scale": bench_write_shard_scale,
+}
+
+# multi-chip mesh execution (ISSUE 18): measured shard_map scaling on
+# the local device set (virtual CPU mesh in CI, physical on TPU)
+MESH_CONFIGS = {
+    "mesh-scale": bench_mesh_scale,
 }
 
 # decision-cache bench configs (ISSUE 3): run standalone via --config or
@@ -2195,6 +2322,7 @@ def _config_registry() -> dict:
         "device pipeline": list(PIPELINE_CONFIGS),
         "replication": list(REPLICATION_CONFIGS),
         "write sharding": list(SHARDING_CONFIGS),
+        "multi-chip mesh": list(MESH_CONFIGS),
         "scenario matrix": list(SCENARIO_CONFIGS),
         "observability": list(OBS_CONFIGS),
     }
@@ -2425,6 +2553,24 @@ def main() -> None:
               "baseline": "single shard-leader aggregate dual-write "
                           "throughput (same churn profile, same "
                           "per-process core budget)",
+              **res})
+        return
+
+    if args.config in MESH_CONFIGS:
+        # standalone mesh config: largest-mesh paired scaling vs the
+        # single-chip kernels is the headline (measured, not projected)
+        stage(f"mesh config {args.config}")
+        tel_before = devtel_snapshot()
+        res = MESH_CONFIGS[args.config](args)
+        tel = devtel_delta(tel_before)
+        if tel:
+            res["device_telemetry"] = tel
+        _STATE["metric"] = f"mesh {args.config}"
+        emit({"metric": _STATE["metric"],
+              "value": res.get("mesh_scaling", 0.0), "unit": "x",
+              "platform": _STATE["platform"],
+              "baseline": "single-chip ell kernels over the same store "
+                          "(interleaved rounds, same batches)",
               **res})
         return
 
@@ -2661,7 +2807,8 @@ def main() -> None:
         # restart time-to-serve + WAL write-overhead columns)
         for name, fn in {**CACHE_CONFIGS, **PERSIST_CONFIGS,
                          **PIPELINE_CONFIGS, **REPLICATION_CONFIGS,
-                         **SHARDING_CONFIGS, **SCENARIO_CONFIGS}.items():
+                         **SHARDING_CONFIGS, **MESH_CONFIGS,
+                         **SCENARIO_CONFIGS}.items():
             try:
                 tel_before = devtel_snapshot()
                 tl_mark = timeline_mark()

@@ -37,6 +37,9 @@ ALLOWED_METRIC_LABELS = frozenset((
     # sweep telemetry: which fixpoint kernel produced the measurement
     # (ell | segment — bounded by the code, not by traffic)
     "kernel",
+    # per-shard HBM accounting: owning device id of a sharded mesh
+    # buffer (bounded by the local device count, not by traffic)
+    "device",
 ))
 _METRIC_FACTORIES = ("counter", "gauge", "histogram")
 _M001_PREFIX = "spicedb_kubeapi_proxy_tpu"

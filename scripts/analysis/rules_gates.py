@@ -49,6 +49,11 @@ GATED_MODULES = (
     # killswitch because a blocking capture is a heavier hammer
     ("utils/workload.py", "KernelIntrospect"),
     ("utils/profiler.py", "Profiler"),
+    # multi-chip mesh execution: the sharded kernel module rides the
+    # MeshExecution killswitch (compat.py/distributed.py are pure
+    # resolution/runtime glue with no subsystem state to gate; the
+    # endpoint checks the gate at mesh construction)
+    ("parallel/sharding.py", "MeshExecution"),
 )
 
 _MUTATOR_METHODS = ("inc", "observe", "dec")

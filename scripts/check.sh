@@ -50,7 +50,8 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_decision_cache.py -q \
     -p no:cacheprovider -k "coherence or Footprint or Invalidation"
 
 echo "== differential fuzz smoke (25 fixed seeds x 3 gate combos x 3"
-echo "   replication roles + 2 sharded2 router cells, jax:// vs oracle)"
+echo "   replication roles + 2 sharded2 router cells + 2 mesh cells,"
+echo "   jax:// vs oracle)"
 # seeded, deterministic, time-boxed (docs/fuzzing.md): random schemas +
 # random delta streams replayed against the device kernels AND the
 # recursive oracle at pinned revisions, as leader / 2-hop follower
@@ -125,6 +126,16 @@ JAX_PLATFORMS=cpu python scripts/soak.py 24 --churn --graph small \
 
 echo "== multi-chip dryrun (8-device virtual mesh + single-chip entry)"
 JAX_PLATFORMS=cpu python __graft_entry__.py 8
+
+echo "== multi-chip mesh smoke (proxy on jax://?mesh=1x2, oracle parity)"
+# the sharded shard_map path end to end (docs/performance.md
+# "Multi-chip mesh"): the server boots a 1x2 (data x graph) mesh over
+# forced virtual CPU devices, a filtered LIST through the full proxy
+# stack matches the embedded host oracle before and after write churn
+# (no full rebuild), and /metrics carries one
+# authz_device_shard_bytes{kind,device} ledger row per mesh device.
+# Runs even with --fast.
+python scripts/mesh_smoke.py
 
 if [[ "${1:-}" != "--fast" ]]; then
   echo "== bench smoke (pods-depth1, CPU)"

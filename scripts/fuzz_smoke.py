@@ -1,12 +1,15 @@
 #!/usr/bin/env python
 """Differential fuzz smoke: the fixed-seed gate x replication-role matrix.
 
-check.sh mode (default): replays 27 FIXED seeds — 25 mapped onto the
+check.sh mode (default): replays 29 FIXED seeds — 25 mapped onto the
 3 gate-combos x 3 replication-roles matrix (every cell covered >= 2x
 across the set; kernels alternate ell/segment), plus 2 `sharded2`
 cells replaying through a router over TWO partition leaders
 (spicedb/sharding, schema-derived co-location-valid map, off/full
-gates) — asserting ZERO jax://-vs-oracle divergences.  Deterministic: schemas, delta
+gates), plus 2 `mesh` cells replaying on a 2x2 virtual-device mesh
+endpoint differentially checked against a single-device endpoint
+(parallel/sharding.py, off/full gates) — asserting ZERO
+jax://-vs-oracle divergences.  Deterministic: schemas, delta
 streams, clocks, and queries all derive from the seed; wall time is the
 only thing that varies.  A divergence shrinks to a self-contained repro
 artifact (docs/fuzzing.md) and fails the run with its path + seed line.
@@ -53,9 +56,12 @@ _XLA_CACHE_DIR = os.environ.get("FUZZ_XLA_CACHE",
 if os.environ.get("_FUZZ_SMOKE_REEXEC") != "1":
     # compile-speed flags must be in place before the interpreter (or
     # any sitecustomize) initializes a jax backend — re-exec with them
+    # the forced host device count gives the `mesh` cells their 2x2
+    # virtual mesh (a no-op for every other cell)
     env = dict(os.environ, _FUZZ_SMOKE_REEXEC="1", JAX_PLATFORMS="cpu",
                XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
-                          + " --xla_backend_optimization_level=0"))
+                          + " --xla_backend_optimization_level=0"
+                          + " --xla_force_host_platform_device_count=8"))
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 ARTIFACT_DIR = os.environ.get("FUZZ_ARTIFACT_DIR", "/tmp/authz_fuzz")
@@ -141,27 +147,37 @@ def run_fixed_set(n_seeds: int, workers: int, time_box: float) -> int:
     # matrix-coverage tripwire (a real error path, not an assert: it
     # must survive python -O and scale with --seeds).  The expectation
     # is INDEPENDENT of smoke_cell_for — derived from the documented
-    # walk (seeds 0..24 = classic 3x3 matrix, >= 25 = sharded2 cells
-    # alternating off/full) — so a regression in the seed->cell map
-    # itself trips here instead of validating its own output.
+    # walk (seeds 0..24 = classic 3x3 matrix, 25..26 = sharded2 cells
+    # alternating off/full, >= 27 = mesh cells alternating off/full) —
+    # so a regression in the seed->cell map itself trips here instead
+    # of validating its own output.
     n_classic = min(n_seeds, 25)
-    n_sharded = max(0, n_seeds - 25)
+    n_sharded = min(max(0, n_seeds - 25), 2)
+    n_mesh = max(0, n_seeds - 27)
     classic_hit = {c: v for c, v in cells_hit.items()
-                   if c[1] != "sharded2"}
+                   if c[1] not in ("sharded2", "mesh")}
     sharded_hit = {c: v for c, v in cells_hit.items()
                    if c[1] == "sharded2"}
+    mesh_hit = {c: v for c, v in cells_hit.items()
+                if c[1] == "mesh"}
     want_sharded = {k: v for k, v in (
         (("off", "sharded2"), (n_sharded + 1) // 2),
         (("full", "sharded2"), n_sharded // 2)) if v}
+    want_mesh = {k: v for k, v in (
+        (("off", "mesh"), (n_mesh + 1) // 2),
+        (("full", "mesh"), n_mesh // 2)) if v}
     if (len(classic_hit) != min(9, n_classic)
             or sum(classic_hit.values()) != n_classic
             or any(v < max(1, n_classic // 9)
                    for v in classic_hit.values())
-            or sharded_hit != want_sharded):
+            or sharded_hit != want_sharded
+            or mesh_hit != want_mesh):
         print(f"fuzz smoke: matrix coverage hole at --seeds {n_seeds}: "
-              f"classic {dict(classic_hit)}, sharded {dict(sharded_hit)} "
+              f"classic {dict(classic_hit)}, sharded {dict(sharded_hit)}, "
+              f"mesh {dict(mesh_hit)} "
               f"(want {min(9, n_classic)} classic cells x >= "
-              f"{max(1, n_classic // 9)}, sharded {dict(want_sharded)})")
+              f"{max(1, n_classic // 9)}, sharded {dict(want_sharded)}, "
+              f"mesh {dict(want_mesh)})")
         return 1
     if failed:
         for res in failed:
@@ -172,8 +188,8 @@ def run_fixed_set(n_seeds: int, workers: int, time_box: float) -> int:
               f"in {elapsed:.1f}s")
         return 1
     print(f"fuzz smoke: {n_seeds} seeds x 3 gate combos x 3 replication "
-          f"roles (+ {n_sharded} sharded2 router cells) AGREE in "
-          f"{elapsed:.1f}s")
+          f"roles (+ {n_sharded} sharded2 router cells, + {n_mesh} mesh "
+          f"cells) AGREE in {elapsed:.1f}s")
     if elapsed > time_box:
         print(f"fuzz smoke: exceeded the {time_box:.0f}s time box")
         return 1
@@ -202,6 +218,8 @@ def run_budgeted(budget_s: float, start_seed: int, scenario: str = "") -> int:
         gates = tuple(GATE_COMBOS)[seed % 3]
         role = ALL_ROLES[(seed // 3) % len(ALL_ROLES)]
         kernel = SMOKE_KERNELS[(seed // 9) % 2]
+        if role == "mesh":
+            kernel = "ell"  # the mesh path requires the ell kernel
         case = build_case(seed, kernel=kernel, **bias_kw)
         divs = run_case(case, gates=gates, role=role, checkpoints="every",
                         stop_on_first=True)
@@ -269,10 +287,11 @@ def run_mutation_check(name: str, n_seeds: int) -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--seeds", type=int, default=27,
+    ap.add_argument("--seeds", type=int, default=29,
                     help="seeds 0..24 walk the classic 3x3 gate x role "
-                         "matrix; seeds 25+ are the appended sharded2 "
-                         "(2-partition-leader router) cells")
+                         "matrix; seeds 25..26 are the appended sharded2 "
+                         "(2-partition-leader router) cells; seeds 27+ "
+                         "are the mesh (2x2 virtual-device) cells")
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--time-box", type=float, default=90.0,
                     help="hard wall-clock bound for the fixed set "

@@ -23,7 +23,14 @@ endpoint built over the store a REPLICATION ROLE produces:
                 mirror store of the full stream — the per-shard device
                 graphs must answer exactly like the whole-store oracle
                 (the footprint co-location proof, exercised end to
-                end).
+                end);
+- `mesh`       a single leader store served by a THREE-way differential:
+                a multi-chip mesh endpoint (2x2 virtual-device
+                shard_map kernels, parallel/sharding.py) and a plain
+                single-device endpoint answer every query over the same
+                store — a mesh-vs-single disagreement fails the replay
+                loudly, and the mesh answer is then compared against
+                the host oracle like any other cell.
 
 After every burst, every query in the case's query stream is answered
 by the device endpoint (optionally behind a DecisionCacheEndpoint) and
@@ -87,7 +94,13 @@ ROLES = ("leader", "follower2", "promoted")
 # schema-derived co-location-valid partition map; the oracle reads a
 # single mirror store receiving the same stream
 SHARDED_ROLE = "sharded2"
-ALL_ROLES = ROLES + (SHARDED_ROLE,)
+
+# multi-chip mesh execution (parallel/sharding.py): the case replays
+# through a 2x2 virtual-device mesh endpoint differentially checked
+# against a single-device endpoint over the same store, and the mesh
+# answers are compared against the host oracle like any other cell
+MESH_ROLE = "mesh"
+ALL_ROLES = ROLES + (SHARDED_ROLE, MESH_ROLE)
 
 SMOKE_KERNELS = ("segment", "ell")
 
@@ -99,11 +112,15 @@ SMOKE_SHARDED_GATES = ("off", "full")
 def smoke_cell_for(seed: int) -> tuple:
     """The fixed (gates, role, kernel) cell a smoke seed lands in:
     seeds 0..24 walk the classic 3x3 gate x role matrix (every cell
-    covered >= 2x) with the kernel alternating on top; seeds >= 25 are
+    covered >= 2x) with the kernel alternating on top; seeds 25..26 are
     the appended `sharded2` cells (router over 2 partition leaders,
-    off/full gates, kernels alternating).  Shared by
+    off/full gates, kernels alternating); seeds >= 27 are the `mesh`
+    cells (2x2 virtual-device mesh vs single-device vs oracle, off/full
+    gates, ell kernel only — the mesh path requires it).  Shared by
     scripts/fuzz_smoke.py and the mutation-check tests so 'the fixed
     seed set' means one thing."""
+    if seed >= 27:
+        return (SMOKE_SHARDED_GATES[(seed - 27) % 2], MESH_ROLE, "ell")
     if seed >= 25:
         return (SMOKE_SHARDED_GATES[(seed - 25) % 2], SHARDED_ROLE,
                 SMOKE_KERNELS[seed % 2])
@@ -223,7 +240,9 @@ class _RoleHarness:
         self._promoted = False
         self.pmap = None               # sharded2: the partition map
         self.shard_stores: list = []   # sharded2: per-shard stores
-        if role == "leader":
+        if role in ("leader", MESH_ROLE):
+            # mesh: same single-store topology as leader; the endpoint
+            # pair (mesh + single-device reference) is built later
             self.query_store = self.leader
             self.hops = []
         elif role == "follower2":
@@ -385,11 +404,69 @@ class _RoleHarness:
                 from ..spicedb.decision_cache import DecisionCacheEndpoint
                 inners = [DecisionCacheEndpoint(i) for i in inners]
             return ShardedEndpoint(self.pmap, inners, schema=schema)
+        if self.role == MESH_ROLE:
+            import jax
+            from ..parallel.sharding import make_mesh
+            mesh = make_mesh(jax.devices()[:4], data=2, graph=2)
+            mesh_ep = JaxEndpoint(schema, store=self.query_store,
+                                  kernel=kernel, mesh=mesh)
+            if cache_on:
+                from ..spicedb.decision_cache import DecisionCacheEndpoint
+                mesh_ep = DecisionCacheEndpoint(mesh_ep)
+            # the single-device reference is always bare: an independent
+            # checker, not a second copy of the cell's gate combo
+            return _MeshDifferentialEndpoint(
+                mesh_ep, JaxEndpoint(schema, store=self.query_store,
+                                     kernel=kernel))
         ep = JaxEndpoint(schema, store=self.query_store, kernel=kernel)
         if cache_on:
             from ..spicedb.decision_cache import DecisionCacheEndpoint
             ep = DecisionCacheEndpoint(ep)
         return ep
+
+
+class _MeshDifferentialEndpoint:
+    """Three-way differential shim for the `mesh` role: every query runs
+    on the sharded mesh endpoint AND a plain single-device endpoint over
+    the same store.  A mesh-vs-single disagreement fails the replay
+    loudly (same contract as the sharded2 partition-map validation);
+    the mesh answer is what the driver then compares against the host
+    oracle, so all three pairwise comparisons are covered."""
+
+    def __init__(self, mesh_ep, single_ep):
+        self._mesh = mesh_ep
+        self._single = single_ep
+
+    def warm_start(self) -> None:
+        self._mesh.warm_start()
+        self._single.warm_start()
+
+    def wait_rebuilds(self) -> None:
+        for ep in (self._mesh, self._single):
+            wait = getattr(ep, "wait_rebuilds", None)
+            if wait is not None:
+                wait()
+
+    async def lookup_resources(self, rtype, perm, subject):
+        got = await self._mesh.lookup_resources(rtype, perm, subject)
+        ref = await self._single.lookup_resources(rtype, perm, subject)
+        if sorted(got) != sorted(ref):
+            raise AssertionError(
+                f"mesh vs single-device lookup divergence for "
+                f"{rtype}#{perm}@{subject}: mesh={sorted(got)} "
+                f"single={sorted(ref)}")
+        return got
+
+    async def check_bulk_permissions(self, reqs):
+        got = await self._mesh.check_bulk_permissions(reqs)
+        ref = await self._single.check_bulk_permissions(reqs)
+        for req, g, s in zip(reqs, got, ref):
+            if g.permissionship != s.permissionship:
+                raise AssertionError(
+                    f"mesh vs single-device check divergence for "
+                    f"{req}: mesh={g.permissionship.name} "
+                    f"single={s.permissionship.name}")
+        return got
 
 
 # -- the replay ---------------------------------------------------------------

@@ -224,6 +224,23 @@ def _register_graph_buffers(graph, gen: int) -> int:
     # changes the registered bytes (in-place aliasing neither allocates
     # nor frees)
     kern = getattr(graph, "kernel", None)
+    if kern is not None and hasattr(kern, "mesh"):
+        # sharded mesh graph: tables live on the kernel as row-sharded
+        # NamedSharding arrays.  Register one row PER ADDRESSABLE SHARD,
+        # keyed by device id — the per-shard sum is the true physical
+        # footprint (data-axis replication really does hold one copy per
+        # replica), and each row also feeds the per-device gauge
+        # (authz_device_shard_bytes) so placement is observable.
+        for attr, kind in (("idx_main", "ell_main"),
+                           ("idx_aux", "ell_aux"),
+                           ("idx_cav", "ell_cav")):
+            a = getattr(kern, attr, None)
+            for sh in getattr(a, "addressable_shards", ()):
+                nb = int(sh.data.nbytes)
+                devtel.LEDGER.register(kind, nb, generation=gen,
+                                       name=f"{attr}:d{sh.device.id}",
+                                       device=sh.device.id)
+                total += nb
     if kern is not None and hasattr(kern, "devtel_generation"):
         kern.devtel_generation = gen
     # the segment graph creates its kernel caches lazily (sorted vs
@@ -262,8 +279,21 @@ def _sweep_bytes(graph, lanes: int) -> int:
         elif hasattr(graph, "edge_src"):
             # segment kernel: one gather read + segment write per edge
             cached = (int(graph.edge_src.shape[0]) * 2, False)
+        elif getattr(getattr(graph, "kernel", None), "idx_main", None) \
+                is not None:
+            # sharded mesh graph: tables live on the kernel (padded row
+            # counts include the n_graph row padding — the padded rows
+            # really are swept on device, so they belong in the model)
+            kern = graph.kernel
+            n, km = kern.idx_main.shape
+            a_rows, ka = kern.idx_aux.shape
+            ap = getattr(kern, "aux_passes", 1)
+            rows = n * (km + 1) + ap * a_rows * (ka + 1)
+            if getattr(kern, "idx_cav", None) is not None:
+                rows += (n + a_rows) * (kern.idx_cav.shape[1] + 1)
+            cached = (rows, True)
         else:
-            cached = (0, True)      # sharded path: no host-side model
+            cached = (0, True)
         graph._timeline_sweep = cached
     rows, packed = cached
     width = max(1, lanes // 32) * 4 if packed else lanes * 4
@@ -1102,13 +1132,6 @@ class _ShardedEllGraph(_EllGraph):
     is SpiceDB's internal dispatch distribution
     (reference pkg/spicedb/spicedb.go:31-47)."""
 
-    # the sharded kernel manages its own sharded buffers and has no
-    # donated-arena/device-transpose entry points: shadow the inherited
-    # pipeline methods so the endpoint (and prewarm) fall back to the
-    # serial path cleanly
-    run_checks3_device = None
-    run_lookup_packed_T_device = None
-
     def __init__(self, prog: GraphProgram, edge_endpoints, mesh,
                  num_iters: Optional[int] = None):
         from ..parallel.sharding import ShardedEllKernel
@@ -1161,6 +1184,27 @@ class _ShardedEllGraph(_EllGraph):
             changed = True
         return changed
 
+    def _prewarm_flush_bucket(self, b: int) -> bool:
+        """Sharded variant of the delta-flush scatter prewarm: the
+        device tables live on the kernel, so warm flush()'s scatter
+        shapes through the same update_*_rows entry points it uses
+        (row 0 rewritten with its current host values — idempotent)."""
+        rows = np.zeros(b, np.int32)
+        done = False
+        if len(self.host_main):
+            self.kernel.update_main_rows(rows, self.host_main[rows])
+            done = True
+        if len(self.host_aux):
+            self.kernel.update_aux_rows(rows, self.host_aux[rows])
+            done = True
+        if self.host_cav is not None and len(self.host_cav):
+            self.kernel.update_cav_rows(rows, self.host_cav[rows])
+            done = True
+        if done:
+            self.kernel.idx_main.block_until_ready()
+            self.kernel.idx_aux.block_until_ready()
+        return done
+
     def batch_bucket(self, n: int) -> int:
         # honor the SPICEDB_TPU_MIN_BATCH_WORDS floor here too (the kernel
         # then rounds up to whole words per data-axis shard)
@@ -1197,6 +1241,31 @@ class _ShardedEllGraph(_EllGraph):
                           snap=None) -> np.ndarray:
         return self.kernel.lookup_packed(
             offset, length, np.asarray(q_arr, np.int32), tables=snap)
+
+    # -- device-resident pipeline (dispatch-only; caller owns readback) ------
+    # Same contract as _EllGraph's entries: the sharded kernel donates
+    # per-shard state arenas and word-transposes on device, so the
+    # endpoint's async readback/overlap machinery (and pipelined
+    # prewarm) run unchanged on the mesh instead of degrading to the
+    # blocking serial path.
+
+    def run_checks3_device(self, q_arr, gather_idx, gather_col, snap=None):
+        tables = snap if snap is not None else self.snapshot()
+        # same bucket unification as run_checks3 (prewarm-diagonal keys)
+        q_arr, gi, gc = _unify_check_buckets(
+            q_arr, gather_idx, gather_col, self.prog.dead_index)
+        n_words = max(1, len(q_arr) // 32)
+        dev, tel = self.kernel.checks_device(q_arr, n_words, gi, gc,
+                                             *tables)
+        return dev, tel, self.kernel
+
+    def run_lookup_packed_T_device(self, offset: int, length: int, q_arr,
+                                   snap=None):
+        tables = snap if snap is not None else self.snapshot()
+        n_words = max(1, len(q_arr) // 32)
+        dev, tel = self.kernel.lookup_packed_T_device(
+            offset, length, q_arr, n_words, *tables)
+        return dev, tel, self.kernel
 
 
 _GRAPH_KINDS = {"ell": _EllGraph, "segment": _SegmentGraph}

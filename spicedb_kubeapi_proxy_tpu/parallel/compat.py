@@ -1,0 +1,55 @@
+"""Version-spanning `shard_map` resolution.
+
+`shard_map` has moved twice across the jax versions this package must
+span: 0.4.x ships it at `jax.experimental.shard_map.shard_map` with a
+`check_rep` kwarg, newer releases promote it to `jax.shard_map` and
+rename the replication-check kwarg to `check_vma`.  Every sharded
+program in this repo (parallel/sharding.py, parallel/distributed.py's
+multi-host variant, tests) goes through this one shim so a jax upgrade
+is a one-file event instead of a grep across kernels.
+
+The shim keeps the MODERN calling convention (`check_vma=`) at call
+sites and translates down for 0.4.x, because the modern name is where
+the API is heading — the compat direction should age out, not in.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def _resolve():
+    """-> (callable, replication-check kwarg name or None)."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # C-accelerated / exotic wrapper
+        params = {}
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            return fn, name
+    return fn, None
+
+
+_SHARD_MAP, _CHECK_KWARG = _resolve()
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """`jax.shard_map` with the modern signature on every supported jax.
+
+    `check_vma=None` means "library default"; an explicit bool is passed
+    through under whatever name (`check_vma`/`check_rep`) the resolved
+    implementation accepts, and silently dropped if it accepts neither
+    (the check is an assertion aid, never a semantics change).
+    """
+    kwargs = {}
+    if check_vma is not None and _CHECK_KWARG is not None:
+        kwargs[_CHECK_KWARG] = check_vma
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -7,7 +7,8 @@ remote device-backed permission server, and (b) — TPU-natively — one
 `jax://` endpoint spanning a MULTI-HOST device mesh: every proxy process
 joins a `jax.distributed` cluster, `jax.devices()` becomes the global
 device set, and the same 2D (data x graph) `shard_map` program from
-parallel/sharding.py runs with the graph axis striped across hosts
+parallel/sharding.py (resolved for the running jax version by
+parallel/compat.shard_map) runs with the graph axis striped across hosts
 (XLA routes per-iteration all_gathers over ICI within a slice and DCN
 across slices — SURVEY.md §5 communication-backend note).
 

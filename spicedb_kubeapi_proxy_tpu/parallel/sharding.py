@@ -22,6 +22,7 @@ shard agrees on the trip count.  On a v5e-8 both axes map onto ICI, and
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import jax
@@ -32,6 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.graph_compile import GraphProgram
 from ..ops.spmv import (MAX_ITERATIONS, bucket, make_evaluate,
                         pad_edges, pad_scatter)
+from ..utils import devtel, workload
+from ..utils.failpoints import fail_point
+from .compat import shard_map
 
 
 def make_mesh(devices=None, data: Optional[int] = None,
@@ -66,7 +70,7 @@ def make_sharded_evaluate(prog: GraphProgram, mesh: Mesh, num_iters: int):
         changed_reduce=lambda c: jax.lax.pmax(
             c.astype(jnp.int32), ("data", "graph")) > 0,
     )
-    return jax.shard_map(
+    return shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P("data"), P("graph"), P("graph")),
         out_specs=P(None, "data"),
@@ -290,6 +294,11 @@ class ShardedEllKernel:
     shard on the gathered full state (tiny elementwise work).
     """
 
+    # metric label for authz_sweep_iterations / authz_frontier_decay:
+    # the sharded kernel runs the same packed fixed-fanin sweep, so its
+    # telemetry shares the single-chip label value space
+    kernel_name = "ell"
+
     def __init__(self, prog: GraphProgram, mesh: Mesh,
                  num_iters: Optional[int] = None, tables=None):
         from ..ops.ell import K_AUX, build_cav_tables, build_tables
@@ -357,6 +366,17 @@ class ShardedEllKernel:
                 cav_dev[cav_dev >= n] += self.n_pad - n
             self.idx_cav = jax.device_put(cav_dev, self._row_spec)
         self._jits: dict = {}
+        # pipelined dispatch state (mirrors ops/ell.EllKernelCache): the
+        # sweep state is a (n_pad + a_pad) x local-words arena, word-
+        # sharded along `data` and replicated along `graph` — exactly the
+        # layout the shard program carries — so donation aliases the
+        # previous call's per-device buffers in place
+        self._state_spec = NamedSharding(
+            mesh, P(None, "data", None) if self.planes else P(None, "data"))
+        self._q_spec = NamedSharding(mesh, P("data"))
+        self._arenas: dict = {}
+        self._arena_lock = threading.Lock()
+        self.devtel_generation = 0
 
     def update_cav_rows(self, rows: np.ndarray, vals: np.ndarray) -> None:
         """Incremental MAYBE-plane table edits.  Host tables are in compile
@@ -394,11 +414,30 @@ class ShardedEllKernel:
 
     # -- the sharded program -------------------------------------------------
 
-    def _evaluate_shard_fn(self):
+    def _evaluate_shard_fn(self, arena: bool = False,
+                           introspect: bool = False):
+        """Build the shard_map'd sweep program.
+
+        Default flavor: fn(q, idx_main, idx_aux[, idx_cav]) -> x_main
+        [n_pad, W(, 2)] — the main block only, for the blocking entries.
+
+        `arena=True` (the pipelined dispatch flavor, mirroring
+        ops/ell.make_ell_evaluate): the signature grows a LEADING
+        donated `state` operand [n_pad + a_pad, W(, 2)] whose buffer
+        seeds the zero-init in place (the jit's donate_argnums aliases
+        it to the returned full main+aux state), and the return value is
+        that full state so the caller can repool it.
+
+        `introspect=True` (arena flavor only; KernelIntrospect resolved
+        at jit-build time, see ops/ell._pipe_fns): the return becomes
+        (state, tel) — tel the int32 [1 + num_iters] sweep trace
+        (tel[0] executed iterations, tel[1:] per-iteration global
+        frontier popcount)."""
         from ..ops.ell import _apply_perm_expr_packed
 
         prog = self.prog
         n_pad = self.n_pad
+        a_pad = self.a_pad
         dead = prog.dead_index
         planes = self.planes
         perm_ops = tuple(prog.perm_ops)
@@ -411,23 +450,7 @@ class ShardedEllKernel:
         num_iters = self.num_iters
         aux_passes = self.aux_passes
 
-        def shard_fn(q_local, main_local, aux_local, cav_local=None):
-            wl = q_local.shape[0] // 32
-            cols = jnp.arange(q_local.shape[0])
-            word = cols // 32
-            bit = (cols % 32).astype(jnp.uint32)
-            # planes: trailing size-2 axis (0=definite, 1=maybe); the
-            # query subject seeds BOTH planes (broadcast add)
-            shape = (n_pad, wl, 2) if planes else (n_pad, wl)
-            x0_main = jnp.zeros(shape, jnp.uint32)
-            if planes:
-                x0_main = x0_main.at[q_local, word, :].add(
-                    jnp.uint32(1) << bit[:, None])
-            else:
-                x0_main = x0_main.at[q_local, word].add(jnp.uint32(1) << bit)
-            x0_main = x0_main.at[dead].set(np.uint32(0))
-            x0_aux = jnp.zeros((self.a_pad,) + shape[1:], jnp.uint32)
-
+        def sweep(x0_main, x0_aux, main_local, aux_local, cav_local):
             def step(x_main, x_aux):
                 x = jnp.concatenate([x_main, x_aux], axis=0)
                 # bottom-up aux refresh first (Gauss-Seidel tree collapse,
@@ -486,6 +509,36 @@ class ShardedEllKernel:
                 x1 = x1.at[dead].set(np.uint32(0))
                 return x1, y_aux
 
+            if introspect:
+                def cond(state):
+                    _, _, changed, i, _ = state
+                    return jnp.logical_and(changed, i < num_iters)
+
+                def body(state):
+                    x_main, x_aux, _, i, trace = state
+                    x1_main, x1_aux = step(x_main, x_aux)
+                    changed = (jnp.any(x1_main != x_main)
+                               | jnp.any(x1_aux != x_aux))
+                    changed = jax.lax.pmax(changed.astype(jnp.int32),
+                                           ("data", "graph")) > 0
+                    delta = (jnp.sum(jax.lax.population_count(
+                                 x1_main ^ x_main))
+                             + jnp.sum(jax.lax.population_count(
+                                 x1_aux ^ x_aux))).astype(jnp.int32)
+                    # the local popcount covers this shard's WORDS only:
+                    # psum over `data` yields the global frontier delta.
+                    # The state is replicated along `graph` — reducing
+                    # over it too would multiply the count by n_graph.
+                    delta = jax.lax.psum(delta, "data")
+                    return (x1_main, x1_aux, changed, i + 1,
+                            trace.at[i].set(delta))
+
+                x_main, x_aux, _, i, trace = jax.lax.while_loop(
+                    cond, body,
+                    (x0_main, x0_aux, jnp.bool_(True), jnp.int32(0),
+                     jnp.zeros((num_iters,), jnp.int32)))
+                return x_main, x_aux, jnp.concatenate([i[None], trace])
+
             def cond(state):
                 _, _, changed, i = state
                 return jnp.logical_and(changed, i < num_iters)
@@ -498,27 +551,65 @@ class ShardedEllKernel:
                                        ("data", "graph")) > 0
                 return (x1_main, x1_aux, changed, i + 1)
 
-            x_main, _, _, _ = jax.lax.while_loop(
+            x_main, x_aux, _, _ = jax.lax.while_loop(
                 cond, body, (x0_main, x0_aux, jnp.bool_(True), jnp.int32(0)))
-            return x_main
+            return x_main, x_aux
+
+        def seed_main(x0_main, q_local):
+            # planes: trailing size-2 axis (0=definite, 1=maybe); the
+            # query subject seeds BOTH planes (broadcast add)
+            cols = jnp.arange(q_local.shape[0])
+            word = cols // 32
+            bit = (cols % 32).astype(jnp.uint32)
+            if planes:
+                x0_main = x0_main.at[q_local, word, :].add(
+                    jnp.uint32(1) << bit[:, None])
+            else:
+                x0_main = x0_main.at[q_local, word].add(jnp.uint32(1) << bit)
+            return x0_main.at[dead].set(np.uint32(0))
+
+        if arena:
+            def shard_fn(state_local, q_local, main_local, aux_local,
+                         cav_local=None):
+                # zero-init THROUGH the donated buffer (the sharded
+                # counterpart of ops/ell.init_packed_state `like=`): the
+                # bitplane pack seeds per-device buffers XLA aliases to
+                # the previous call's donated output
+                x0_main = seed_main(jnp.zeros_like(state_local[:n_pad]),
+                                    q_local)
+                x0_aux = jnp.zeros_like(state_local[n_pad:])
+                res = sweep(x0_main, x0_aux, main_local, aux_local,
+                            cav_local)
+                if introspect:
+                    x_main, x_aux, tel = res
+                    return jnp.concatenate([x_main, x_aux], axis=0), tel
+                x_main, x_aux = res
+                return jnp.concatenate([x_main, x_aux], axis=0)
+        else:
+            def shard_fn(q_local, main_local, aux_local, cav_local=None):
+                wl = q_local.shape[0] // 32
+                shape = (n_pad, wl, 2) if planes else (n_pad, wl)
+                x0_main = seed_main(jnp.zeros(shape, jnp.uint32), q_local)
+                x0_aux = jnp.zeros((a_pad,) + shape[1:], jnp.uint32)
+                x_main, _ = sweep(x0_main, x0_aux, main_local, aux_local,
+                                  cav_local)
+                return x_main
 
         row = P("graph", None)
-        if planes:
-            return jax.shard_map(
-                shard_fn, mesh=self.mesh,
-                in_specs=(P("data"), row, row, row),
-                out_specs=P(None, "data", None),
-                check_vma=False,  # state replicated along `graph` by design
-            )
-        return jax.shard_map(
-            shard_fn, mesh=self.mesh,
-            in_specs=(P("data"), row, row),
-            out_specs=P(None, "data"),
-            check_vma=False,  # state is replicated along `graph` by design
-        )
+        # the state is replicated along `graph` by design (check_vma off)
+        state_sp = P(None, "data", None) if planes else P(None, "data")
+        in_specs = (P("data"), row, row) + ((row,) if planes else ())
+        if arena:
+            in_specs = (state_sp,) + in_specs
+            out_specs = (state_sp, P(None)) if introspect else state_sp
+        else:
+            out_specs = state_sp
+        return shard_map(shard_fn, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
 
     def _fns(self) -> tuple:
-        if not self._jits:
+        fns = self._jits.get("serial")
+        if fns is None:
             evaluate = self._evaluate_shard_fn()
             if self.planes:
                 def run_lookup(slot_offset, slot_length, q, idx_main,
@@ -550,9 +641,166 @@ class ShardedEllKernel:
                     return (x[gather_idx, gather_word] >> gather_bit) \
                         & jnp.uint32(1)
 
-            self._jits = (jax.jit(run_lookup, static_argnums=(0, 1)),
-                          jax.jit(run_checks))
-        return self._jits
+            fns = (jax.jit(run_lookup, static_argnums=(0, 1)),
+                   jax.jit(run_checks))
+            self._jits["serial"] = fns
+        return fns
+
+    # -- pipelined (device-resident) entry points ----------------------------
+    # Sharded counterpart of ops/ell.EllKernelCache's pipelined dispatch:
+    # the bitplane pack seeds a DONATED per-shard state arena, the word
+    # transpose folds into the jit, and the un-materialized device array
+    # is returned so the endpoint overlaps the D2H readback with the
+    # next batch's dispatch — the mesh path no longer degrades to the
+    # blocking serial entries.
+
+    def _pipe_fns(self) -> tuple:
+        fns = self._jits.get("pipe")
+        if fns is not None:
+            return fns
+        # introspection resolved at jit-BUILD time (see ops/ell._fns):
+        # gate off, the carry and return shapes are byte-identical to
+        # the pre-introspection build
+        intro = workload.enabled()
+        evaluate = self._evaluate_shard_fn(arena=True, introspect=intro)
+
+        if self.planes:
+            def run_checks(q, gather_idx, gather_col, state,
+                           idx_main, idx_aux, idx_cav):
+                # word/bit split of the raw query columns happens HERE:
+                # the host uploads plain int32 column ids
+                gw = gather_col // 32
+                gb = (gather_col % 32).astype(jnp.uint32)
+                xe = evaluate(state, q, idx_main, idx_aux, idx_cav)
+                x, tel = xe if intro else (xe, None)
+                d = (x[gather_idx, gw, 0] >> gb) & jnp.uint32(1)
+                m = (x[gather_idx, gw, 1] >> gb) & jnp.uint32(1)
+                # 2=HAS, 1=CONDITIONAL (maybe without definite), 0=NO
+                out = d * 2 + (m & (d ^ jnp.uint32(1)))
+                return (out, x, tel) if intro else (out, x)
+
+            def run_lookup(slot_offset, slot_length, q, state,
+                           idx_main, idx_aux, idx_cav):
+                xe = evaluate(state, q, idx_main, idx_aux, idx_cav)
+                x, tel = xe if intro else (xe, None)
+                # DEFINITE plane only (reference lookups.go:85-88);
+                # transpose ON DEVICE so the D2H lands [W, L]
+                sl = jax.lax.dynamic_slice_in_dim(
+                    x[..., 0], slot_offset, slot_length, axis=0)
+                return (sl.T, x, tel) if intro else (sl.T, x)
+        else:
+            def run_checks(q, gather_idx, gather_col, state,
+                           idx_main, idx_aux):
+                gw = gather_col // 32
+                gb = (gather_col % 32).astype(jnp.uint32)
+                xe = evaluate(state, q, idx_main, idx_aux)
+                x, tel = xe if intro else (xe, None)
+                # tri-state encoding ({0, 2}) so every kernel variant
+                # hands the endpoint the same value space
+                out = ((x[gather_idx, gw] >> gb) & jnp.uint32(1)) * 2
+                return (out, x, tel) if intro else (out, x)
+
+            def run_lookup(slot_offset, slot_length, q, state,
+                           idx_main, idx_aux):
+                xe = evaluate(state, q, idx_main, idx_aux)
+                x, tel = xe if intro else (xe, None)
+                sl = jax.lax.dynamic_slice_in_dim(
+                    x, slot_offset, slot_length, axis=0)
+                return (sl.T, x, tel) if intro else (sl.T, x)
+
+        # donate_argnums=3 = the state arena; donation is a no-op on
+        # backends without aliasing support (the virtual CPU mesh) and
+        # an in-place per-shard update on TPU
+        fns = (jax.jit(run_checks, donate_argnums=(3,)),
+               jax.jit(run_lookup, static_argnums=(0, 1),
+                       donate_argnums=(3,)),
+               intro)
+        self._jits["pipe"] = fns
+        return fns
+
+    def arena_key(self, lanes: int) -> int:
+        """Pool key for a batch of `lanes` padded query columns (GLOBAL
+        uint32 words — the data axis splits them across shards)."""
+        return max(1, lanes // 32)
+
+    def take_arena(self, n_words: int):
+        """Pop the bucket's sharded state arena (exclusive: a donated
+        buffer must never be shared between two in-flight calls); lazily
+        allocated with the sweep's own sharding and HBM-ledger-registered
+        on first use under the owning graph generation."""
+        # kill-matrix site (tests/test_faultmatrix.py): a failure at the
+        # arena pop must fail the dispatching batch fast without
+        # corrupting the pool or the ledger
+        fail_point("arenaTake")
+        with self._arena_lock:
+            a = self._arenas.pop(n_words, None)
+        if a is not None:
+            return a
+        rows = self.n_pad + self.a_pad
+        shape = (rows, n_words, 2) if self.planes else (rows, n_words)
+        a = jax.device_put(jnp.zeros(shape, jnp.uint32), self._state_spec)
+        devtel.LEDGER.register("state_arena", int(a.nbytes),
+                               generation=self.devtel_generation,
+                               name=f"arena:{n_words}")
+        return a
+
+    def put_arena(self, n_words: int, state) -> None:
+        """Return a call's final state as the bucket's next donated
+        arena (first writer wins, as in ops/ell.EllKernelCache)."""
+        with self._arena_lock:
+            self._arenas.setdefault(n_words, state)
+
+    def discard_arena(self, n_words: int) -> None:
+        """Drop a bucket's pooled arena — a failed async computation
+        poisons its output array, and donating a poisoned arena would
+        fail every later call of the bucket."""
+        with self._arena_lock:
+            a = self._arenas.pop(n_words, None)
+        if a is not None:
+            devtel.LEDGER.unregister("state_arena",
+                                     generation=self.devtel_generation,
+                                     name=f"arena:{n_words}")
+
+    def checks_device(self, q_idx: np.ndarray, n_words: int,
+                      gather_idx: np.ndarray, gather_col: np.ndarray,
+                      idx_main, idx_aux, idx_cav=None):
+        """Dispatch-only tri-state checks over the mesh ({0,2}, or
+        {0,1,2} with planes): returns (out, tel) — the un-materialized
+        device result plus the sweep-trace device array (None when
+        KernelIntrospect was off at jit build); the caller owns the
+        blocking readback.  `q_idx` must already be padded to a
+        data-divisible lane count (the graph's batch_bucket guarantees
+        it)."""
+        run_checks, _, intro = self._pipe_fns()
+        state = self.take_arena(n_words)
+        q = jax.device_put(np.asarray(q_idx, np.int32), self._q_spec)
+        args = [q, jnp.asarray(gather_idx), jnp.asarray(gather_col),
+                state, idx_main, idx_aux]
+        res = run_checks(*args, idx_cav) if self.planes else run_checks(*args)
+        out, x, tel = res if intro else (res[0], res[1], None)
+        self.put_arena(n_words, x)
+        return out, tel
+
+    def lookup_packed_T_device(self, slot_offset: int, slot_length: int,
+                               q_idx: np.ndarray, n_words: int,
+                               idx_main, idx_aux, idx_cav=None):
+        """Dispatch-only packed lookup over the mesh, word-transposed on
+        device: returns (out, tel) — out the un-materialized
+        [n_words, slot_length] uint32 device array (bit b of word row w
+        = query column w*32+b; DEFINITE plane when planes are active),
+        tel the sweep trace (None when KernelIntrospect was off)."""
+        _, run_lookup, intro = self._pipe_fns()
+        state = self.take_arena(n_words)
+        q = jax.device_put(np.asarray(q_idx, np.int32), self._q_spec)
+        if self.planes:
+            res = run_lookup(slot_offset, slot_length, q, state,
+                             idx_main, idx_aux, idx_cav)
+        else:
+            res = run_lookup(slot_offset, slot_length, q, state,
+                             idx_main, idx_aux)
+        out, x, tel = res if intro else (res[0], res[1], None)
+        self.put_arena(n_words, x)
+        return out, tel
 
     # -- host-facing ---------------------------------------------------------
 

@@ -466,21 +466,40 @@ def create_endpoint(url: str,
         # Single-device processes fall back to the single-chip kernels.
         mesh_param = (params.get("mesh") or [""])[0]
         if mesh_param and "mesh" not in kwargs:
-            import jax
-
-            from ..parallel.sharding import make_mesh
-            if mesh_param == "auto":
-                if len(jax.devices()) > 1:
-                    kwargs["mesh"] = make_mesh()
-            else:
-                try:
-                    data_s, _, graph_s = mesh_param.partition("x")
-                    kwargs["mesh"] = make_mesh(data=int(data_s),
-                                               graph=int(graph_s))
-                except ValueError as e:
+            from ..utils.features import mesh_enabled
+            if not mesh_enabled():
+                # MeshExecution killswitch: `auto` degrades to the
+                # single-chip kernels (best-effort by definition), an
+                # EXPLICIT topology must fail loudly rather than be
+                # silently ignored
+                if mesh_param != "auto":
                     raise EndpointConfigError(
-                        f"invalid mesh {mesh_param!r} in {url!r}: {e}"
-                    ) from e
+                        f"mesh={mesh_param!r} in {url!r} requires the "
+                        f"MeshExecution feature gate (disabled)")
+            else:
+                import jax
+
+                from ..parallel.sharding import make_mesh
+                if mesh_param == "auto":
+                    if len(jax.devices()) > 1:
+                        kwargs["mesh"] = make_mesh()
+                else:
+                    try:
+                        data_s, _, graph_s = mesh_param.partition("x")
+                        d, g = int(data_s), int(graph_s)
+                        devices = jax.devices()
+                        if d * g > len(devices):
+                            raise ValueError(
+                                f"mesh {d}x{g} needs {d * g} devices, "
+                                f"have {len(devices)}")
+                        # an explicit DxG smaller than the host takes
+                        # the first d*g devices (run on a chip subset)
+                        kwargs["mesh"] = make_mesh(devices[:d * g],
+                                                   data=d, graph=g)
+                    except ValueError as e:
+                        raise EndpointConfigError(
+                            f"invalid mesh {mesh_param!r} in {url!r}: {e}"
+                        ) from e
         if store is not None:
             kwargs["store"] = store
         ep: PermissionsEndpoint = JaxEndpoint.from_bootstrap(bootstrap,

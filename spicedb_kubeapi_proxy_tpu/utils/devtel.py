@@ -107,6 +107,16 @@ class HbmLedger:
             "authz_device_bytes_peak",
             "High-water mark of the HBM ledger total",
             callback=lambda: float(self.peak))
+        # per-device shard accounting (sharded mesh tables/arenas): only
+        # buffers registered with an explicit device= land here, so the
+        # label cardinality is bounded by the local device count
+        self._dev_gauge = registry.gauge(
+            "authz_device_shard_bytes",
+            "Bytes of device buffers by kind and owning device shard "
+            "(populated by the sharded mesh path)",
+            labels=("kind", "device"))
+        self._by_dev: dict = {}    # (kind, device id) -> bytes
+        self._buf_dev: dict = {}   # buffer key -> device id
 
     def defer_retire(self, generation: int) -> None:
         """Queue a generation for retirement WITHOUT taking any lock —
@@ -126,6 +136,11 @@ class HbmLedger:
                 return
             self._retire_locked(gen)
 
+    def _dev_delta_locked(self, kind: str, device: int, delta: int) -> None:
+        k = (kind, int(device))
+        self._by_dev[k] = self._by_dev.get(k, 0) + delta
+        self._dev_gauge.set(self._by_dev[k], kind=kind, device=str(k[1]))
+
     def _retire_locked(self, generation: int) -> int:
         dead = [k for k in self._buffers if k[0] == generation]
         freed = 0
@@ -134,10 +149,13 @@ class HbmLedger:
             freed += nb
             self._by_kind[key[1]] = self._by_kind.get(key[1], 0) - nb
             self._gauge.set(self._by_kind[key[1]], kind=key[1])
+            dev = self._buf_dev.pop(key, None)
+            if dev is not None:
+                self._dev_delta_locked(key[1], dev, -nb)
         return freed
 
     def register(self, kind: str, nbytes: int, generation: int = 0,
-                 name: str = "") -> None:
+                 name: str = "", device: Optional[int] = None) -> None:
         # the DeviceTelemetry gate covers ADDITIONS only: unregister and
         # retire_generation always run, so flipping the gate off never
         # strands entries the gauge can no longer shed
@@ -151,15 +169,27 @@ class HbmLedger:
             self._by_kind[kind] = self._by_kind.get(kind, 0) - old + int(nbytes)
             self._peak = max(self._peak, sum(self._by_kind.values()))
             self._gauge.set(self._by_kind[kind], kind=kind)
+            # device attribution replaces like the byte count does: a
+            # re-registration may move the buffer to another shard
+            prev_dev = self._buf_dev.pop(key, None)
+            if prev_dev is not None:
+                self._dev_delta_locked(kind, prev_dev, -old)
+            if device is not None:
+                self._buf_dev[key] = int(device)
+                self._dev_delta_locked(kind, device, int(nbytes))
 
     def unregister(self, kind: str, generation: int = 0,
                    name: str = "") -> int:
+        key = (generation, kind, name)
         with self._lock:
             self._reap_locked()
-            freed = self._buffers.pop((generation, kind, name), 0)
+            freed = self._buffers.pop(key, 0)
             if freed:
                 self._by_kind[kind] = self._by_kind.get(kind, 0) - freed
                 self._gauge.set(self._by_kind[kind], kind=kind)
+            dev = self._buf_dev.pop(key, None)
+            if dev is not None and freed:
+                self._dev_delta_locked(kind, dev, -freed)
             return freed
 
     def retire_generation(self, generation: int) -> int:
@@ -194,6 +224,13 @@ class HbmLedger:
         with self._lock:
             self._reap_locked()
             return {k: v for k, v in sorted(self._by_kind.items()) if v}
+
+    def device_totals(self) -> dict:
+        """Per-shard view: {(kind, device id): bytes} for every buffer
+        registered with device attribution (sharded mesh tables)."""
+        with self._lock:
+            self._reap_locked()
+            return {k: v for k, v in sorted(self._by_dev.items()) if v}
 
     @property
     def peak(self) -> int:

@@ -156,6 +156,26 @@ GATES.register("KernelIntrospect", stage=BETA, default=True)
 # chrome-trace output.  This gate is the killswitch: off, capture
 # requests are refused and the sampler thread never starts.
 GATES.register("Profiler", stage=ALPHA, default=True)
+# multi-chip mesh execution (parallel/sharding.py, parallel/compat.py,
+# ops/jax_endpoint.py _ShardedEllGraph): 2D (data x graph) shard_map
+# kernels behind `jax://?mesh=...` — row-sharded ELL tables with
+# per-iteration tiled all_gather, word-sharded batches, sharded donated
+# state arenas, and per-device HBM ledger rows.  This gate is the
+# killswitch: off, `mesh=auto` degrades to the single-chip kernels
+# (byte-identical single-device path) and an explicit `mesh=DxG` fails
+# endpoint construction loudly (an authz proxy must not silently ignore
+# an explicitly configured topology).
+GATES.register("MeshExecution", stage=ALPHA, default=True)
+
+
+def mesh_enabled() -> bool:
+    """MeshExecution gate accessor; unknown-gate errors fail open so an
+    embedded user with a stripped gate registry keeps a configured
+    mesh (mirrors pipeline_enabled below)."""
+    try:
+        return GATES.enabled("MeshExecution")
+    except Exception:
+        return True
 
 
 def pipeline_enabled() -> bool:

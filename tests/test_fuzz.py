@@ -145,6 +145,17 @@ class TestDriver:
             cells[(g, r)] = cells.get((g, r), 0) + 1
         assert len(cells) == 9 and min(cells.values()) >= 2
 
+    def test_mesh_cell_agrees(self):
+        """The appended mesh cells (seeds 27+): 2x2 virtual-device mesh
+        endpoint vs single-device endpoint vs host oracle, zero
+        divergences.  The cell map pins them to the ell kernel."""
+        assert smoke_cell_for(27) == ("off", "mesh", "ell")
+        assert smoke_cell_for(28) == ("full", "mesh", "ell")
+        gates, role, kernel = smoke_cell_for(27)
+        case = build_case(27, smoke=True, kernel=kernel)
+        divs = run_case(case, gates=gates, role=role, checkpoints="final")
+        assert divs == [], [d.line() for d in divs]
+
     def test_gates_restored_after_run(self):
         before = {k: GATES.enabled(k)
                   for k in ("DecisionCache", "DevicePipeline",
