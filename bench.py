@@ -1942,6 +1942,183 @@ def bench_scenario_ephemeral_grants(args) -> dict:
     return _scenario_bench("ephemeral-grants", args, churn, cache_on=True)
 
 
+def bench_scenario_group_explosion(args) -> dict:
+    """Leopard materialized group index A/B (ISSUE 19): 100k groups in
+    disjoint depth-8 membership chains, docs shared with chain HEADS —
+    the shape where every check pays `depth` HBM sweep iterations
+    without the index and ONE closure-plane probe with it.  Two
+    endpoints over the SAME store: LeopardIndex gate ON at construction
+    (indexed) and OFF (iterative kernel sweeps), churned with tail-user
+    moves (insert propagation + delete quarantine -> background
+    re-close) under the host-oracle parity referee on BOTH endpoints.
+    Acceptance: 0 divergences, indexed >= 5x iterative checks/s, and
+    measured mean sweep depth ~1 on the indexed pairs."""
+    import asyncio
+    import random as _random
+
+    from spicedb_kubeapi_proxy_tpu.fuzz.delta_gen import FakeClock
+    from spicedb_kubeapi_proxy_tpu.fuzz.scenarios import SCENARIO_WORKLOADS
+    from spicedb_kubeapi_proxy_tpu.ops.jax_endpoint import JaxEndpoint
+    from spicedb_kubeapi_proxy_tpu.spicedb import schema as sch
+    from spicedb_kubeapi_proxy_tpu.spicedb.evaluator import Evaluator
+    from spicedb_kubeapi_proxy_tpu.spicedb.store import TupleStore
+    from spicedb_kubeapi_proxy_tpu.spicedb.types import (
+        CheckRequest, ObjectRef, RelationshipUpdate, SubjectRef, UpdateOp,
+        parse_relationship)
+    from spicedb_kubeapi_proxy_tpu.utils import workload as wk
+    from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+
+    depth = 8
+    workload = SCENARIO_WORKLOADS["group-explosion"](depth=depth)
+    stage(f"group-explosion build ({len(workload.relationships)} tuples)")
+    schema = sch.parse_schema(workload.schema_text)
+    clock = FakeClock()
+    store = TupleStore(clock=clock.now)
+    store.bulk_load_text("\n".join(workload.relationships))
+    # the LeopardIndex gate is captured at endpoint construction, so the
+    # indexed and iterative endpoints coexist over the same store
+    prev = GATES.enabled("LeopardIndex")
+    try:
+        GATES.set("LeopardIndex", True)
+        ep_on = JaxEndpoint(schema, store=store)
+        GATES.set("LeopardIndex", False)
+        ep_off = JaxEndpoint(schema, store=store)
+    finally:
+        GATES.set("LeopardIndex", prev)
+    oracle = Evaluator(schema, store)
+    rng = _random.Random(199)
+
+    # doc -> (head group, tail user) straight from the tuples, so the
+    # check mix carries known depth-8 positives without generator coupling
+    doc_user = {}
+    for r in workload.relationships:
+        if r.startswith("doc:"):
+            rel = parse_relationship(r)
+            head = int(rel.subject.id[1:])
+            doc_user[rel.resource.id] = f"u{(head // depth) % 2000}"
+    docs = sorted(doc_user)
+
+    def check_reqs(n):
+        reqs = []
+        for _ in range(n):
+            d = docs[rng.randrange(len(docs))]
+            u = (doc_user[d] if rng.random() < 0.5
+                 else f"u{rng.randrange(2000)}")
+            reqs.append(CheckRequest(ObjectRef("doc", d), "view",
+                                     SubjectRef("user", u)))
+        return reqs
+
+    rounds = max(2, args.rounds * 4 // 10)
+    n_chains = 100_000 // depth
+    divergences = 0
+    refereed = 0
+    p3 = {"NO_PERMISSION": 0, "CONDITIONAL_PERMISSION": 1,
+          "HAS_PERMISSION": 2}
+
+    def churn(r):
+        # move a few tail users between chains: the DELETE leg drives
+        # the quarantine -> background re-close path, the TOUCH leg the
+        # bounded-frontier insert propagation
+        ops = []
+        for _ in range(4):
+            c = rng.randrange(n_chains)
+            tail = c * depth + depth - 1
+            ops.append(RelationshipUpdate(UpdateOp.DELETE,
+                       parse_relationship(f"group:g{tail}#member"
+                                          f"@user:u{c % 2000}")))
+            ops.append(RelationshipUpdate(UpdateOp.TOUCH,
+                       parse_relationship(f"group:g{tail}#member"
+                                          f"@user:u{rng.randrange(2000)}")))
+        store.write(ops)
+
+    async def referee():
+        nonlocal divergences, refereed
+        subjects = [SubjectRef("user", doc_user[docs[rng.randrange(
+            len(docs))]]) for _ in range(2)]
+        for s in subjects:
+            want = sorted(oracle.lookup_resources("doc", "view", s))
+            for ep in (ep_on, ep_off):
+                got = sorted(await ep.lookup_resources("doc", "view", s))
+                refereed += 1
+                if got != want:
+                    divergences += 1
+        reqs = check_reqs(64)
+        want3 = [oracle.check3(q.resource, q.permission, q.subject)
+                 for q in reqs]
+        for ep in (ep_on, ep_off):
+            res = await ep.check_bulk_permissions(reqs)
+            for w, cr in zip(want3, res):
+                refereed += 1
+                if p3[cr.permissionship.name] != w:
+                    divergences += 1
+
+    async def measure(ep):
+        # depth attribution reads the sweep-telemetry singleton, so each
+        # phase starts from a clean accounting slate
+        wk.WORKLOAD.reset()
+        reqs = check_reqs(args.batch)
+        await ep.check_bulk_permissions(reqs)  # pay compiles untimed
+        t0 = time.time()
+        n = 0
+        for _ in range(max(4, args.rounds)):
+            await ep.check_bulk_permissions(reqs)
+            n += len(reqs)
+        check_s = time.time() - t0
+        t0 = time.time()
+        n_lists = 0
+        for _ in range(8):
+            s = SubjectRef("user", doc_user[docs[rng.randrange(len(docs))]])
+            await ep.lookup_resources("doc", "view", s)
+            n_lists += 1
+        list_s = time.time() - t0
+        mean_depth = None
+        for row in wk.WORKLOAD.payload()["rows"]:
+            if (row["resource_type"], row["permission"]) == ("doc", "view"):
+                mean_depth = row["mean_sweep_depth"]
+        return {"checks_per_s": round(n / max(check_s, 1e-9), 1),
+                "lists_per_s": round(n_lists / max(list_s, 1e-9), 2),
+                "mean_sweep_depth": mean_depth}
+
+    async def run():
+        for r in range(rounds):
+            churn(r)
+            await referee()
+        # drain background re-closes so the indexed phase measures the
+        # closure-plane fast path, not the quarantine kernel fallback
+        ep_on.wait_rebuilds()
+        ep_off.wait_rebuilds()
+        await referee()
+        return await measure(ep_on), await measure(ep_off)
+
+    indexed, iterative = asyncio.run(run())
+    lp = ep_on._leopard
+    statuses = lp.status_map() if lp is not None else {}
+    out = {
+        "divergences": divergences,
+        "refereed_answers": refereed,
+        "rounds": rounds,
+        "depth": depth,
+        "tuples": len(workload.relationships),
+        "checks_per_s": indexed["checks_per_s"],
+        "indexed": indexed,
+        "iterative": iterative,
+        "indexed_speedup": round(indexed["checks_per_s"]
+                                 / max(iterative["checks_per_s"], 1e-9), 2),
+        "index_fragments": lp.fragment_count() if lp is not None else 0,
+        "index_bytes": lp.nbytes if lp is not None else 0,
+        "index_statuses": statuses,
+        "leopard_checks": ep_on.stats["leopard_checks"],
+        "leopard_lookups": ep_on.stats["leopard_lookups"],
+        "leopard_recloses": ep_on.stats["leopard_recloses"],
+    }
+    log(f"group-explosion: {divergences} divergences over {refereed} "
+        f"refereed answers, indexed {indexed['checks_per_s']} vs "
+        f"iterative {iterative['checks_per_s']} checks/s "
+        f"({out['indexed_speedup']}x), depth {indexed['mean_sweep_depth']}"
+        f" vs {iterative['mean_sweep_depth']}")
+    return out
+
+
 # scenario matrix configs (ISSUE 12 / ROADMAP item 5): the three
 # workload shapes the sweep was missing, each with a host-oracle parity
 # referee (docs/performance.md "Scenario matrix")
@@ -2253,6 +2430,7 @@ SCENARIO_CONFIGS = {
     "caveat-heavy": bench_scenario_caveat_heavy,
     "wildcard-public": bench_scenario_wildcard_public,
     "ephemeral-grants": bench_scenario_ephemeral_grants,
+    "group-explosion": bench_scenario_group_explosion,
 }
 
 # device-resident pipeline A/B (ISSUE 7): same contract as CACHE_CONFIGS

@@ -40,6 +40,9 @@ ALLOWED_METRIC_LABELS = frozenset((
     # per-shard HBM accounting: owning device id of a sharded mesh
     # buffer (bounded by the local device count, not by traffic)
     "device",
+    # Leopard fragment maintenance state (indexed | quarantined |
+    # retired — bounded by the code, not by traffic)
+    "state",
 ))
 _METRIC_FACTORIES = ("counter", "gauge", "histogram")
 _M001_PREFIX = "spicedb_kubeapi_proxy_tpu"

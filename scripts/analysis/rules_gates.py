@@ -54,6 +54,11 @@ GATED_MODULES = (
     # resolution/runtime glue with no subsystem state to gate; the
     # endpoint checks the gate at mesh construction)
     ("parallel/sharding.py", "MeshExecution"),
+    # Leopard materialized group index: the closure planner/builder and
+    # its authz_leopard_* recording helpers ride the LeopardIndex
+    # killswitch (the endpoint only constructs the index when the gate
+    # was on at build time)
+    ("ops/leopard.py", "LeopardIndex"),
 )
 
 _MUTATOR_METHODS = ("inc", "observe", "dec")

@@ -50,6 +50,15 @@ from spicedb_kubeapi_proxy_tpu.proxy.server import (  # noqa: E402
 from spicedb_kubeapi_proxy_tpu.spicedb.endpoints import Bootstrap  # noqa: E402
 from spicedb_kubeapi_proxy_tpu.spicedb.types import (  # noqa: E402
     parse_relationship)
+from spicedb_kubeapi_proxy_tpu.utils.features import GATES  # noqa: E402
+
+# This smoke measures the device pipeline itself (fused-batch overlap,
+# admission shedding against real kernel windows): keep the Leopard
+# index out so nested lookups sweep instead of serving from the closure
+# plane.  The /debug/workload leopard field still surfaces through the
+# detector fallback (candidate | ineligible(unplanned)); the indexed
+# path is exercised by tests/test_leopard.py and the live e2e driver.
+GATES.set("LeopardIndex", False)
 
 SCHEMA = """
 definition user {}
@@ -298,6 +307,20 @@ async def main() -> None:
             fail(f"/debug/workload has no (pod, view) row: {sorted(pairs)}")
         if pod_view["kernel_rows"] + pod_view["oracle_rows"] <= 0:
             fail(f"(pod, view) row attributes no routed rows: {pod_view}")
+        # every row must carry the Leopard per-pair status verdict
+        # (`indexed | indexed(quarantined) | candidate |
+        # ineligible(reason)` — ops/leopard.py status_map plus the
+        # detector fallback), and the candidate list must be present
+        for row in wl.get("rows", []):
+            leo = row.get("leopard")
+            if not (leo in ("indexed", "indexed(quarantined)", "candidate")
+                    or (isinstance(leo, str)
+                        and leo.startswith("ineligible("))):
+                fail(f"/debug/workload row has no actionable leopard "
+                     f"status: {row}")
+        if "leopard_candidates" not in wl:
+            fail(f"/debug/workload payload missing leopard_candidates: "
+                 f"{sorted(wl)}")
         # total device seconds must reconcile with the cumulative
         # kernel-time histogram (same hook, same seconds) within 5%
         metric_s = 0.0

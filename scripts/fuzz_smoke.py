@@ -1,15 +1,17 @@
 #!/usr/bin/env python
 """Differential fuzz smoke: the fixed-seed gate x replication-role matrix.
 
-check.sh mode (default): replays 29 FIXED seeds — 25 mapped onto the
+check.sh mode (default): replays 31 FIXED seeds — 25 mapped onto the
 3 gate-combos x 3 replication-roles matrix (every cell covered >= 2x
 across the set; kernels alternate ell/segment), plus 2 `sharded2`
 cells replaying through a router over TWO partition leaders
 (spicedb/sharding, schema-derived co-location-valid map, off/full
 gates), plus 2 `mesh` cells replaying on a 2x2 virtual-device mesh
 endpoint differentially checked against a single-device endpoint
-(parallel/sharding.py, off/full gates) — asserting ZERO
-jax://-vs-oracle divergences.  Deterministic: schemas, delta
+(parallel/sharding.py, off/full gates), plus 2 `leopard` cells
+replaying a nested-groups-biased case on a Leopard-indexed endpoint
+differentially checked against a gate-off endpoint (ops/leopard.py,
+off/full gates) — asserting ZERO jax://-vs-oracle divergences.  Deterministic: schemas, delta
 streams, clocks, and queries all derive from the seed; wall time is the
 only thing that varies.  A divergence shrinks to a self-contained repro
 artifact (docs/fuzzing.md) and fails the run with its path + seed line.
@@ -94,7 +96,14 @@ def _run_cell(seed: int) -> dict:
     from spicedb_kubeapi_proxy_tpu.fuzz import build_case, run_case
     gates, role, kernel = cell_for(seed)
     t0 = time.time()
-    case = build_case(seed, smoke=True, kernel=kernel)
+    kw = {}
+    if role == "leopard":
+        # leopard cells replay the nested-groups shape at the smoke
+        # size cap, so membership-only fragments actually materialize
+        from spicedb_kubeapi_proxy_tpu.fuzz.scenarios import (
+            NESTED_GROUPS_SMOKE_BIAS)
+        kw["schema_bias"] = NESTED_GROUPS_SMOKE_BIAS
+    case = build_case(seed, smoke=True, kernel=kernel, **kw)
     divs = run_case(case, gates=gates, role=role, checkpoints="final")
     return {"seed": seed, "gates": gates, "role": role, "kernel": kernel,
             "elapsed": time.time() - t0,
@@ -109,7 +118,12 @@ def _shrink_and_report(seed: int, smoke: bool = True,
     from spicedb_kubeapi_proxy_tpu.fuzz.shrink import (
         delta_count, shrink_case, write_artifact)
     gates, role, kernel = cell_for(seed)
-    case = build_case(seed, smoke=smoke, kernel=kernel)
+    kw = {}
+    if role == "leopard":
+        from spicedb_kubeapi_proxy_tpu.fuzz.scenarios import (
+            NESTED_GROUPS_SMOKE_BIAS)
+        kw["schema_bias"] = NESTED_GROUPS_SMOKE_BIAS
+    case = build_case(seed, smoke=smoke, kernel=kernel, **kw)
     divs = run_case(case, gates=gates, role=role, checkpoints=checkpoints,
                     stop_on_first=True)
     if not divs:
@@ -148,36 +162,44 @@ def run_fixed_set(n_seeds: int, workers: int, time_box: float) -> int:
     # must survive python -O and scale with --seeds).  The expectation
     # is INDEPENDENT of smoke_cell_for — derived from the documented
     # walk (seeds 0..24 = classic 3x3 matrix, 25..26 = sharded2 cells
-    # alternating off/full, >= 27 = mesh cells alternating off/full) —
-    # so a regression in the seed->cell map itself trips here instead
-    # of validating its own output.
+    # alternating off/full, 27..28 = mesh cells alternating off/full,
+    # >= 29 = leopard cells alternating off/full) — so a regression in
+    # the seed->cell map itself trips here instead of validating its
+    # own output.
     n_classic = min(n_seeds, 25)
     n_sharded = min(max(0, n_seeds - 25), 2)
-    n_mesh = max(0, n_seeds - 27)
+    n_mesh = min(max(0, n_seeds - 27), 2)
+    n_leopard = max(0, n_seeds - 29)
     classic_hit = {c: v for c, v in cells_hit.items()
-                   if c[1] not in ("sharded2", "mesh")}
+                   if c[1] not in ("sharded2", "mesh", "leopard")}
     sharded_hit = {c: v for c, v in cells_hit.items()
                    if c[1] == "sharded2"}
     mesh_hit = {c: v for c, v in cells_hit.items()
                 if c[1] == "mesh"}
+    leopard_hit = {c: v for c, v in cells_hit.items()
+                   if c[1] == "leopard"}
     want_sharded = {k: v for k, v in (
         (("off", "sharded2"), (n_sharded + 1) // 2),
         (("full", "sharded2"), n_sharded // 2)) if v}
     want_mesh = {k: v for k, v in (
         (("off", "mesh"), (n_mesh + 1) // 2),
         (("full", "mesh"), n_mesh // 2)) if v}
+    want_leopard = {k: v for k, v in (
+        (("off", "leopard"), (n_leopard + 1) // 2),
+        (("full", "leopard"), n_leopard // 2)) if v}
     if (len(classic_hit) != min(9, n_classic)
             or sum(classic_hit.values()) != n_classic
             or any(v < max(1, n_classic // 9)
                    for v in classic_hit.values())
             or sharded_hit != want_sharded
-            or mesh_hit != want_mesh):
+            or mesh_hit != want_mesh
+            or leopard_hit != want_leopard):
         print(f"fuzz smoke: matrix coverage hole at --seeds {n_seeds}: "
               f"classic {dict(classic_hit)}, sharded {dict(sharded_hit)}, "
-              f"mesh {dict(mesh_hit)} "
+              f"mesh {dict(mesh_hit)}, leopard {dict(leopard_hit)} "
               f"(want {min(9, n_classic)} classic cells x >= "
               f"{max(1, n_classic // 9)}, sharded {dict(want_sharded)}, "
-              f"mesh {dict(want_mesh)})")
+              f"mesh {dict(want_mesh)}, leopard {dict(want_leopard)})")
         return 1
     if failed:
         for res in failed:
@@ -189,7 +211,7 @@ def run_fixed_set(n_seeds: int, workers: int, time_box: float) -> int:
         return 1
     print(f"fuzz smoke: {n_seeds} seeds x 3 gate combos x 3 replication "
           f"roles (+ {n_sharded} sharded2 router cells, + {n_mesh} mesh "
-          f"cells) AGREE in {elapsed:.1f}s")
+          f"cells, + {n_leopard} leopard cells) AGREE in {elapsed:.1f}s")
     if elapsed > time_box:
         print(f"fuzz smoke: exceeded the {time_box:.0f}s time box")
         return 1
@@ -287,11 +309,12 @@ def run_mutation_check(name: str, n_seeds: int) -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--seeds", type=int, default=29,
+    ap.add_argument("--seeds", type=int, default=31,
                     help="seeds 0..24 walk the classic 3x3 gate x role "
                          "matrix; seeds 25..26 are the appended sharded2 "
-                         "(2-partition-leader router) cells; seeds 27+ "
-                         "are the mesh (2x2 virtual-device) cells")
+                         "(2-partition-leader router) cells; seeds 27..28 "
+                         "are the mesh (2x2 virtual-device) cells; seeds "
+                         "29+ are the leopard (indexed vs gate-off) cells")
     ap.add_argument("--workers", type=int, default=2)
     ap.add_argument("--time-box", type=float, default=90.0,
                     help="hard wall-clock bound for the fixed set "
@@ -300,7 +323,8 @@ def main() -> int:
     ap.add_argument("--budget-seconds", type=float, default=0.0)
     ap.add_argument("--budget-start", type=int, default=1000)
     ap.add_argument("--scenario", default="", choices=(
-        "", "caveat-heavy", "wildcard-public", "ephemeral-grants"),
+        "", "caveat-heavy", "wildcard-public", "ephemeral-grants",
+        "nested-groups"),
         help="steer the budgeted search with a scenario bias profile")
     ap.add_argument("--replay", default="")
     ap.add_argument("--mutation", default="",

@@ -30,7 +30,16 @@ endpoint built over the store a REPLICATION ROLE produces:
                 single-device endpoint answer every query over the same
                 store — a mesh-vs-single disagreement fails the replay
                 loudly, and the mesh answer is then compared against
-                the host oracle like any other cell.
+                the host oracle like any other cell;
+- `leopard`    a single leader store served by another THREE-way
+                differential: a Leopard-indexed endpoint (the
+                LeopardIndex gate forced ON at construction, so
+                membership-only fragments answer from the materialized
+                closure planes — ops/leopard.py) and a gate-OFF
+                endpoint (pure kernel sweeps) answer every query over
+                the same store; an indexed-vs-plain disagreement fails
+                the replay loudly, and the indexed answer is then
+                compared against the host oracle like any other cell.
 
 After every burst, every query in the case's query stream is answered
 by the device endpoint (optionally behind a DecisionCacheEndpoint) and
@@ -100,7 +109,14 @@ SHARDED_ROLE = "sharded2"
 # against a single-device endpoint over the same store, and the mesh
 # answers are compared against the host oracle like any other cell
 MESH_ROLE = "mesh"
-ALL_ROLES = ROLES + (SHARDED_ROLE, MESH_ROLE)
+
+# Leopard materialized group index (ops/leopard.py): the case replays
+# through a gate-ON endpoint (closure-plane fast path + incremental
+# maintenance under the delta stream) differentially checked against a
+# gate-OFF endpoint over the same store, and the indexed answers are
+# compared against the host oracle like any other cell
+LEOPARD_ROLE = "leopard"
+ALL_ROLES = ROLES + (SHARDED_ROLE, MESH_ROLE, LEOPARD_ROLE)
 
 SMOKE_KERNELS = ("segment", "ell")
 
@@ -114,11 +130,16 @@ def smoke_cell_for(seed: int) -> tuple:
     seeds 0..24 walk the classic 3x3 gate x role matrix (every cell
     covered >= 2x) with the kernel alternating on top; seeds 25..26 are
     the appended `sharded2` cells (router over 2 partition leaders,
-    off/full gates, kernels alternating); seeds >= 27 are the `mesh`
+    off/full gates, kernels alternating); seeds 27..28 are the `mesh`
     cells (2x2 virtual-device mesh vs single-device vs oracle, off/full
-    gates, ell kernel only — the mesh path requires it).  Shared by
-    scripts/fuzz_smoke.py and the mutation-check tests so 'the fixed
-    seed set' means one thing."""
+    gates, ell kernel only — the mesh path requires it); seeds >= 29
+    are the `leopard` cells (Leopard-indexed vs gate-off vs oracle,
+    off/full gates, kernels alternating, nested-groups schema bias).
+    Shared by scripts/fuzz_smoke.py and the mutation-check tests so
+    'the fixed seed set' means one thing."""
+    if seed >= 29:
+        return (SMOKE_SHARDED_GATES[(seed - 29) % 2], LEOPARD_ROLE,
+                SMOKE_KERNELS[seed % 2])
     if seed >= 27:
         return (SMOKE_SHARDED_GATES[(seed - 27) % 2], MESH_ROLE, "ell")
     if seed >= 25:
@@ -240,9 +261,9 @@ class _RoleHarness:
         self._promoted = False
         self.pmap = None               # sharded2: the partition map
         self.shard_stores: list = []   # sharded2: per-shard stores
-        if role in ("leader", MESH_ROLE):
-            # mesh: same single-store topology as leader; the endpoint
-            # pair (mesh + single-device reference) is built later
+        if role in ("leader", MESH_ROLE, LEOPARD_ROLE):
+            # mesh/leopard: same single-store topology as leader; the
+            # differential endpoint pair is built later
             self.query_store = self.leader
             self.hops = []
         elif role == "follower2":
@@ -418,6 +439,26 @@ class _RoleHarness:
             return _MeshDifferentialEndpoint(
                 mesh_ep, JaxEndpoint(schema, store=self.query_store,
                                      kernel=kernel))
+        if self.role == LEOPARD_ROLE:
+            # the LeopardIndex gate is captured at endpoint
+            # construction, so an ON endpoint and an OFF endpoint can
+            # coexist over the same store — the on-vs-off differential
+            prev = GATES.enabled("LeopardIndex")
+            try:
+                GATES.set("LeopardIndex", True)
+                leo_ep = JaxEndpoint(schema, store=self.query_store,
+                                     kernel=kernel)
+                GATES.set("LeopardIndex", False)
+                plain_ep = JaxEndpoint(schema, store=self.query_store,
+                                       kernel=kernel)
+            finally:
+                GATES.set("LeopardIndex", prev)
+            if cache_on:
+                from ..spicedb.decision_cache import DecisionCacheEndpoint
+                leo_ep = DecisionCacheEndpoint(leo_ep)
+            # the gate-off reference stays bare: an independent checker,
+            # not a second copy of the cell's gate combo
+            return _LeopardDifferentialEndpoint(leo_ep, plain_ep)
         ep = JaxEndpoint(schema, store=self.query_store, kernel=kernel)
         if cache_on:
             from ..spicedb.decision_cache import DecisionCacheEndpoint
@@ -466,6 +507,50 @@ class _MeshDifferentialEndpoint:
                     f"mesh vs single-device check divergence for "
                     f"{req}: mesh={g.permissionship.name} "
                     f"single={s.permissionship.name}")
+        return got
+
+
+class _LeopardDifferentialEndpoint:
+    """Three-way differential shim for the `leopard` role: every query
+    runs on the Leopard-indexed endpoint AND a gate-off endpoint over
+    the same store.  An indexed-vs-plain disagreement fails the replay
+    loudly (same contract as the mesh differential); the indexed answer
+    is what the driver then compares against the host oracle, so all
+    three pairwise comparisons are covered."""
+
+    def __init__(self, leo_ep, plain_ep):
+        self._leo = leo_ep
+        self._plain = plain_ep
+
+    def warm_start(self) -> None:
+        self._leo.warm_start()
+        self._plain.warm_start()
+
+    def wait_rebuilds(self) -> None:
+        for ep in (self._leo, self._plain):
+            wait = getattr(ep, "wait_rebuilds", None)
+            if wait is not None:
+                wait()
+
+    async def lookup_resources(self, rtype, perm, subject):
+        got = await self._leo.lookup_resources(rtype, perm, subject)
+        ref = await self._plain.lookup_resources(rtype, perm, subject)
+        if sorted(got) != sorted(ref):
+            raise AssertionError(
+                f"leopard-indexed vs gate-off lookup divergence for "
+                f"{rtype}#{perm}@{subject}: indexed={sorted(got)} "
+                f"plain={sorted(ref)}")
+        return got
+
+    async def check_bulk_permissions(self, reqs):
+        got = await self._leo.check_bulk_permissions(reqs)
+        ref = await self._plain.check_bulk_permissions(reqs)
+        for req, g, p in zip(reqs, got, ref):
+            if g.permissionship != p.permissionship:
+                raise AssertionError(
+                    f"leopard-indexed vs gate-off check divergence for "
+                    f"{req}: indexed={g.permissionship.name} "
+                    f"plain={p.permissionship.name}")
         return got
 
 

@@ -1,10 +1,11 @@
-"""The three first-class bench scenario workloads (ROADMAP item 5) and
-their fuzzer bias profiles.
+"""The first-class bench scenario workloads (ROADMAP item 5, extended
+by the Leopard group-explosion shape) and their fuzzer bias profiles.
 
 Each scenario ships twice:
 
 - as a bench config (`bench.py --config caveat-heavy | wildcard-public |
-  ephemeral-grants`, riding `--all`) with a HOST-ORACLE PARITY REFEREE:
+  ephemeral-grants | group-explosion`, riding `--all`) with a
+  HOST-ORACLE PARITY REFEREE:
   every churn round re-derives a reference frontier with the recursive
   evaluator over the same store and counts divergences (acceptance: 0);
 - as a (SchemaBias, DeltaBias) pair that steers the random fuzzer's
@@ -27,6 +28,13 @@ The workloads:
   stressing the PR 3 expiry heap + decision-cache invalidation, PR 8
   rebuild absorption, and (via the fuzzer's follower roles) PR 9/11
   replica expiry reseeding all at once.
+- **group-explosion / nested-groups**  deep recursive group nesting at
+  scale: 100k groups chained depth 8+ under pure union/userset/arrow
+  rewrites — the exact shape the Leopard materialized closure index
+  (ops/leopard.py) flattens to one AND+popcount.  The bench config is
+  named `group-explosion`; the fuzzer bias profile steering the random
+  generators toward the same shape (membership-only subgraphs, deep
+  userset chains, near-zero caveats/wildcards) is `nested-groups`.
 """
 
 from __future__ import annotations
@@ -74,6 +82,18 @@ definition doc {
   relation owner: user
   relation grant: user with expiration | task
   permission view = owner + grant + grant->runner
+}
+"""
+
+
+GROUP_EXPLOSION_SCHEMA = """
+definition user {}
+definition group {
+  relation member: user | group#member
+}
+definition doc {
+  relation viewer: group#member | user
+  permission view = viewer
 }
 """
 
@@ -162,6 +182,38 @@ def ephemeral_grants(n_docs: int = 3000, n_users: int = 300,
                     expected_objects=n_docs)
 
 
+def group_explosion(n_groups: int = 100_000, n_users: int = 2_000,
+                    n_docs: int = 5_000, depth: int = 8,
+                    seed: int = 15) -> Workload:
+    """Leopard's headline shape: `n_groups` groups arranged in disjoint
+    membership chains of length `depth` (every user membership enters at
+    the chain TAIL, so reaching a chain-head group — and any doc shared
+    with it — costs `depth` userset hops), docs shared with chain
+    heads.  Pure union/userset rewrites: every pair is
+    Leopard-eligible, so the index collapses the depth-8 walk to one
+    closure-plane probe."""
+    rng = random.Random(seed)
+    n_chains = max(1, n_groups // depth)
+    rels = set()
+    for c in range(n_chains):
+        base = c * depth
+        for i in range(depth - 1):
+            # members of g{base+i+1} are members of g{base+i}: the
+            # chain HEAD (g{base}) is `depth` hops from the user
+            rels.add(f"group:g{base + i}#member"
+                     f"@group:g{base + i + 1}#member")
+        rels.add(f"group:g{base + depth - 1}#member@user:u{c % n_users}")
+    for d in range(n_docs):
+        head = rng.randrange(n_chains) * depth
+        rels.add(f"doc:d{d}#viewer@group:g{head}#member")
+    return Workload(name="group-explosion",
+                    schema_text=GROUP_EXPLOSION_SCHEMA,
+                    relationships=sorted(rels),
+                    subjects=[f"u{i}" for i in range(n_users)],
+                    resource_type="doc", permission="view",
+                    expected_objects=n_docs)
+
+
 # fuzzer bias profiles: the budgeted random search steered toward each
 # scenario's shape (scripts/fuzz_smoke.py --scenario)
 SCENARIO_BIASES = {
@@ -174,10 +226,28 @@ SCENARIO_BIASES = {
     "ephemeral-grants": (
         SchemaBias(expiration=0.5, caveat=0.08, wildcard=0.05),
         DeltaBias(short_ttl=0.6, expired=0.1, advance=0.35)),
+    # the Leopard shape: membership-only subgraphs (deep usersets and
+    # arrows, near-zero caveat/wildcard/expiration so fragments stay
+    # eligible, SOME exclusion/intersection so the planner's
+    # ineligibility edges get hammered too) under delete-heavy churn
+    # (the quarantine -> background re-close path)
+    "nested-groups": (
+        SchemaBias(userset=0.65, arrow=0.6, caveat=0.04, wildcard=0.03,
+                   expiration=0.04, exclusion=0.08, intersection=0.06),
+        DeltaBias(delete=0.4, caveat_boost=0.3, wildcard_boost=0.3,
+                  short_ttl=0.05, expired=0.05, bulk=0.12)),
 }
+
+# the fixed-seed leopard smoke cells run the same shape universe at the
+# smoke size cap (cheap kernel compiles, same contract as SMOKE_BIAS)
+NESTED_GROUPS_SMOKE_BIAS = SchemaBias(
+    userset=0.65, arrow=0.6, caveat=0.04, wildcard=0.03, expiration=0.04,
+    exclusion=0.08, intersection=0.06, n_types=(2, 2, 2),
+    n_rels=(2, 2, 3), n_perms=(1, 1, 2), expr_depth=1)
 
 SCENARIO_WORKLOADS = {
     "caveat-heavy": caveat_heavy,
     "wildcard-public": wildcard_public,
     "ephemeral-grants": ephemeral_grants,
+    "group-explosion": group_explosion,
 }

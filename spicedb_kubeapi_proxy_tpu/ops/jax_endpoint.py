@@ -53,6 +53,7 @@ from typing import Iterable, Optional
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.features import leopard_enabled as _leopard_on
 from ..utils.features import pipeline_enabled as _pipeline_on
 from ..utils.failpoints import fail_point
 
@@ -369,7 +370,7 @@ class _GenState:
     __slots__ = ("_graph", "_graph_revision", "_spare_pool",
                  "_assigned_refs", "_spare_seq", "_caveated_pairs",
                  "_caveat_affected", "_caveated_keys", "_expiry_heap",
-                 "_expiry_meta", "_stale_pairs")
+                 "_expiry_meta", "_stale_pairs", "_leopard")
 
     def __init__(self):
         self._graph = None
@@ -383,6 +384,7 @@ class _GenState:
         self._expiry_heap: list = []
         self._expiry_meta: dict = {}
         self._stale_pairs: set = set()
+        self._leopard = None
 
 
 def _start_readback(dev, batch_id, bucket: int, sweep_bytes: int,
@@ -1321,7 +1323,8 @@ class JaxEndpoint(PermissionsEndpoint):
                       "spare_reclaims": 0, "explain_checks": 0,
                       "bg_rebuilds": 0, "preemptive_rebuilds": 0,
                       "rebuild_failures": 0, "stale_pair_marks": 0,
-                      "stale_routed": 0}
+                      "stale_routed": 0, "leopard_checks": 0,
+                      "leopard_lookups": 0, "leopard_recloses": 0}
         # off-loop rebuild state (AsyncRebuild gate; docs/performance.md
         # "Overload & rebuild behavior").  While a background rebuild is
         # in flight the OLD generation keeps serving: deltas it can
@@ -1364,6 +1367,17 @@ class JaxEndpoint(PermissionsEndpoint):
         # HBM-ledger graph generation: bumped per rebuild; the outgoing
         # generation's buffers are retired wholesale (utils/devtel.py)
         self._devtel_gen = 0
+        # Leopard materialized group index (ops/leopard.py, LeopardIndex
+        # gate): the gate is evaluated ONCE, at construction — like a
+        # configured mesh — so differential harnesses can hold an
+        # index-on and an index-off endpoint in the same process.  The
+        # index itself is a per-generation artifact (built with the
+        # candidate off-lock, swapped in _install_candidate).
+        self._leopard_wanted = _leopard_on()
+        self._leopard = None
+        # in-flight background re-close futures (delete-quarantine
+        # recovery); wait_rebuilds drains them for test quiescence
+        self._leo_futures: list = []
         self.store.add_delta_listener(self._on_delta)
         self.store.add_reset_listener(self._on_reset)
 
@@ -1574,6 +1588,20 @@ class JaxEndpoint(PermissionsEndpoint):
             graph.index_tuples(tuples)
             self._reset_expiry(st, tuples)
         st._graph = graph
+        if self._leopard_wanted:
+            # Leopard closure materialization rides the candidate build:
+            # off-lock like the compile, consistent with the captured
+            # snapshot (the closure is seeded from the compiled edge
+            # arrays, so it reflects exactly st._graph_revision).  Hot
+            # pairs the runtime detector flagged are materialized first
+            # so the byte budget goes to measured wins.
+            from .leopard import LeopardIndex
+            cand = tuple((c["resource_type"], c["permission"])
+                         for c in workload.WORKLOAD.leopard_candidates())
+            st._leopard = LeopardIndex.build(
+                self.schema, graph.prog,
+                caveat_affected=frozenset(st._caveat_affected),
+                mesh=self.mesh, candidate_order=cand)
         return st
 
     def _install_candidate(self, st: "_GenState", t_start: float,
@@ -1619,6 +1647,13 @@ class JaxEndpoint(PermissionsEndpoint):
         old_gen = self._devtel_gen
         self._devtel_gen = devtel.next_generation()
         added = _register_graph_buffers(st._graph, self._devtel_gen)
+        # the Leopard closure planes are generation artifacts like the
+        # graph tables: register under the incoming generation so the
+        # wholesale retire below reclaims the outgoing index too
+        self._leopard = st._leopard
+        if st._leopard is not None:
+            added += st._leopard.register_ledger(self._devtel_gen)
+            workload.WORKLOAD.note_leopard_status(st._leopard.status_map())
         freed = devtel.LEDGER.retire_generation(old_gen) if old_gen else 0
         # timeline: the rebuild span covers build start -> swap.  Off-
         # loop modes tag background=True so stall attribution can tell
@@ -1766,6 +1801,24 @@ class JaxEndpoint(PermissionsEndpoint):
         if st is self:  # not candidate replay (see _assign_spare)
             self.stats["spare_reclaims"] += 1
 
+    def _leo_insert(self, st, graph, rel, key) -> None:
+        """Mirror a definite tuple the device graph just absorbed into
+        the generation's Leopard closure (ops/leopard.py); no-op when no
+        index was built for this generation."""
+        lp = st._leopard
+        if lp is not None:
+            lp.apply_insert(key, self._edge_endpoints(graph.prog, rel))
+
+    def _leo_remove(self, st, graph, key) -> None:
+        """Mirror a removal into the Leopard closure BEFORE spare-row
+        reclaim renames the ids away (_note_key_removed): the closure's
+        local rows are keyed by the compiled state index, which the
+        rename re-purposes."""
+        lp = st._leopard
+        if lp is not None:
+            lp.apply_remove(key, self._edge_endpoints(
+                graph.prog, _rel_from_key(key)))
+
     def _ensure_ids_for(self, st, graph, rel: Relationship) -> bool:
         """Make every id a TOUCHed tuple names indexable, assigning spare
         rows to new ones; False (pool dry / unknown type combination)
@@ -1848,11 +1901,13 @@ class JaxEndpoint(PermissionsEndpoint):
                             stale |= self._stale_closure(rt, relation)
                             continue
                         st._caveated_keys.discard(key)
+                        self._leo_remove(st, graph, key)
                         self._note_key_removed(st, graph, key)
                         continue
                     if not graph.remove_key(key):
                         stale |= self._stale_closure(rt, relation)
                         continue
+                    self._leo_remove(st, graph, key)
                     self._note_key_removed(st, graph, key)
                 elif u.rel.caveat is not None:  # TOUCH, caveated
                     self._set_expiry(st, key, u.rel.expires_at)
@@ -1884,6 +1939,13 @@ class JaxEndpoint(PermissionsEndpoint):
                             stale |= self._stale_closure(rt, relation)
                             continue
                     # value False: no edges at all
+                    if st._leopard is not None:
+                        # a caveated tuple now lives on a fragment
+                        # relation: a closure bit cannot represent
+                        # CONDITIONAL, so the fragment retires for the
+                        # generation (the rebuild skips it via
+                        # caveat_affected)
+                        st._leopard.retire_relation((rt, relation))
                     self._note_key_applied(st, key)
                 else:  # TOUCH, definite
                     self._set_expiry(st, key, u.rel.expires_at)
@@ -1900,6 +1962,7 @@ class JaxEndpoint(PermissionsEndpoint):
                     if not graph.add_rel(u.rel):
                         stale |= self._stale_closure(rt, relation)
                         continue
+                    self._leo_insert(st, graph, u.rel, key)
                     self._note_key_applied(st, key)
         # expire lazily AFTER batch processing so expirations registered by
         # the batches just drained take effect this query; heap entries whose
@@ -1925,11 +1988,13 @@ class JaxEndpoint(PermissionsEndpoint):
                     stale |= self._stale_closure(key[0], key[2])
                     continue
                 st._caveated_keys.discard(key)
+                self._leo_remove(st, graph, key)
                 self._note_key_removed(st, graph, key)
                 continue
             if not graph.remove_key(key):
                 stale |= self._stale_closure(key[0], key[2])
                 continue
+            self._leo_remove(st, graph, key)
             self._note_key_removed(st, graph, key)
         if stale and st is self:  # not candidate replay (_assign_spare)
             self.stats["stale_pair_marks"] += len(stale)
@@ -1991,6 +2056,31 @@ class JaxEndpoint(PermissionsEndpoint):
             # new-object churn drains the spare pool dry, so the pool
             # refresh is never a request-visible event
             self._kick_background_rebuild("preemptive")
+        self._kick_leopard_recloses()
+
+    def _kick_leopard_recloses(self) -> None:
+        """Submit background re-closes for delete-quarantined Leopard
+        fragments (under self._lock).  Quarantined fragments already
+        route to the iterative kernel — which the delta path kept
+        correct — so the re-close is pure capacity recovery and shares
+        the rebuild executor."""
+        lp = self._leopard
+        if lp is None:
+            return
+        self._leo_futures = [f for f in self._leo_futures if not f.done()]
+        if self._leo_futures:
+            return  # one re-close wave at a time
+        pending = lp.reclose_pending()
+        if not pending:
+            return
+        for frag in pending:
+            try:
+                fut = _rebuild_pool().submit(lp.reclose, frag)
+            except BaseException:
+                break  # executor shut down at teardown: fragments stay
+                       # quarantined (kernel fallback remains correct)
+            self._leo_futures.append(fut)
+            self.stats["leopard_recloses"] += 1
 
     def _current_graph(self):
         self._apply_pending()
@@ -2177,11 +2267,23 @@ class JaxEndpoint(PermissionsEndpoint):
             with self._lock:
                 fut = self._bg_future
                 if fut is None:
-                    if not self._stale_pairs or not self._async_rebuild_on():
+                    # leopard re-closes ride the same quiescence contract
+                    leo = [f for f in self._leo_futures if not f.done()]
+                    if leo:
+                        fut = leo[0]
+                    elif (self._leopard is not None
+                            and self._leopard.reclose_pending()):
+                        self._kick_leopard_recloses()
+                        leo = [f for f in self._leo_futures
+                               if not f.done()]
+                        fut = leo[0] if leo else None
+                    elif (not self._stale_pairs
+                            or not self._async_rebuild_on()):
                         return True
-                    self._bg_not_before = 0.0
-                    self._kick_background_rebuild("background")
-                    fut = self._bg_future
+                    else:
+                        self._bg_not_before = 0.0
+                        self._kick_background_rebuild("background")
+                        fut = self._bg_future
             if fut is not None:
                 try:
                     fut.result(timeout=max(0.01,
@@ -2240,6 +2342,24 @@ class JaxEndpoint(PermissionsEndpoint):
         two-phase pair below is the dispatcher's pipelining surface."""
         return self._check_batch_finish(self._check_batch_capture(reqs))
 
+    def _leo_check_fill(self, leo_rows: list, results: list,
+                        rev: int) -> None:
+        """Answer closure-plane check rows: one word-gather per distinct
+        plane (fragment closures never carry CONDITIONAL, so the bit maps
+        exactly to {NO, HAS}_PERMISSION)."""
+        by_plane: dict = {}
+        for (i, view, row, col) in leo_rows:
+            by_plane.setdefault(id(view[0]), (view[0], []))[1].append(
+                (i, row, col))
+        for plane, items in by_plane.values():
+            rows = np.asarray([r for (_i, r, _c) in items], np.int32)
+            cls = np.asarray([c for (_i, _r, c) in items], np.int64)
+            words = np.asarray(plane[jnp.asarray(rows),
+                                     jnp.asarray(cls // 32)])
+            bits = (words >> (cls % 32).astype(np.uint32)) & np.uint32(1)
+            for (it, bit) in zip(items, bits):
+                results[it[0]] = (int(bit) * 2, rev)
+
     def _check_batch_capture(self, reqs: list) -> dict:
         bid = timeline.next_batch()
         with tracing.span("kernel.prepare", kind="check", batch=len(reqs)), \
@@ -2266,6 +2386,13 @@ class JaxEndpoint(PermissionsEndpoint):
             results: list[Optional[tuple]] = [None] * len(reqs)
             oracle_rows: list[int] = []  # positions needing host evaluation
             tri = getattr(graph, "tri_state_capable", False)
+            # Leopard closure-plane consult (ops/leopard.py): rows whose
+            # (type, permission) has a live flattened fragment answer
+            # with one bit-gather instead of the fixpoint sweep.  Views
+            # are immutable snapshots, so the gather below runs outside
+            # the lock like the kernel dispatch.
+            leo = self._leopard
+            leo_rows: list = []  # (i, view, row, col)
 
             for i, r in enumerate(reqs):
                 if (self._stale_pairs and (r.resource.type, r.permission)
@@ -2305,9 +2432,18 @@ class JaxEndpoint(PermissionsEndpoint):
                         # answer (source stays "kernel" below)
                         results[i] = (0, rev)
                     continue
+                if leo is not None:
+                    hit = leo.check_coords(
+                        r.resource.type, r.permission,
+                        int(q_arr[cols[r.subject]]), state_idx)
+                    if hit is not None:
+                        leo_rows.append((i,) + hit)
+                        continue
                 gather_idx.append(state_idx)
                 gather_col.append(cols[r.subject])
                 kernel_rows.append(i)
+            if leo_rows:
+                self.stats["leopard_checks"] += len(leo_rows)
             timeline.record("pack", "host", t_pack, batch=bid,
                             bucket=len(q_arr), nbytes=int(q_arr.nbytes))
             if kernel_rows:
@@ -2326,6 +2462,19 @@ class JaxEndpoint(PermissionsEndpoint):
         # queueing behind a hundreds-of-ms kernel hold.
         ctx = {"reqs": reqs, "results": results, "kernel_rows": kernel_rows,
                "oracle_rows": oracle_rows, "rev": rev, "batch_id": bid}
+        if leo_rows:
+            # one AND+popcount instead of N sweep iterations: the
+            # measured depth on indexed pairs is 1 by construction —
+            # recorded through note_batch so /debug/workload shows the
+            # collapse the index buys
+            with tracing.kernel_span("kernel.leopard", kind="check",
+                                     rows=len(leo_rows)) as a:
+                a["batch_id"] = bid
+                self._leo_check_fill(leo_rows, results, rev)
+            workload.WORKLOAD.note_batch(
+                workload.comp_rows([reqs[i] for (i, _v, _r, _c)
+                                    in leo_rows]), "check", 1, None)
+            leo.note_hits("check", len(leo_rows))
         if oracle_rows:
             workload.WORKLOAD.note_oracle(
                 workload.comp_rows([reqs[i] for i in oracle_rows]))
@@ -2513,6 +2662,7 @@ class JaxEndpoint(PermissionsEndpoint):
                      subject: SubjectRef, retry: bool = False) -> tuple:
         self.schema.definition(resource_type)  # raises like the oracle
         oracle = False
+        leo_hit = None  # (fragment view, closure column) when indexed
         bid = timeline.next_batch()
         with self._lock:
             graph = self._current_graph()
@@ -2554,7 +2704,19 @@ class JaxEndpoint(PermissionsEndpoint):
                     _forensic = (id(graph), self._graph_revision,
                                  self.stats.get("spare_assignments"),
                                  id(ids), threading.get_ident())
-                    self.stats["kernel_calls"] += 1
+                    # Leopard consult: a live fragment for this pair with
+                    # a closure column for this subject answers from the
+                    # plane (the view is immutable, read outside the lock)
+                    lp = self._leopard
+                    if lp is not None:
+                        frag = lp.lookup_frag(resource_type, permission)
+                        if frag is not None:
+                            lcol = int(frag.col_of[int(q_arr[col])])
+                            if lcol >= 0:
+                                leo_hit = (frag.view, lcol)
+                                self.stats["leopard_lookups"] += 1
+                    if leo_hit is None:
+                        self.stats["kernel_calls"] += 1
         if oracle:
             # host evaluation outside the lock (reads the live store)
             workload.WORKLOAD.note_oracle([(resource_type, permission, 1)])
@@ -2563,8 +2725,27 @@ class JaxEndpoint(PermissionsEndpoint):
                     self._oracle.lookup_resources(resource_type, permission,
                                                   subject),
                     source="oracle"), 0
-        # kernel + extraction outside the lock (immutable snapshot)
         comp = [(resource_type, permission, 1)]
+        if leo_hit is not None:
+            # closure-plane lookup: one word-column slice of the
+            # fragment plane replaces the fixpoint kernel (depth 1)
+            (plane, plane_rows), lcol = leo_hit
+            with tracing.kernel_span("kernel.leopard", kind="lookup") as a:
+                a["batch_id"] = bid
+                wordcol = np.asarray(plane[:plane_rows, lcol // 32])
+                idx = np.nonzero((wordcol >> np.uint32(lcol % 32))
+                                 & np.uint32(1))[0]
+            workload.WORKLOAD.note_batch(
+                comp, "lookup", 1, 1 / len(q_arr) if len(q_arr) else None)
+            self._leopard.note_hits("lookup", 1)
+            t_ext = timeline.now()
+            out, bad_n, bad_sample = _ids_for(ids, idx, ph, mask)
+            timeline.record("extract", "host", t_ext, batch=bid)
+            if bad_n:
+                self._report_suppressed(bad_n, bad_sample, _forensic,
+                                        retry=retry)
+            return AnnotatedIds(out, source="kernel"), bad_n
+        # kernel + extraction outside the lock (immutable snapshot)
         with tracing.kernel_span("kernel.device", kind="lookup",
                                  bucket=len(q_arr)) as a:
             a["batch_id"] = bid
@@ -2640,6 +2821,7 @@ class JaxEndpoint(PermissionsEndpoint):
         double-buffer drain, spicedb/dispatch.py)."""
         self.schema.definition(resource_type)
         all_oracle = False
+        leo = None  # (fragment view, {query col -> closure col}) if indexed
         bid = timeline.next_batch()
         with self._lock:
             graph = self._current_graph()
@@ -2669,10 +2851,29 @@ class JaxEndpoint(PermissionsEndpoint):
                 _forensic = (id(graph), self._graph_revision,
                              self.stats.get("spare_assignments"),
                              id(ids), threading.get_ident())
-                self.stats["kernel_calls"] += 1
-                devtel.LEDGER.note_scratch(
-                    int(q_arr.nbytes)
-                    + rng[1] * max(1, len(q_arr) // 32) * 4)
+                # Leopard consult (mirrors _lookup_once): a live fragment
+                # with a closure column for EVERY known subject answers
+                # the whole batch from the plane — unknown subjects route
+                # to the oracle per-subject at extract time either way
+                lp = self._leopard
+                if lp is not None:
+                    frag = lp.lookup_frag(resource_type, permission)
+                    if frag is not None:
+                        lcols: Optional[dict] = {}
+                        for s, col in cols.items():
+                            lcol = int(frag.col_of[int(q_arr[col])])
+                            if lcol < 0:
+                                lcols = None
+                                break
+                            lcols[col] = lcol
+                        if lcols is not None:
+                            leo = (frag.view, lcols)
+                            self.stats["leopard_lookups"] += len(lcols)
+                if leo is None:
+                    self.stats["kernel_calls"] += 1
+                    devtel.LEDGER.note_scratch(
+                        int(q_arr.nbytes)
+                        + rng[1] * max(1, len(q_arr) // 32) * 4)
         ctx = {"rt": resource_type, "perm": permission, "subjects": subjects,
                "batch_id": bid}
         if all_oracle:
@@ -2680,9 +2881,18 @@ class JaxEndpoint(PermissionsEndpoint):
                 [(resource_type, permission, len(subjects))])
             ctx["all_oracle"] = True
             return ctx
-        # kernel dispatch outside the lock (immutable snapshot)
         comp = [(resource_type, permission, len(subjects))]
         occ = used / len(q_arr) if len(q_arr) else None
+        if leo is not None:
+            # closure-plane batch: word-column slices of the fragment
+            # plane replace the fixpoint kernel (measured depth 1)
+            ctx["leopard"] = leo
+            workload.WORKLOAD.note_batch(comp, "lookup", 1, occ)
+            self._leopard.note_hits("lookup", len(leo[1]))
+            ctx.update(cols=cols, unknown=unknown, ids=ids, mask=mask,
+                       ph=ph, forensic=_forensic)
+            return ctx
+        # kernel dispatch outside the lock (immutable snapshot)
         pipe = None
         if _pipeline_on():
             pipe = (getattr(graph, "run_lookup_packed_T_device", None)
@@ -2754,7 +2964,25 @@ class JaxEndpoint(PermissionsEndpoint):
                                 ctx["rt"], ctx["perm"], s),
                             source="oracle")
                         for s in ctx["subjects"]], 0
-        if "readback" in ctx:
+        if "leopard" in ctx:
+            # closure-plane batch: read each needed word column of the
+            # fragment plane once (columns are shared across subjects)
+            (plane, plane_rows), lcols = ctx["leopard"]
+            with tracing.kernel_span("kernel.leopard",
+                                     kind="lookup_batch") as a:
+                a["batch_id"] = ctx.get("batch_id")
+                word_cols = {}
+                for lcol in lcols.values():
+                    w = lcol // 32
+                    if w not in word_cols:
+                        word_cols[w] = np.asarray(plane[:plane_rows, w])
+
+            def col_indices(col):
+                lcol = lcols[col]
+                return np.nonzero((word_cols[lcol // 32]
+                                   >> np.uint32(lcol % 32))
+                                  & np.uint32(1))[0]
+        elif "readback" in ctx:
             # pipelined path: the device already transposed; block on the
             # waiter future (kernel + transfer timeline slices were
             # recorded by the waiter thread — this span only attributes

@@ -376,7 +376,22 @@ class ShardedEllKernel:
         self._q_spec = NamedSharding(mesh, P("data"))
         self._arenas: dict = {}
         self._arena_lock = threading.Lock()
+        # Collective executions (the shard_map programs and sharded
+        # scatters) must not interleave across host threads: two
+        # concurrent launches can pair device A's all_gather with
+        # device B's from the OTHER program and deadlock the per-device
+        # rendezvous (observed as both callers parked forever in the
+        # D2H readback).  Every device execution takes this lock and
+        # drains the program before releasing.
+        self._dispatch_lock = threading.Lock()
         self.devtel_generation = 0
+
+    def _run_collective(self, fn, *args):
+        """Execute one sharded program under the dispatch lock and block
+        until every per-device buffer is done before the next program
+        may launch."""
+        with self._dispatch_lock:
+            return jax.block_until_ready(fn(*args))
 
     def update_cav_rows(self, rows: np.ndarray, vals: np.ndarray) -> None:
         """Incremental MAYBE-plane table edits.  Host tables are in compile
@@ -399,10 +414,15 @@ class ShardedEllKernel:
 
     def _scatter_rows(self, arr, rows: np.ndarray, vals: np.ndarray):
         rows, vals = pad_scatter(np.asarray(rows), np.asarray(vals))
-        out = arr.at[jnp.asarray(rows)].set(jnp.asarray(vals))
-        # keep the row sharding stable regardless of what the scatter's
-        # output sharding propagation decided
-        return jax.device_put(out, self._row_spec)
+
+        def scatter(a, r, v):
+            out = a.at[r].set(v)
+            # keep the row sharding stable regardless of what the
+            # scatter's output sharding propagation decided
+            return jax.device_put(out, self._row_spec)
+
+        return self._run_collective(scatter, arr, jnp.asarray(rows),
+                                    jnp.asarray(vals))
 
     def update_main_rows(self, rows: np.ndarray, vals: np.ndarray) -> None:
         self.idx_main = self._scatter_rows(self.idx_main, rows,
@@ -776,7 +796,9 @@ class ShardedEllKernel:
         q = jax.device_put(np.asarray(q_idx, np.int32), self._q_spec)
         args = [q, jnp.asarray(gather_idx), jnp.asarray(gather_col),
                 state, idx_main, idx_aux]
-        res = run_checks(*args, idx_cav) if self.planes else run_checks(*args)
+        if self.planes:
+            args.append(idx_cav)
+        res = self._run_collective(run_checks, *args)
         out, x, tel = res if intro else (res[0], res[1], None)
         self.put_arena(n_words, x)
         return out, tel
@@ -793,11 +815,11 @@ class ShardedEllKernel:
         state = self.take_arena(n_words)
         q = jax.device_put(np.asarray(q_idx, np.int32), self._q_spec)
         if self.planes:
-            res = run_lookup(slot_offset, slot_length, q, state,
-                             idx_main, idx_aux, idx_cav)
+            res = self._run_collective(run_lookup, slot_offset, slot_length,
+                                       q, state, idx_main, idx_aux, idx_cav)
         else:
-            res = run_lookup(slot_offset, slot_length, q, state,
-                             idx_main, idx_aux)
+            res = self._run_collective(run_lookup, slot_offset, slot_length,
+                                       q, state, idx_main, idx_aux)
         out, x, tel = res if intro else (res[0], res[1], None)
         self.put_arena(n_words, x)
         return out, tel
@@ -838,8 +860,8 @@ class ShardedEllKernel:
         q = jax.device_put(self._pad_q(np.asarray(q_idx, np.int32)),
                            NamedSharding(self.mesh, P("data")))
         return np.ascontiguousarray(
-            run_lookup(slot_offset, slot_length, q,
-                       *self._table_args(tables)))
+            self._run_collective(run_lookup, slot_offset, slot_length, q,
+                                 *self._table_args(tables)))
 
     def lookup(self, slot_offset: int, slot_length: int,
                q_idx: np.ndarray, tables=None) -> np.ndarray:
@@ -862,8 +884,8 @@ class ShardedEllKernel:
         gcol = np.zeros(g, np.int64)
         gi[: len(gather_idx)] = gather_idx
         gcol[: len(gather_col)] = gather_col
-        out = np.asarray(run_checks(
-            q, jnp.asarray(gi), jnp.asarray(gcol // 32),
+        out = np.asarray(self._run_collective(
+            run_checks, q, jnp.asarray(gi), jnp.asarray(gcol // 32),
             jnp.asarray((gcol % 32).astype(np.uint32)),
             *self._table_args(tables)))
         if self.planes:

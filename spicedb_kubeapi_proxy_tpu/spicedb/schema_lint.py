@@ -36,6 +36,13 @@ cache invalidates by — so the lint is cheap:
   SL008 (warn)   a partition map key naming a type absent from the
                  schema: tuples of a mistyped name silently route to
                  the default shard
+  SL009 (warn)   permission that is Leopard-eligible (pure
+                 group-membership fragment, ops/leopard.py) but whose
+                 estimated closure exceeds the configured byte budget
+                 (SPICEDB_TPU_LEOPARD_BUDGET_BYTES) at the assumed
+                 universe size (SPICEDB_TPU_LEOPARD_LINT_OBJECTS,
+                 default 100000 objects/type) — the pair stays on the
+                 iterative kernel and operators should know why
 
 Proxy-internal definitions (lock / workflow / activity — the dual-write
 engine's bookkeeping, spicedb/endpoints.py INTERNAL_SCHEMA) are exempt
@@ -49,11 +56,24 @@ Run via the CLI: `python -m spicedb_kubeapi_proxy_tpu --lint-schema
 
 from __future__ import annotations
 
+import os
 import re
 from dataclasses import dataclass
 
 from . import schema as sch
 from ..ops.graph_compile import relation_footprint
+
+# assumed per-type object count for the SL009 closure-size estimate
+LEOPARD_LINT_OBJECTS_ENV = "SPICEDB_TPU_LEOPARD_LINT_OBJECTS"
+DEFAULT_LEOPARD_LINT_OBJECTS = 100_000
+
+
+def _leopard_assumed_objects() -> int:
+    try:
+        return int(os.environ.get(LEOPARD_LINT_OBJECTS_ENV,
+                                  DEFAULT_LEOPARD_LINT_OBJECTS))
+    except ValueError:
+        return DEFAULT_LEOPARD_LINT_OBJECTS
 
 # definitions the dual-write engine owns (endpoints.INTERNAL_SCHEMA):
 # written/read by engine code, not by schema permissions
@@ -287,6 +307,32 @@ def lint_schema(schema: sch.Schema, rule_configs=(),
                 f"permission's footprint includes it and no proxy rule "
                 f"reads it — tuples written to it can never influence a "
                 f"decision"))
+
+    # -- SL009: Leopard-eligible fragments over the closure byte budget ------
+    from ..ops.leopard import (BUDGET_ENV, budget_bytes,
+                               estimate_fragment_bytes, fragment_is_nested)
+    budget = budget_bytes()
+    assumed = _leopard_assumed_objects()
+    for tname, d in sorted(schema.definitions.items()):
+        if tname in INTERNAL_TYPES:
+            continue
+        for pname in sorted(d.permissions):
+            # only nested fragments (userset/arrow chains) warn: a flat
+            # union gains nothing from flattening, so staying iterative
+            # is not a loss worth a finding
+            if not fragment_is_nested(schema, tname, pname):
+                continue
+            est = estimate_fragment_bytes(schema, tname, pname, assumed)
+            if est is not None and est > budget:
+                findings.append(Finding(
+                    "SL009", "warn", f"{tname}#{pname}",
+                    f"permission {tname}#{pname} is Leopard-eligible but "
+                    f"its estimated closure (~{est} bytes at {assumed} "
+                    f"objects per type) exceeds the configured budget "
+                    f"({budget} bytes, {BUDGET_ENV}) — the pair stays on "
+                    f"the iterative kernel; raise the budget (or lower "
+                    f"{LEOPARD_LINT_OBJECTS_ENV} if the assumed universe "
+                    f"overshoots) to let the index materialize it"))
 
     findings.sort(key=lambda f: (f.severity != "error", f.code, f.where))
     return findings

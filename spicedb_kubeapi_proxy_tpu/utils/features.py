@@ -166,6 +166,17 @@ GATES.register("Profiler", stage=ALPHA, default=True)
 # endpoint construction loudly (an authz proxy must not silently ignore
 # an explicitly configured topology).
 GATES.register("MeshExecution", stage=ALPHA, default=True)
+# Leopard-style materialized group index (ops/leopard.py,
+# ops/jax_endpoint.py): statically-proven group-membership fragments are
+# flattened into device-resident transitive-closure bitplanes consulted
+# before the iterative kernel (one AND+popcount instead of one fixpoint
+# iteration per nesting level), maintained incrementally from store
+# deltas with delete-quarantine + background re-close.  This gate is the
+# killswitch: off, no closure is planned or built and the check/lookup
+# ladders are byte-identical to the pre-index build.  The gate is
+# evaluated at endpoint construction (like a configured mesh): flipping
+# it mid-process affects endpoints built afterwards.
+GATES.register("LeopardIndex", stage=ALPHA, default=True)
 
 
 def mesh_enabled() -> bool:
@@ -186,3 +197,14 @@ def pipeline_enabled() -> bool:
         return GATES.enabled("DevicePipeline")
     except Exception:
         return True
+
+
+def leopard_enabled() -> bool:
+    """LeopardIndex gate accessor; unknown-gate errors fail CLOSED —
+    unlike the mesh/pipeline accessors, the safe degraded mode for a
+    stripped registry is the iterative kernel (no index is always
+    correct, it is only slower)."""
+    try:
+        return GATES.enabled("LeopardIndex")
+    except Exception:
+        return False

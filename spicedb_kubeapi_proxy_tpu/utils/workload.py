@@ -96,6 +96,7 @@ class WorkloadAccounting:
         self._attributed_s = 0.0       # seconds split onto pairs
         self._schema = None            # most recent endpoint schema
         self._footprints: dict = {}    # (type, perm) -> frozenset
+        self._leopard_status: dict = {}  # "type#perm" -> index status
         self._tls = threading.local()  # per-thread last SweepRecord
         self._sweep_iters = registry.histogram(
             "authz_sweep_iterations",
@@ -245,6 +246,14 @@ class WorkloadAccounting:
             self._schema = schema
             self._footprints.clear()
 
+    def note_leopard_status(self, statuses: Optional[dict]) -> None:
+        """Per-pair Leopard index status ("type#perm" ->
+        `indexed | indexed(quarantined) | ineligible(reason)`), fed by
+        the endpoint at every index install (ops/leopard.py
+        `status_map`); surfaces in the /debug/workload rows."""
+        with self._lock:
+            self._leopard_status = dict(statuses or {})
+
     # -- Leopard-candidate detection ----------------------------------------
 
     def _footprint_locked(self, pair: tuple) -> frozenset:
@@ -318,12 +327,24 @@ class WorkloadAccounting:
     def payload(self) -> dict:
         """The /debug/workload body: per-pair rows (device-time-sorted),
         totals, and the attribution/σ(kernel histogram) reconciliation."""
+        candidates = self.leopard_candidates()
+        cand_pairs = {(c["resource_type"], c["permission"])
+                      for c in candidates}
         with self._lock:
             rows = []
             for (rtype, perm), r in self._rows.items():
                 routed = r["kernel_rows"] + r["oracle_rows"]
                 probes = r["cache_hits"] + r["cache_misses"]
+                # actionable Leopard status: installed-index verdicts win
+                # (indexed / ineligible(reason)); with no verdict — gate
+                # off, or no install yet — a detector-flagged pair shows
+                # `candidate` so operators see what an index would buy
+                leopard = self._leopard_status.get(f"{rtype}#{perm}")
+                if leopard is None:
+                    leopard = ("candidate" if (rtype, perm) in cand_pairs
+                               else "ineligible(unplanned)")
                 rows.append({
+                    "leopard": leopard,
                     "resource_type": rtype,
                     "permission": perm,
                     "device_s": round(r["device_s"], 6),
@@ -352,7 +373,7 @@ class WorkloadAccounting:
             "attribution_ratio": (round(attributed / total, 4)
                                   if total > 0 else None),
             "leopard_depth_threshold": LEOPARD_DEPTH,
-            "leopard_candidates": self.leopard_candidates(),
+            "leopard_candidates": candidates,
         }
 
     def reset(self) -> None:
@@ -360,6 +381,7 @@ class WorkloadAccounting:
             self._rows.clear()
             self._total_device_s = 0.0
             self._attributed_s = 0.0
+            self._leopard_status.clear()
 
 
 WORKLOAD = WorkloadAccounting()

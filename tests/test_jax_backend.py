@@ -505,7 +505,17 @@ class TestJaxEndpointBehavior:
         asyncio.run(run())
 
     def test_stats_track_kernel_usage(self):
-        jx, oracle = make_pair(GROUPS_SCHEMA, ["namespace:ns#viewer@user:alice"])
+        # this test asserts the fixpoint kernels' own accounting; keep the
+        # Leopard index out so the nested chain actually hits a kernel
+        from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+
+        prev = GATES.enabled("LeopardIndex")
+        GATES.set("LeopardIndex", False)
+        try:
+            jx, oracle = make_pair(
+                GROUPS_SCHEMA, ["namespace:ns#viewer@user:alice"])
+        finally:
+            GATES.set("LeopardIndex", prev)
         assert_agreement(jx, oracle, "namespace", "view", users("alice"))
         assert jx.stats["kernel_calls"] > 0
         assert jx.stats["rebuilds"] >= 1

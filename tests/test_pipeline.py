@@ -55,7 +55,15 @@ def make_pair(n_docs=24, n_users=6, n_groups=2, seed=3):
     """(jax endpoint, oracle) over a randomized doc/group graph."""
     rng = np.random.default_rng(seed)
     schema = sch.parse_schema(SCHEMA)
-    jx = JaxEndpoint(schema)
+    # these tests exercise the device-pipeline machinery (arenas,
+    # overlapped dispatch): keep the Leopard index out so batch lookups
+    # actually launch kernels instead of serving from the closure plane
+    prev = GATES.enabled("LeopardIndex")
+    GATES.set("LeopardIndex", False)
+    try:
+        jx = JaxEndpoint(schema)
+    finally:
+        GATES.set("LeopardIndex", prev)
     rels = []
     for g in range(n_groups):
         for u in range(n_users):
@@ -471,8 +479,14 @@ class TestOverlapE2E:
         dispatches — see docs/performance.md "pipeline depth".  One
         retry absorbs scheduler-noise flakes (precedent:
         test_device_batches_do_not_block_event_loop)."""
-        ep = create_endpoint("jax://?max_batch=8&pipeline_depth=3",
-                             Bootstrap(schema_text=SCHEMA))
+        # plane-served lookups would leave no kernel windows to overlap
+        prev = GATES.enabled("LeopardIndex")
+        GATES.set("LeopardIndex", False)
+        try:
+            ep = create_endpoint("jax://?max_batch=8&pipeline_depth=3",
+                                 Bootstrap(schema_text=SCHEMA))
+        finally:
+            GATES.set("LeopardIndex", prev)
         n_users = 96
         ep.store.bulk_load(
             [parse_relationship(f"doc:d{d}#viewer@user:u{d % n_users}")

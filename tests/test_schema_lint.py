@@ -359,3 +359,36 @@ def test_cli_lint_schema_partition_map(tmp_path, capsys):
                    "--shards", "2", "--partition-map",
                    "pod=1,namespace=1"])
     assert rc == 0
+
+
+def test_sl009_leopard_over_budget(monkeypatch):
+    """A pure group-membership permission whose estimated closure busts
+    the byte budget warns (the pair stays iterative); a comfortable
+    budget clears it, and ineligible fragments never fire."""
+    schema = sch.parse_schema("""
+definition user {}
+definition group {
+  relation member: user | group#member
+  permission view = member
+}
+definition doc {
+  relation viewer: user | group#member
+  relation banned: user
+  permission view = viewer
+  permission allowed = view - banned
+}
+""")
+    monkeypatch.setenv("SPICEDB_TPU_LEOPARD_LINT_OBJECTS", "100000")
+    monkeypatch.setenv("SPICEDB_TPU_LEOPARD_BUDGET_BYTES", "1024")
+    findings = lint_schema(schema)
+    sl009 = [f for f in findings if f.code == "SL009"]
+    assert sl009 and all(f.severity == "warn" for f in sl009)
+    wheres = {f.where for f in sl009}
+    assert {"group#view", "doc#view"} <= wheres
+    # `allowed` contains an exclusion: not Leopard-eligible, never warns
+    assert "doc#allowed" not in wheres
+    assert "SPICEDB_TPU_LEOPARD_BUDGET_BYTES" in sl009[0].message
+    # a comfortable budget clears the warning
+    monkeypatch.setenv("SPICEDB_TPU_LEOPARD_BUDGET_BYTES",
+                       str(64 << 30))
+    assert not [f for f in lint_schema(schema) if f.code == "SL009"]

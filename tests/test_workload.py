@@ -120,7 +120,14 @@ def segment_lookup_iterations(schema_text, rels, users=("deep", "flat")):
 
 def make_endpoint(depth=7):
     schema = sch.parse_schema(NESTED_SCHEMA)
-    ep = JaxEndpoint(schema)
+    # these tests measure the fixpoint kernels' own telemetry: keep the
+    # Leopard index out so the nested chain actually sweeps
+    prev = GATES.enabled("LeopardIndex")
+    GATES.set("LeopardIndex", False)
+    try:
+        ep = JaxEndpoint(schema)
+    finally:
+        GATES.set("LeopardIndex", prev)
     ep.store.write(touch(*chain_rels(depth)))
     return ep
 
