@@ -1164,6 +1164,10 @@ def bench_replica_scale(args) -> dict:
         HttpServer,
         json_response,
     )
+    from spicedb_kubeapi_proxy_tpu.utils.topology import (
+        WorkerFleet,
+        cpu_pair_ceiling,
+    )
     from spicedb_kubeapi_proxy_tpu.spicedb.persist import PersistenceManager
     from spicedb_kubeapi_proxy_tpu.spicedb.replication import ReplicationHub
     from spicedb_kubeapi_proxy_tpu.spicedb.types import (
@@ -1233,49 +1237,26 @@ def bench_replica_scale(args) -> dict:
                  "lookup_batch": spec["lookup_batch"],
                  "tuples": len(workload.relationships),
                  "cores": os.cpu_count()}
-    workers: list = []
+    # fixed per-replica CPU budget (1 core, single-threaded XLA) via
+    # the shared harness: production replicas are separate nodes, so
+    # the scaling claim is "aggregate throughput grows as replicas are
+    # added at a constant per-replica budget" — without the pin, one
+    # XLA intra-op pool eats every local core and the baseline is
+    # already machine-saturated, measuring contention, not scaling
+    fleet = WorkerFleet(name="replica-scale")
     try:
         stage(f"replica-scale: spawn + warm {max(fleet_sizes)} follower "
               f"processes")
-        # fixed per-replica CPU budget (1 core, single-threaded XLA):
-        # production replicas are separate nodes, so the scaling claim
-        # is "aggregate throughput grows as replicas are added at a
-        # constant per-replica budget" — without the pin, one XLA
-        # intra-op pool eats every local core and the baseline is
-        # already machine-saturated, measuring contention, not scaling
-        taskset = shutil.which("taskset")
-        ncores = os.cpu_count() or 1
-        env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_cpu_multi_thread_eigen=false "
-                             "intra_op_parallelism_threads=1",
-                   OMP_NUM_THREADS="1", OPENBLAS_NUM_THREADS="1")
         for i in range(max(fleet_sizes)):
             wspec = dict(spec, leader=leader_url, identity=f"replica-{i}")
-            cmd = [sys.executable, os.path.abspath(__file__),
-                   "--replica-worker", json.dumps(wspec)]
-            if taskset:
-                cmd = [taskset, "-c", str(i % ncores)] + cmd
-            workers.append(subprocess.Popen(
-                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                env=env, text=True, bufsize=1))
-        for w in workers:
-            line = w.stdout.readline()
-            assert line.strip() == "READY", f"worker said {line!r}"
+            fleet.spawn(
+                [sys.executable, os.path.abspath(__file__),
+                 "--replica-worker", json.dumps(wspec)],
+                pin=i, label=f"replica-{i}")
+        fleet.wait_ready()
 
         def window(n):
-            for w in workers[:n]:
-                w.stdin.write("RUN\n")
-                w.stdin.flush()
-            results = []
-            for w in workers[:n]:
-                while True:
-                    line = w.stdout.readline()
-                    if line.startswith("DONE "):
-                        results.append(json.loads(line[5:]))
-                        break
-                    if not line:
-                        raise AssertionError("worker died mid-run")
-            return results
+            return fleet.run_window(n)
 
         # interleaved rounds, median per fleet size (same methodology
         # as the pipeline-depth A/B): this box's background load drifts
@@ -1310,24 +1291,14 @@ def bench_replica_scale(args) -> dict:
                 f"(median of {aggs}), lag p50/p99 = "
                 f"{lag_p50}/{lag_p99} revisions")
     finally:
-        for w in workers:
-            try:
-                w.stdin.write("EXIT\n")
-                w.stdin.flush()
-            except OSError:
-                pass
-        for w in workers:
-            try:
-                w.wait(10)
-            except subprocess.TimeoutExpired:
-                w.kill()
+        fleet.shutdown()
         stop.set()
         lt.join(10)
         mgr.close()
         shutil.rmtree(tmp, ignore_errors=True)
 
     stage("replica-scale: CPU pair-scaling ceiling probe")
-    out["cpu_pair_scaling_ceiling"] = _cpu_pair_ceiling(taskset)
+    out["cpu_pair_scaling_ceiling"] = cpu_pair_ceiling()
 
     # scaling is estimated from PAIRED per-round ratios (windows inside
     # one round are adjacent in time), because ambient load on a shared
@@ -1354,33 +1325,6 @@ def bench_replica_scale(args) -> dict:
         f"{out.get('scaling_4x')}x on {out['cores']} cores "
         f"(n=1 round noise spread {out['noise_spread_1x']}x)")
     return out
-
-
-def _cpu_pair_ceiling(taskset) -> float:
-    """This box's measured 2-process CPU scaling ceiling: two pinned
-    pure-python burners over one, same pinning as the follower workers.
-    Throttled/oversubscribed CI vCPUs cap well below 2.0 (measured 1.57
-    on the 2-vCPU sandbox) — the replica scaling number cannot exceed
-    this no matter how perfect the replication path is, so the artifact
-    records it next to the raw scaling."""
-    burn = ("import time\nt0=time.time()\nn=0\n"
-            "while time.time()-t0<1.5:\n"
-            "    x=0\n"
-            "    for i in range(100000):\n"
-            "        x+=i*i\n"
-            "    n+=1\n"
-            "print(n)")
-
-    def spawn(pin):
-        cmd = [sys.executable, "-c", burn]
-        if taskset:
-            cmd = [taskset, "-c", str(pin)] + cmd
-        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
-
-    single = int(spawn(0).communicate(timeout=30)[0])
-    pair = [spawn(0), spawn(1)]
-    total = sum(int(p.communicate(timeout=30)[0]) for p in pair)
-    return round(total / max(single, 1), 2)
 
 
 # -- partitioned write scale-out (ISSUE 15) -----------------------------------
@@ -1574,6 +1518,10 @@ def bench_write_shard_scale(args) -> dict:
         merge_internal_definitions,
     )
     from spicedb_kubeapi_proxy_tpu.spicedb.sharding import PartitionMap
+    from spicedb_kubeapi_proxy_tpu.utils.topology import (
+        WorkerFleet,
+        cpu_pair_ceiling,
+    )
 
     spec = dict(SHARD_WORKER_SPEC)
     fleet_sizes = (1, 2, 4)
@@ -1603,54 +1551,33 @@ def bench_write_shard_scale(args) -> dict:
                  "wal_fsync": spec["wal_fsync"],
                  "partition_map_4": maps[4].describe(),
                  "cores": os.cpu_count()}
-    workers: list = []
+    # same fixed per-process budget as replica-scale, via the shared
+    # harness: production shard leaders are separate nodes, so the
+    # claim is "aggregate write throughput grows as shards are added
+    # at a constant per-shard budget"
+    fleet = WorkerFleet(name="write-shard-scale")
     try:
         stage(f"write-shard-scale: spawn + warm {max(fleet_sizes)} "
               f"shard-leader processes")
-        # same fixed per-process budget as replica-scale: production
-        # shard leaders are separate nodes, so the claim is "aggregate
-        # write throughput grows as shards are added at a constant
-        # per-shard budget"
-        taskset = shutil.which("taskset")
-        ncores = os.cpu_count() or 1
-        env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_cpu_multi_thread_eigen=false "
-                             "intra_op_parallelism_threads=1",
-                   OMP_NUM_THREADS="1", OPENBLAS_NUM_THREADS="1")
         for i in range(max(fleet_sizes)):
             wspec = dict(spec, identity=f"shard{i}",
                          data_dir=os.path.join(tmp, f"shard-{i}"))
-            cmd = [sys.executable, os.path.abspath(__file__),
-                   "--shard-worker", json.dumps(wspec)]
-            if taskset:
-                cmd = [taskset, "-c", str(i % ncores)] + cmd
-            workers.append(subprocess.Popen(
-                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                env=env, text=True, bufsize=1))
-        for w in workers:
-            line = w.stdout.readline()
-            assert line.strip() == "READY", f"worker said {line!r}"
+            fleet.spawn(
+                [sys.executable, os.path.abspath(__file__),
+                 "--shard-worker", json.dumps(wspec)],
+                pin=i, label=f"shard-{i}")
+        fleet.wait_ready()
 
         def window(n: int, tag: str) -> list:
             # ownership split the fleet-n partition map prescribes:
             # worker i writes the kube resources of classes c%n == i
             pmap = maps[n]
-            for i, w in enumerate(workers[:n]):
+            payloads = []
+            for i in range(n):
                 resources = [res for res, _ns, typ in SHARD_CLASSES
                              if pmap.shard_for_type(typ) == i]
-                w.stdin.write("RUN " + json.dumps(
-                    {"tag": tag, "resources": resources}) + "\n")
-                w.stdin.flush()
-            results = []
-            for w in workers[:n]:
-                while True:
-                    line = w.stdout.readline()
-                    if line.startswith("DONE "):
-                        results.append(json.loads(line[5:]))
-                        break
-                    if not line:
-                        raise AssertionError("shard worker died mid-run")
-            return results
+                payloads.append({"tag": tag, "resources": resources})
+            return fleet.run_window(n, payloads=payloads)
 
         # interleaved rounds, median per fleet size, paired per-round
         # scaling ratios — the replica-scale methodology (ambient load
@@ -1682,21 +1609,11 @@ def bench_write_shard_scale(args) -> dict:
                 f"aggregate (median of {aggs}), p99 "
                 f"{out['fleet'][str(n)]['dual_write_p99_ms']}ms")
     finally:
-        for w in workers:
-            try:
-                w.stdin.write("EXIT\n")
-                w.stdin.flush()
-            except OSError:
-                pass
-        for w in workers:
-            try:
-                w.wait(10)
-            except subprocess.TimeoutExpired:
-                w.kill()
+        fleet.shutdown()
         shutil.rmtree(tmp, ignore_errors=True)
 
     stage("write-shard-scale: CPU pair-scaling ceiling probe")
-    out["cpu_pair_scaling_ceiling"] = _cpu_pair_ceiling(taskset)
+    out["cpu_pair_scaling_ceiling"] = cpu_pair_ceiling()
 
     base_rounds = [sum(res["writes_per_s"] for res in results)
                    for results in acc[1]]
@@ -2463,6 +2380,21 @@ MESH_CONFIGS = {
     "mesh-scale": bench_mesh_scale,
 }
 
+# composed fleet topology (ISSUE 20): real multi-process fleets (shard
+# leaders x follower fan-out trees x the CLI router) under open-loop
+# load, via the shared harness (utils/topology.py) + scripts/
+# fleet_bench.py.  The parent never imports jax (members run embedded
+# endpoints), so these dispatch BEFORE the backend probe like
+# cpu-microbench.  Excluded from --all like OBS_CONFIGS: a fleet boot
+# is minutes of wall clock and its artifact is FLEET_rNN.json, not
+# BENCH.  Values are fleet_bench.py section names.
+FLEET_CONFIGS = {
+    "fleet-read-scale": "read_scale",
+    "fleet-write-scale": "write_scale",
+    "fleet-chaos": "chaos",
+    "fleet-topology": "full",
+}
+
 # decision-cache bench configs (ISSUE 3): run standalone via --config or
 # appended to the --all sweep artifact
 CACHE_CONFIGS = {
@@ -2501,6 +2433,7 @@ def _config_registry() -> dict:
         "replication": list(REPLICATION_CONFIGS),
         "write sharding": list(SHARDING_CONFIGS),
         "multi-chip mesh": list(MESH_CONFIGS),
+        "fleet topology": list(FLEET_CONFIGS),
         "scenario matrix": list(SCENARIO_CONFIGS),
         "observability": list(OBS_CONFIGS),
     }
@@ -2607,6 +2540,30 @@ def main() -> None:
             verdict = bd.compare(base, payload)
             bd.print_report(verdict, file=sys.stderr)
             sys.exit(1 if verdict["regressions"] else 0)
+        return
+
+    if args.config in FLEET_CONFIGS:
+        # composed-fleet config: multi-process members, no jax in the
+        # parent — dispatch before the backend probe (cpu-microbench
+        # precedent) and delegate to the fleet_bench section runner
+        stage(f"fleet config {args.config} (multi-process, no jax in "
+              f"parent)")
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "fleet_bench",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "fleet_bench.py"))
+        fb = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fb)
+        _STATE["metric"] = f"fleet {args.config}"
+        res = fb.run_section(FLEET_CONFIGS[args.config])
+        emit({"metric": _STATE["metric"],
+              "value": res.get("headline", 0.0),
+              "unit": res.get("headline_unit", "x"),
+              "platform": "cpu-multiprocess",
+              "baseline": "smallest fleet of the same shape under the "
+                          "same open-loop schedule (paired rounds)",
+              **res})
         return
 
     path_desc = (f"{args.batch}-subject direct batched call"

@@ -59,6 +59,10 @@ GATED_MODULES = (
     # killswitch (the endpoint only constructs the index when the gate
     # was on at build time)
     ("ops/leopard.py", "LeopardIndex"),
+    # tail explainer: pure report computation over the merged fleet
+    # view; the explain() entry point checks the gate itself, and the
+    # module keeps no state and ticks no metrics
+    ("utils/tailexplain.py", "TailExplain"),
 )
 
 _MUTATOR_METHODS = ("inc", "observe", "dec")

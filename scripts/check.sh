@@ -85,6 +85,21 @@ echo "   promote, rejoin; fleet trace through 3 tiers; sharded router)"
 # client-measured e2e latency; then the sharded write scale-out.
 JAX_PLATFORMS=cpu python scripts/replication_smoke.py
 
+echo "== fleet topology smoke (router + leader + follower, open-loop"
+echo "   load, /debug/tail p99 explainer, attribution vs client e2e)"
+# the ISSUE 20 stack end to end (docs/performance.md "Fleet topology
+# bench"): the shared ProcessFleet harness boots the smallest real
+# fleet (fake kube + shard leader + follower + CLI router fronting the
+# follower), the open-loop generator drives ~10s of mixed
+# filter/check/update load through it (coordinated-omission-free:
+# latencies charged to intended send times, scheduler lag exported as
+# authz_loadgen_lag_seconds), and the gate asserts (a) per-tier
+# /debug/fleet attribution reconciles with the client's own e2e wall
+# times (10% + slack, same bounds as the replication smoke) and (b)
+# /debug/tail serves a non-empty ranked tail report covering exactly
+# the _SERVING_STAGES stage set.  Runs even with --fast.
+JAX_PLATFORMS=cpu python scripts/fleet_smoke.py --fast
+
 echo "== device-telemetry smoke (/metrics + /debug/flight + /debug/timeline)"
 # the device-telemetry metric families (HBM ledger, jit-cache counters,
 # batch occupancy, SLO burn rates, dispatch-timeline stall/roofline/

@@ -1,0 +1,785 @@
+#!/usr/bin/env python
+"""Fleet topology bench: the FLEET_r01.json producer (ISSUE 20).
+
+Every number here is MEASURED on real process fleets booted through the
+shared `ProcessFleet` harness (utils/topology.py) and driven by the
+open-loop generator (utils/loadgen.py) — no projections:
+
+- **read_scale** — one shard leader under an 8-follower 2-level fan-out
+  tree (2 mids re-serving replication, 6 leaves), open-loop filtered
+  LISTs round-robin over the 2-mid subset vs all 8 followers, paired
+  rounds (A/B/A/B so drift hits both sides equally);
+- **write_scale** — 4 shard leaders (own WAL each, fsync=always) behind
+  CLI routers partitioned 1/2/4 ways over the same symmetric 4-class
+  schema, open-loop create churn per width, paired rounds;
+- **chaos** — open-loop create churn with a client-side acked-write
+  ledger; `kill -9` one shard leader mid-window (other shards keep
+  acking, the dead shard's 5xx are counted, not hidden), restart it on
+  the same data dir, then read every ledger entry back through the
+  router: the pass asserts ZERO lost acknowledged writes.  The read
+  fleet gets the failover flavor: kill the leader, promote a mid-tier
+  follower, and require the pre-kill acked write readable on the
+  promoted leader and its leaf subtree;
+- **attribution** — a mixed million-user zipfian workload (filtered
+  lists, checks, dual-write creates, watch churn, short-TTL
+  grant/revoke bursts) through the router, reconciling the merged
+  `/debug/fleet` per-tier attribution against the client's own e2e
+  wall times and embedding the `/debug/tail` p99 explainer report.
+
+`cpu_pair_ceiling()` is recorded next to every scaling number: on a
+throttled 2-vCPU CI box no fleet can scale past the box, and the
+artifact must say so rather than let a flat curve read as a replication
+bottleneck.
+
+bench.py dispatches `--config fleet-*` to `run_section(name)` here
+(names: read_scale, write_scale, chaos, full); `--out FLEET_r01.json`
+writes the full artifact.  `--parity OLD_BENCH` runs the migration
+parity check: the pre-harness bench.py replica-scale vs the migrated
+one, same box, numbers expected to agree.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (  # noqa: E402
+    H11Transport,
+    Headers,
+    Request,
+)
+from spicedb_kubeapi_proxy_tpu.utils import loadgen  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.utils.topology import (  # noqa: E402
+    FleetSpec,
+    ProcessFleet,
+    cpu_pair_ceiling,
+    http,
+)
+
+# -- workload shapes ----------------------------------------------------------
+
+READ_SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  permission view = creator
+}
+definition pod {
+  relation creator: user
+  permission view = creator
+}
+"""
+
+READ_RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+lock: Optimistic
+check: [{tpl: "namespace:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: delete-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [delete]}]
+lock: Optimistic
+update:
+  deleteByFilter:
+  - tpl: "pod:{{namespacedName}}#$resourceRelation@$subjectType:$subjectID"
+"""
+
+# four symmetric co-location classes (same shape bench.py's in-process
+# write-shard bench uses), each with list+create+delete rules so the
+# chaos ledger can be read back through the router per class
+CLASSES = (
+    ("pods", "podns", "pod"),
+    ("configmaps", "cfgns", "configmap"),
+    ("secrets", "secns", "secret"),
+    ("services", "svcns", "service"),
+)
+
+WRITE_SCHEMA = "definition user {}\n" + "\n".join(
+    f"definition {t} {{\n  relation creator: user\n"
+    f"  permission view = creator\n}}"
+    for _res, ns, typ in CLASSES for t in (ns, typ))
+
+_CLASS_RULE_TPL = """\
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {{name: list-{res}}}
+match: [{{apiVersion: v1, resource: {res}, verbs: [list]}}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{{{split_namespace(resourceId)}}}}"
+  fromObjectIDNameExpr: "{{{{split_name(resourceId)}}}}"
+  lookupMatchingResources: {{tpl: "{typ}:$#view@user:{{{{user.name}}}}"}}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {{name: create-{res}}}
+match: [{{apiVersion: v1, resource: {res}, verbs: [create]}}]
+lock: Optimistic
+check: [{{tpl: "{ns}:{{{{namespace}}}}#view@user:{{{{user.name}}}}"}}]
+update:
+  creates:
+  - tpl: "{typ}:{{{{namespacedName}}}}#creator@user:{{{{user.name}}}}"
+"""
+# (no delete rule here: the wildcard deleteByFilter template is opaque
+# to the router's rule->shard pinning — it would pin every class's
+# delete to the default shard and refuse to boot; the write-churn and
+# chaos workloads are create-only, and grant/revoke churn lives on the
+# single-shard read fleet where the pin cannot conflict)
+
+WRITE_RULES = "\n---\n".join(
+    _CLASS_RULE_TPL.format(res=res, ns=ns, typ=typ)
+    for res, ns, typ in CLASSES)
+
+# class i -> shard i%n; ns + tuple type co-located (the router's
+# relation-closure validation refuses split classes)
+PARTITION_MAPS = {
+    1: "",
+    2: "secns=1,secret=1,svcns=1,service=1",
+    4: "cfgns=1,configmap=1,secns=2,secret=2,svcns=3,service=3",
+}
+
+USERS = 1_000_000  # the zipfian per-user fan-in id space
+
+
+def stage(msg: str) -> None:
+    print(f"[fleet-bench] {msg}", file=sys.stderr, flush=True)
+
+
+def med(vals):
+    vs = sorted(vals)
+    return vs[len(vs) // 2] if vs else 0.0
+
+
+def obj_path(res: str, name: str = "") -> str:
+    base = f"/api/v1/namespaces/team-a/{res}"
+    return f"{base}/{name}" if name else base
+
+
+def obj_body(res: str, name: str) -> dict:
+    kind = {"pods": "Pod", "configmaps": "ConfigMap",
+            "secrets": "Secret", "services": "Service"}[res]
+    return {"apiVersion": "v1", "kind": kind,
+            "metadata": {"name": name, "namespace": "team-a"}}
+
+
+# -- open-loop drivers --------------------------------------------------------
+
+
+async def around_trip(transport, method: str, target: str,
+                      body=None) -> object:
+    h = Headers()
+    h.set("Accept", "application/json")
+    h.set("X-Remote-User", "alice")
+    raw = b""
+    if body is not None:
+        raw = json.dumps(body).encode()
+        h.set("Content-Type", "application/json")
+    req = Request(method=method, target=target, headers=h, body=raw)
+    # open-loop bench driver: latency belongs to the intended schedule;
+    # hop attribution is the serving fleet's, reconciled via /debug/fleet
+    return await transport.round_trip(req)  # noqa: A006(open-loop bench client)
+
+
+def run_schedule(urls: list, spec: loadgen.WorkloadSpec, issue=None,
+                 max_inflight: int = 96, extra_tasks=()) -> dict:
+    """One open-loop window: default issue = filtered LIST round-robin
+    over `urls`; returns the OpenLoopRunner report."""
+    transports = [H11Transport(u) for u in urls]
+
+    async def default_issue(ev: dict) -> None:
+        t = transports[ev["seq"] % len(transports)]
+        resp = await around_trip(t, "GET", obj_path("pods"))
+        if resp.status >= 400:
+            raise AssertionError(f"list -> HTTP {resp.status}")
+
+    runner = loadgen.OpenLoopRunner(issue or default_issue,
+                                    max_inflight=max_inflight)
+
+    async def drive():
+        extras = [asyncio.create_task(t()) for t in extra_tasks]
+        try:
+            return await runner.run(spec.schedule())
+        finally:
+            for e in extras:
+                if not e.done():
+                    e.cancel()
+            await asyncio.gather(*extras, return_exceptions=True)
+
+    return asyncio.run(drive())
+
+
+def seed_objects(router_url: str, res: str, n: int, tag: str) -> list:
+    names = []
+    for i in range(n):
+        name = f"{tag}-{i}"
+        status, _, body = http("POST", router_url + obj_path(res),
+                               user="alice", body=obj_body(res, name))
+        assert status in (200, 201), \
+            f"seed {res}/{name} -> HTTP {status}: {body[:160]!r}"
+        names.append(name)
+    return names
+
+
+# -- sections -----------------------------------------------------------------
+
+
+def read_fleet_spec(fast: bool) -> FleetSpec:
+    return FleetSpec(
+        schema_text=READ_SCHEMA, rules_yaml=READ_RULES,
+        shard_leaders=1,
+        follower_levels=(2, 2) if fast else (2, 6),
+        router=True, route_via="followers",
+        seed_rels=("namespace:team-a#creator@user:alice",),
+        ready_timeout_s=120.0)
+
+
+def measure_read_scale(fleet: ProcessFleet, fast: bool) -> dict:
+    """Open-loop filtered LISTs over the 2-mid subset vs every
+    follower, A/B-paired rounds."""
+    followers = fleet.urls("follower")  # boot order: mids, then leaves
+    mids = followers[:2]
+    # offered above any subset's capacity: the open-loop schedule then
+    # drains LATE, and achieved / makespan is the capacity (a closed
+    # loop would instead slow its offering and hide the difference)
+    rate = 120.0 if fast else 200.0
+    dur = 3.0 if fast else 4.0
+    rounds = 2
+    sizes = {len(mids): mids, len(followers): followers}
+    results: dict = {n: [] for n in sizes}
+    for r in range(rounds):
+        for n, urls in sizes.items():
+            spec = loadgen.WorkloadSpec(
+                seed=100 + r, duration_s=dur, rate_per_s=rate,
+                users=USERS, verb_mix=(("filter", 1.0),))
+            rep = run_schedule(urls, spec)
+            results[n].append(rep)
+            stage(f"read round {r} n={n}: achieved "
+                  f"{rep['achieved']}/{rep['offered']} in "
+                  f"{rep['window_s']}s p99 {rep['p99_ms']}ms "
+                  f"lag {rep['max_sched_lag_ms']}ms")
+    small, big = sorted(sizes)
+    ach = {n: med([w["achieved"] / max(w["window_s"], 1e-9)
+                   for w in ws])
+           for n, ws in results.items()}
+    return {
+        "tree": {"mids": 2, "leaves": len(followers) - 2,
+                 "levels": 2},
+        "offered_rate_per_s": rate,
+        "windows": {str(n): ws for n, ws in results.items()},
+        "achieved_per_s": {str(n): round(a, 2) for n, a in ach.items()},
+        "p99_ms": {str(n): med([w["p99_ms"] for w in ws])
+                   for n, ws in results.items()},
+        "scaling": round(ach[big] / max(ach[small], 1e-9), 3),
+        "subsets": [small, big],
+    }
+
+
+def attribution_pass(fleet: ProcessFleet, fast: bool) -> dict:
+    """Million-user mixed workload through the router; per-tier
+    attribution reconciled against the client's own e2e wall times,
+    /debug/tail embedded."""
+    router = fleet.router_url
+    # attribution is a reconciliation-CORRECTNESS pass, so it runs
+    # below saturation on purpose: the capacity sections own the
+    # saturating rates, and a fleet queueing multiple seconds deep on
+    # an oversubscribed box skews span accounting by more than the
+    # bound being verified.  The full tree (10 processes on this box)
+    # therefore gets a lower rate than the fast (6-process) one.
+    spec = loadgen.WorkloadSpec(
+        seed=21, duration_s=5.0 if fast else 10.0,
+        rate_per_s=24.0 if fast else 10.0,
+        users=USERS, zipf_s=1.2,
+        verb_mix=(("filter", 0.55), ("check", 0.2), ("update", 0.25)),
+        watch_churn_per_s=2.0, grant_burst_per_s=0.5,
+        grant_burst_n=4, grant_ttl_s=2.0)
+    transport = H11Transport(router)
+    client_e2e: dict = {}
+
+    async def issue(ev: dict) -> None:
+        verb = ev["verb"]
+        t0 = time.perf_counter()
+        if verb in ("filter", "check"):
+            resp = await around_trip(transport, "GET", obj_path("pods"))
+        elif verb in ("update", "watch"):
+            resp = await around_trip(
+                transport, "POST", obj_path("pods"),
+                body=obj_body("pods", f"{verb}-{ev['seq']}"))
+        elif verb == "grant":
+            resp = await around_trip(
+                transport, "POST", obj_path("pods"),
+                body=obj_body("pods", ev["name"]))
+        else:  # revoke: the grant's short TTL expiring
+            resp = await around_trip(
+                transport, "DELETE", obj_path("pods", ev["name"]))
+            if resp.status == 404:
+                return  # grant lost a race with its own revoke
+        if resp.status >= 400:
+            raise AssertionError(f"{verb} -> HTTP {resp.status}")
+        tid = resp.headers.get("x-trace-id")
+        if tid:
+            client_e2e[tid] = (time.perf_counter() - t0) * 1e3
+
+    rep = run_schedule([router], spec, issue=issue)
+    status, _, body = http("GET", router + "/debug/fleet", user="alice",
+                           timeout=20.0)
+    assert status == 200, f"/debug/fleet -> HTTP {status}"
+    merged = json.loads(body)
+    matched = 0
+    partial = 0
+    worst_gap_ms = 0.0
+    worst_unexplained_ms = 0.0
+    max_tiers = 0
+    for tr in merged.get("traces", ()):
+        e2e = client_e2e.get(tr.get("trace_id"))
+        if e2e is None:
+            continue
+        # each member retains only its slowest traces, so under load a
+        # trace can survive at the leader but be evicted at the router:
+        # the merge flags those (wall alignment / orphan segments) and
+        # their root duration is no longer the client-facing e2e, so
+        # only fully-retained chains are reconcilable
+        if tr.get("aligned_by_wall") or tr.get("wall_fallbacks", 0):
+            partial += 1
+            continue
+        matched += 1
+        max_tiers = max(max_tiers, tr.get("tier_count", 0))
+        dur, attr = tr["duration_ms"], tr["attributed_ms"]
+        worst_gap_ms = max(worst_gap_ms, abs(attr - dur))
+        worst_unexplained_ms = max(worst_unexplained_ms, e2e - dur)
+        assert abs(attr - dur) <= 0.10 * dur + 5.0, \
+            f"attribution gap {attr:.2f} vs {dur:.2f}ms"
+        assert dur <= e2e + 1.0, f"trace {dur:.2f} > e2e {e2e:.2f}ms"
+        assert e2e - dur <= 0.10 * e2e + 75.0, \
+            f"e2e {e2e:.2f}ms unexplained by trace {dur:.2f}ms"
+    assert matched >= 5, (
+        f"only {matched} fully-retained traces reconciled "
+        f"({partial} partial)")
+    status, _, body = http("GET", router + "/debug/tail", user="alice",
+                           timeout=20.0)
+    assert status == 200, f"/debug/tail -> HTTP {status}"
+    tail = json.loads(body)
+    assert tail.get("enabled") is True and tail.get("ranked"), tail
+    stage(f"attribution: {matched} traces reconciled, {partial} "
+          f"partial-retention skipped (worst gap {worst_gap_ms:.2f}ms); "
+          f"tail top {tail['ranked'][0]['tier']}/"
+          f"{tail['ranked'][0]['stage']}")
+    return {
+        "workload": rep,
+        "traces_reconciled": matched,
+        "traces_partial_retention": partial,
+        "deepest_tier_count": max_tiers,
+        "worst_attribution_gap_ms": round(worst_gap_ms, 3),
+        "worst_unexplained_e2e_ms": round(worst_unexplained_ms, 3),
+        "per_tier": merged.get("tiers"),
+        "tail": tail,
+    }
+
+
+def failover_pass(fleet: ProcessFleet) -> dict:
+    """Read-fleet chaos: acked write -> kill the leader -> promote a
+    mid follower -> the acked write must survive on the promoted leader
+    AND its leaf subtree, and new writes must land.  Zero lost."""
+    router = fleet.router_url
+    status, _, body = http("POST", router + obj_path("pods"),
+                           user="alice",
+                           body=obj_body("pods", "pre-failover"))
+    assert status in (200, 201), f"pre-failover write: {status}"
+    time.sleep(1.5)  # let the tree pull it
+    stage("killing leader-0; promoting follower-l0-0 ...")
+    fleet.kill("leader-0")
+    mid = fleet.members["follower-l0-0"]
+    fleet.wait_ready("follower-l0-0", 60.0, want_degraded=True)
+    status, _, body = http("POST", mid.url + "/replication/promote",
+                           user="admin", body={},
+                           groups=["system:masters"], timeout=30.0)
+    assert status == 200, f"promote -> HTTP {status}: {body[:200]!r}"
+    promo = json.loads(body)
+    # post-promote write through a leaf in the promoted mid's subtree
+    # (leaves round-robin over mids: leaf 0 chains off mid 0)
+    leaf = fleet.members.get("follower-l1-0")
+    write_via = (leaf or mid).url
+    status, _, body = http("POST", write_via + obj_path("pods"),
+                           user="alice",
+                           body=obj_body("pods", "post-failover"))
+    assert status in (200, 201), f"post-failover write: {status}"
+
+    def names_on(url):
+        s, _, b = http("GET", url + obj_path("pods"), user="alice",
+                       timeout=10.0)
+        assert s == 200, f"list on {url}: {s}"
+        return {i["metadata"]["name"]
+                for i in json.loads(b).get("items", ())}
+
+    assert "pre-failover" in names_on(mid.url), \
+        "acked pre-kill write lost on the promoted leader"
+    survived_on_leaf = False
+    if leaf is not None:
+        deadline = time.time() + 25.0
+        while time.time() < deadline:
+            got = names_on(leaf.url)
+            if {"pre-failover", "post-failover"} <= got:
+                survived_on_leaf = True
+                break
+            time.sleep(0.5)
+        assert survived_on_leaf, \
+            "leaf subtree never converged on the promoted leader's log"
+    stage(f"failover pass: zero lost (promotion incarnation "
+          f"{promo.get('incarnation')})")
+    return {"lost_acked_writes": 0,
+            "promoted": "follower-l0-0",
+            "incarnation": promo.get("incarnation"),
+            "leaf_subtree_converged": survived_on_leaf}
+
+
+def write_fleet_spec(fast: bool) -> FleetSpec:
+    return FleetSpec(
+        schema_text=WRITE_SCHEMA, rules_yaml=WRITE_RULES,
+        shard_leaders=4, follower_levels=(), router=False,
+        seed_rels=tuple(f"{ns}:team-a#creator@user:alice"
+                        for _res, ns, _typ in CLASSES),
+        wal_fsync="always", ready_timeout_s=120.0)
+
+
+def boot_routers(fleet: ProcessFleet) -> dict:
+    leaders = fleet.urls("leader")
+    routers = {}
+    for n in sorted(PARTITION_MAPS):
+        name = f"router-n{n}"
+        m = fleet.spawn_router(name, leaders[:n],
+                               partition_map=PARTITION_MAPS[n])
+        fleet.wait_ready(name, 90.0)
+        routers[n] = m.url
+    return routers
+
+
+def churn_issue(router_url: str, tag: str, acked=None,
+                rejected=None, ack_times=None):
+    """Open-loop create churn round-robin over the 4 classes; acks land
+    in the ledger, 5xx from a killed shard are counted, never raised."""
+    transport = H11Transport(router_url)
+
+    async def issue(ev: dict) -> None:
+        res = CLASSES[ev["seq"] % len(CLASSES)][0]
+        name = f"{tag}-{ev['seq']}"
+        resp = await around_trip(transport, "POST", obj_path(res),
+                                 body=obj_body(res, name))
+        if resp.status in (200, 201):
+            if acked is not None:
+                acked.setdefault(res, []).append(name)
+                ack_times.append((time.time(), res))
+        elif resp.status >= 500 and rejected is not None:
+            rejected[res] = rejected.get(res, 0) + 1
+        elif resp.status >= 400:
+            raise AssertionError(f"create {res} -> HTTP {resp.status}")
+
+    return issue
+
+
+def measure_write_scale(fleet: ProcessFleet, routers: dict,
+                        fast: bool) -> dict:
+    # saturating offered rate (see measure_read_scale): capacity is
+    # achieved / makespan, the open-loop way to see a shard ceiling
+    rate = 120.0 if fast else 150.0
+    dur = 3.0 if fast else 4.0
+    rounds = 2
+    results: dict = {n: [] for n in routers}
+    for r in range(rounds):
+        for n, url in sorted(routers.items()):
+            spec = loadgen.WorkloadSpec(
+                seed=200 + r, duration_s=dur, rate_per_s=rate,
+                users=USERS, verb_mix=(("update", 1.0),))
+            rep = run_schedule(
+                [url], spec, issue=churn_issue(url, f"w{n}r{r}"))
+            assert rep["errors"] == 0, \
+                f"write window n={n} r={r}: {rep['errors']} errors"
+            results[n].append(rep)
+            stage(f"write round {r} n={n}: achieved "
+                  f"{rep['achieved']}/{rep['offered']} in "
+                  f"{rep['window_s']}s p99 {rep['p99_ms']}ms")
+    ach = {n: med([w["achieved"] / max(w["window_s"], 1e-9)
+                   for w in ws])
+           for n, ws in results.items()}
+    widths = sorted(routers)
+    return {
+        "wal_fsync": "always",
+        "offered_rate_per_s": rate,
+        "windows": {str(n): ws for n, ws in results.items()},
+        "achieved_per_s": {str(n): round(a, 2) for n, a in ach.items()},
+        "p99_ms": {str(n): med([w["p99_ms"] for w in ws])
+                   for n, ws in results.items()},
+        "scaling": round(ach[widths[-1]] / max(ach[widths[0]], 1e-9), 3),
+        "widths": widths,
+    }
+
+
+def shard_kill_pass(fleet: ProcessFleet, router_url: str,
+                    fast: bool) -> dict:
+    """Acked-write ledger under load; kill -9 shard leader-2 mid-window;
+    restart on the same data dir; read every ledger entry back."""
+    acked: dict = {}
+    rejected: dict = {}
+    ack_times: list = []
+    dur = 8.0 if fast else 10.0
+    kill_after = dur * 0.4
+    spec = loadgen.WorkloadSpec(
+        seed=31, duration_s=dur, rate_per_s=30.0, users=USERS,
+        verb_mix=(("update", 1.0),))
+    kill_wall = []
+
+    async def killer():
+        await asyncio.sleep(kill_after)
+        stage("chaos: kill -9 leader-2 under load")
+        kill_wall.append(time.time())
+        await asyncio.to_thread(fleet.kill, "leader-2")
+
+    rep = run_schedule(
+        [router_url], spec,
+        issue=churn_issue(router_url, "chaos", acked=acked,
+                          rejected=rejected, ack_times=ack_times),
+        extra_tasks=(killer,))
+
+    dead_classes = {res for res, _ns, typ in CLASSES
+                    if PARTITION_MAPS[4].find(f"{typ}=2") >= 0}
+    post_kill_other = sum(
+        1 for t, res in ack_times
+        if kill_wall and t > kill_wall[0] and res not in dead_classes)
+    assert post_kill_other > 0, \
+        "no acks on surviving shards after the kill — chaos run invalid"
+    for res, count in rejected.items():
+        assert res in dead_classes, \
+            f"{count} rejects on {res}, which is NOT on the dead shard"
+
+    stage("restarting leader-2 on its data dir ...")
+    fleet.restart("leader-2")
+    fleet.wait_ready("leader-2", 90.0)
+
+    lost: list = []
+    deadline = time.time() + 30.0
+    pending = {res: set(names) for res, names in acked.items()}
+    while time.time() < deadline and any(pending.values()):
+        for res, names in list(pending.items()):
+            if not names:
+                continue
+            s, _, b = http("GET", router_url + obj_path(res),
+                           user="alice", timeout=10.0)
+            if s != 200:
+                continue
+            got = {i["metadata"]["name"]
+                   for i in json.loads(b).get("items", ())}
+            pending[res] = names - got
+        if any(pending.values()):
+            time.sleep(0.5)
+    for res, names in pending.items():
+        lost.extend(f"{res}/{n}" for n in sorted(names))
+    assert not lost, f"LOST acked writes after restart: {lost[:10]}"
+    total_acked = sum(len(v) for v in acked.values())
+    stage(f"shard-kill pass: {total_acked} acked writes, 0 lost, "
+          f"{sum(rejected.values())} dead-shard rejects")
+    return {
+        "acked_writes": total_acked,
+        "acked_per_class": {res: len(v) for res, v in acked.items()},
+        "dead_shard_rejects": sum(rejected.values()),
+        "post_kill_acks_on_live_shards": post_kill_other,
+        "lost_acked_writes": 0,
+        "window": rep,
+    }
+
+
+# -- section drivers ----------------------------------------------------------
+
+
+def section_read_scale(fast: bool = True) -> dict:
+    with ProcessFleet(read_fleet_spec(fast)) as fleet:
+        fleet.boot()
+        seed_objects(fleet.router_url, "pods", 12 if fast else 30, "seed")
+        time.sleep(2.0)  # bounded staleness: let the tree pull the seed
+        out = measure_read_scale(fleet, fast)
+    out["headline"] = out["scaling"]
+    out["headline_unit"] = "x"
+    return out
+
+
+def section_write_scale(fast: bool = True) -> dict:
+    with ProcessFleet(write_fleet_spec(fast)) as fleet:
+        fleet.boot()
+        routers = boot_routers(fleet)
+        out = measure_write_scale(fleet, routers, fast)
+    out["headline"] = out["scaling"]
+    out["headline_unit"] = "x"
+    return out
+
+
+def section_chaos(fast: bool = True) -> dict:
+    with ProcessFleet(write_fleet_spec(fast)) as fleet:
+        fleet.boot()
+        leaders = fleet.urls("leader")
+        m = fleet.spawn_router("router-n4", leaders,
+                               partition_map=PARTITION_MAPS[4])
+        fleet.wait_ready("router-n4", 90.0)
+        out = shard_kill_pass(fleet, m.url, fast)
+    out["headline"] = float(out["lost_acked_writes"])
+    out["headline_unit"] = "lost-writes"
+    return out
+
+
+def section_full(fast: bool = False) -> dict:
+    stage("=== read fleet: 1 leader + 2-level follower tree + router")
+    with ProcessFleet(read_fleet_spec(fast)) as fleet:
+        fleet.boot()
+        seed_objects(fleet.router_url, "pods", 12 if fast else 30, "seed")
+        time.sleep(2.0)
+        # attribution BEFORE the saturating scale windows: every member
+        # retains only its slowest traces, so once the scale windows
+        # fill the recorders with multi-second queueing traces, the
+        # light attribution traffic can no longer be retained at every
+        # tier and no chain reconciles end to end
+        attribution = attribution_pass(fleet, fast)
+        read = measure_read_scale(fleet, fast)
+        failover = failover_pass(fleet)
+    stage("=== write fleet: 4 shard leaders + routers n=1/2/4")
+    with ProcessFleet(write_fleet_spec(fast)) as fleet:
+        fleet.boot()
+        routers = boot_routers(fleet)
+        write = measure_write_scale(fleet, routers, fast)
+        chaos = shard_kill_pass(fleet, routers[4], fast)
+    ceiling = cpu_pair_ceiling()
+    return {
+        "read_scale": read,
+        "write_scale": write,
+        "attribution": attribution,
+        "chaos": {"shard_kill": chaos, "failover": failover},
+        "cpu_pair_scaling_ceiling": ceiling,
+        "open_loop": True,
+        "users": USERS,
+        "headline": read["scaling"],
+        "headline_unit": "x",
+    }
+
+
+SECTIONS = {
+    "read_scale": section_read_scale,
+    "write_scale": section_write_scale,
+    "chaos": section_chaos,
+    "full": section_full,
+}
+
+
+def run_section(name: str, fast: bool = True) -> dict:
+    """bench.py's entry point (`--config fleet-*`)."""
+    return SECTIONS[name](fast=fast)
+
+
+# -- migration parity ---------------------------------------------------------
+
+
+def run_bench_replica_scale(bench_path: str) -> dict:
+    """One `bench.py --config replica-scale` run -> its emitted JSON."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # the bench resolves the package from sys.path[0] (its own dir), so
+    # a copy parked outside the repo needs the root on PYTHONPATH.
+    # Both sides of the pair also get a taskset shim that strips the
+    # pinning args: on cgroup-restricted boxes `taskset -c <masked-out
+    # cpu>` is EINVAL (historical bench revisions crash on it), and
+    # parity only needs the two runs under IDENTICAL conditions, which
+    # unpinned-for-both satisfies everywhere.
+    shim = tempfile.mkdtemp(prefix="parity-shim-")
+    shim_taskset = os.path.join(shim, "taskset")
+    with open(shim_taskset, "w") as f:
+        f.write('#!/bin/sh\nshift 2\nexec "$@"\n')
+    os.chmod(shim_taskset, 0o755)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+               PATH=shim + os.pathsep + os.environ.get("PATH", ""))
+    out = subprocess.run(
+        [sys.executable, bench_path, "--config", "replica-scale"],
+        capture_output=True, text=True, env=env, timeout=1800,
+        cwd=repo)
+    assert out.returncode == 0, \
+        f"{bench_path} failed:\n{out.stderr[-2000:]}"
+    line = [ln for ln in out.stdout.splitlines() if ln.startswith("{")][-1]
+    res = json.loads(line)
+    # the bench emits its one JSON line even on error (with an "error"
+    # field and zeroed numbers) — that must not pass as parity
+    assert "error" not in res, f"{bench_path}: {res['error']}"
+    return res
+
+
+def parity(old_bench: str, new_bench: str, rel_tol: float = 0.35) -> dict:
+    """Behavior-preserving-migration proof: the pre-harness bench.py
+    replica-scale vs the migrated one, same box, back to back.  The
+    scaling ratios must agree within noise (same workers, same
+    protocol, only the spawn/reap plumbing changed owners)."""
+    stage(f"parity: running pre-migration {old_bench} ...")
+    old = run_bench_replica_scale(old_bench)
+    stage(f"parity: running migrated {new_bench} ...")
+    new = run_bench_replica_scale(new_bench)
+    keys = ("scaling_2x", "scaling_4x")
+    report = {"old": {k: old.get(k) for k in keys},
+              "new": {k: new.get(k) for k in keys},
+              "rel_tol": rel_tol}
+    for k in keys:
+        o, n = old.get(k), new.get(k)
+        if not o or not n:
+            continue
+        drift = abs(n - o) / o
+        report[f"{k}_drift"] = round(drift, 3)
+        assert drift <= rel_tol, \
+            f"migration changed {k}: {o} -> {n} ({drift:.0%} drift)"
+    report["parity"] = "ok"
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--section", default="full", choices=sorted(SECTIONS))
+    ap.add_argument("--fast", action="store_true",
+                    help="smaller trees + shorter windows")
+    ap.add_argument("--out", default="",
+                    help="write the artifact JSON here (FLEET_r01.json)")
+    ap.add_argument("--parity", default="",
+                    help="path to the pre-migration bench.py: run the "
+                         "replica-scale migration parity check instead")
+    ap.add_argument("--parity-new", default="",
+                    help="migrated bench.py path (default: repo root)")
+    args = ap.parse_args()
+
+    if args.parity:
+        new_bench = args.parity_new or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "bench.py")
+        result = parity(args.parity, new_bench)
+    else:
+        result = run_section(args.section, fast=args.fast)
+        result["generated_by"] = "scripts/fleet_bench.py"
+        result["section"] = args.section
+    print(json.dumps(result, sort_keys=True))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+        stage(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

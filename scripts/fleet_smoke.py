@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Fleet topology smoke: the CI-sized proof that the ISSUE 20 stack
+works end to end (docs/performance.md "Fleet topology bench").
+
+Boots the smallest interesting fleet — fake kube apiserver, one shard
+leader, one follower, and the CLI router fronting the follower (so a
+write travels router -> follower -> leader: three processes per trace)
+— entirely through the shared `ProcessFleet` harness, then:
+
+1. drives ~10s of OPEN-LOOP mixed load (filtered lists + checks +
+   dual-write creates) through the router with `OpenLoopRunner`, so the
+   serving path records every `_SERVING_STAGES` stage across the fleet;
+2. takes timed client samples (e2e wall time + `x-trace-id`) and
+   reconciles the merged `/debug/fleet` view's per-tier attribution
+   against them with the same bounds scripts/replication_smoke.py pins
+   (attributed-vs-duration within 10% + 5ms; trace inside client e2e;
+   client e2e within 10% + 75ms of the trace);
+3. asserts `/debug/tail` serves a non-empty ranked tail report whose
+   stage set is exactly `_SERVING_STAGES` — the p99 explainer is wired
+   into CI, not just the bench artifact.
+
+Runs under check.sh in BOTH modes (--fast included): the fleet is tiny
+and the load window short, so this is the cheapest end-to-end guard on
+the harness + loadgen + tailexplain composition.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spicedb_kubeapi_proxy_tpu.proxy.httpcore import (  # noqa: E402
+    H11Transport,
+    Headers,
+    Request,
+)
+from spicedb_kubeapi_proxy_tpu.utils import loadgen  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.utils.timeline import _SERVING_STAGES  # noqa: E402
+from spicedb_kubeapi_proxy_tpu.utils.topology import (  # noqa: E402
+    FleetSpec,
+    ProcessFleet,
+    http,
+)
+
+SCHEMA = """
+definition user {}
+definition namespace {
+  relation creator: user
+  permission view = creator
+}
+definition pod {
+  relation creator: user
+  permission view = creator
+}
+"""
+
+RULES = """
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: list-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [list]}]
+prefilter:
+- fromObjectIDNamespaceExpr: "{{split_namespace(resourceId)}}"
+  fromObjectIDNameExpr: "{{split_name(resourceId)}}"
+  lookupMatchingResources: {tpl: "pod:$#view@user:{{user.name}}"}
+---
+apiVersion: authzed.com/v1alpha1
+kind: ProxyRule
+metadata: {name: create-pods}
+match: [{apiVersion: v1, resource: pods, verbs: [create]}]
+lock: Optimistic
+check: [{tpl: "namespace:{{namespace}}#view@user:{{user.name}}"}]
+update:
+  creates:
+  - tpl: "pod:{{namespacedName}}#creator@user:{{user.name}}"
+"""
+
+LIST_PATH = "/api/v1/namespaces/team-a/pods"
+
+# same reconcile contract replication_smoke pins for the two-process
+# fleet view; a third tier must not loosen it
+ATTR_REL_TOL = 0.10
+ATTR_ABS_TOL_MS = 5.0
+E2E_ABS_TOL_MS = 75.0
+
+
+def stage_msg(msg: str) -> None:
+    print(f"[fleet-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def pod_body(name: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "team-a"}}
+
+
+async def drive_open_loop(router_url: str, spec: loadgen.WorkloadSpec):
+    """Open-loop mixed load through the router: filter/check -> filtered
+    LIST, update -> dual-write create.  Latencies are charged to the
+    INTENDED send time by OpenLoopRunner (coordinated-omission-free).
+
+    Every response's `x-trace-id` is recorded against the client-side
+    send->completion wall time, so EVERY request is also a timed
+    attribution sample — the fleet's trace recorders retain the slowest
+    traces, and whichever survive can be reconciled against what this
+    client actually experienced."""
+    transport = H11Transport(router_url)
+    client_e2e: dict = {}   # trace_id -> e2e ms (send -> completion)
+
+    async def issue(ev: dict) -> None:
+        h = Headers()
+        h.set("Accept", "application/json")
+        h.set("X-Remote-User", "alice")
+        if ev["verb"] == "update":
+            body = json.dumps(pod_body(f"lg-{ev['seq']}")).encode()
+            h.set("Content-Type", "application/json")
+            req = Request(method="POST", target=LIST_PATH,
+                          headers=h, body=body)
+        else:
+            req = Request(method="GET", target=LIST_PATH, headers=h)
+        t_send = time.perf_counter()
+        # open-loop load driver: latency is charged to the intended
+        # schedule; per-hop spans are the serving fleet's job, asserted
+        # below via /debug/fleet
+        resp = await transport.round_trip(req)  # noqa: A006(open-loop client)
+        if resp.status >= 400:
+            raise AssertionError(
+                f"{ev['verb']} -> HTTP {resp.status}: {resp.body[:200]!r}")
+        tid = resp.headers.get("x-trace-id")
+        if tid:
+            client_e2e[tid] = (time.perf_counter() - t_send) * 1e3
+
+    runner = loadgen.OpenLoopRunner(issue, max_inflight=64)
+    report = await runner.run(spec.schedule())
+    return report, client_e2e
+
+
+def reconcile(merged: dict, client_e2e: dict) -> tuple:
+    """Per-tier attribution must reconcile with the client's measured
+    e2e wall time for every retained trace this client issued."""
+    matched = 0
+    max_tiers = 0
+    for tr in merged.get("traces", ()):
+        e2e = client_e2e.get(tr.get("trace_id"))
+        if e2e is None:
+            continue
+        # reconcile only fully-retained chains: if any member's
+        # slowest-N recorder evicted its segment (flagged by the merge
+        # as wall alignment / orphan fallbacks), the root duration is
+        # no longer the client-facing e2e and the tier sums cannot
+        # telescope to it
+        if tr.get("aligned_by_wall") or tr.get("wall_fallbacks", 0):
+            continue
+        matched += 1
+        max_tiers = max(max_tiers, tr.get("tier_count", 0))
+        dur, attr = tr["duration_ms"], tr["attributed_ms"]
+        assert abs(attr - dur) <= ATTR_REL_TOL * dur + ATTR_ABS_TOL_MS, (
+            f"attribution gap: attributed {attr:.2f}ms vs trace "
+            f"{dur:.2f}ms (trace {tr['trace_id']})")
+        assert dur <= e2e + 1.0, (
+            f"trace {dur:.2f}ms exceeds client e2e {e2e:.2f}ms "
+            f"(trace {tr['trace_id']})")
+        assert e2e - dur <= ATTR_REL_TOL * e2e + E2E_ABS_TOL_MS, (
+            f"client e2e {e2e:.2f}ms unexplained by trace {dur:.2f}ms "
+            f"(trace {tr['trace_id']})")
+    assert matched >= 5, (
+        f"only {matched} retained fleet traces matched a client sample "
+        f"— ring eviction or trace-id propagation loss")
+    assert max_tiers >= 2, (
+        f"retained traces span at most {max_tiers} tier(s), want the "
+        f"multi-process path")
+    return matched, max_tiers
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="shorter load window (check.sh --fast lane)")
+    ap.add_argument("--duration", type=float, default=0.0,
+                    help="override the open-loop window length (s)")
+    args = ap.parse_args()
+
+    duration = args.duration or (6.0 if args.fast else 10.0)
+    spec = loadgen.WorkloadSpec(
+        seed=20, duration_s=duration, rate_per_s=12.0,
+        users=50_000,  # smoke-sized id space; the bench uses 1e6
+        verb_mix=(("filter", 0.5), ("check", 0.2), ("update", 0.3)))
+
+    fleet_spec = FleetSpec(
+        schema_text=SCHEMA, rules_yaml=RULES,
+        shard_leaders=1, follower_levels=(1,),
+        router=True, route_via="followers",
+        seed_rels=("namespace:team-a#creator@user:alice",))
+
+    stage_msg("booting router + 1 leader + 1 follower fleet ...")
+    with ProcessFleet(fleet_spec) as fleet:
+        fleet.boot()
+        router = fleet.router_url
+        stage_msg(f"fleet ready (router {router}); warming ...")
+        status, _, body = http("GET", router + LIST_PATH, user="alice")
+        assert status == 200, f"warm list -> HTTP {status}: {body[:200]!r}"
+        status, _, body = http("POST", router + LIST_PATH, user="alice",
+                               body=pod_body("warm-0"))
+        assert status in (200, 201), \
+            f"warm create -> HTTP {status}: {body[:200]!r}"
+
+        stage_msg(f"open-loop load: {duration:.0f}s @ 12 req/s "
+                  f"(filter/check/update mix) ...")
+        report, client_e2e = asyncio.run(drive_open_loop(router, spec))
+        stage_msg(
+            f"load done: offered {report['offered']} achieved "
+            f"{report['achieved']} errors {report['errors']} "
+            f"p50 {report['p50_ms']}ms p99 {report['p99_ms']}ms "
+            f"max-sched-lag {report['max_sched_lag_ms']}ms")
+        assert report["errors"] == 0, \
+            f"{report['errors']} open-loop requests failed"
+        assert report["achieved"] == report["offered"] > 0
+
+        status, _, body = http("GET", router + "/debug/fleet",
+                               user="alice", timeout=15.0)
+        assert status == 200, f"/debug/fleet -> HTTP {status}"
+        merged = json.loads(body)
+        found, max_tiers = reconcile(merged, client_e2e)
+        stage_msg(f"attribution reconciles with client e2e on {found} "
+                  f"retained traces (deepest spans {max_tiers} tiers)")
+
+        status, _, body = http("GET", router + "/debug/tail",
+                               user="alice", timeout=15.0)
+        assert status == 200, f"/debug/tail -> HTTP {status}"
+        tail = json.loads(body)
+        assert tail.get("enabled") is True, f"/debug/tail: {tail!r}"
+        assert tail.get("requests", 0) >= 2, \
+            f"tail report over {tail.get('requests')} traces, want >= 2"
+        ranked = tail.get("ranked") or []
+        assert ranked, "/debug/tail ranked report is empty"
+        got_stages = set(tail.get("stages") or ())
+        assert got_stages == set(_SERVING_STAGES), (
+            f"/debug/tail stage set {sorted(got_stages)} != "
+            f"_SERVING_STAGES {sorted(_SERVING_STAGES)}")
+        top = ranked[0]
+        stage_msg(
+            f"/debug/tail: p50 {tail['p50_ms']}ms p99 {tail['p99_ms']}ms "
+            f"gap {tail['gap_ms']}ms; top contributor "
+            f"{top['tier']}/{top['stage']} (+{top['delta_ms']}ms, "
+            f"{top['share_of_gap']:.0%} of gap)")
+
+    print(json.dumps({
+        "fleet_smoke": "ok", "open_loop": report,
+        "traces_reconciled": found, "deepest_tier_count": max_tiers,
+        "tail_top": ranked[0], "tail_gap_ms": tail["gap_ms"],
+    }, sort_keys=True))
+    stage_msg("ALL GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,17 +49,21 @@ import json
 import os
 import shutil
 import signal
-import socket
 import subprocess
 import sys
 import tempfile
 import time
-import urllib.error
 import urllib.request
 
 import yaml
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spicedb_kubeapi_proxy_tpu.utils.topology import (  # noqa: E402
+    free_port,
+    http,
+    wait_http_ready as wait_ready,
+)
 
 SCHEMA = """
 definition user {}
@@ -216,52 +220,8 @@ def serve(role: str, port: int, data_dir: str, leader_url: str,
     asyncio.run(run())
 
 
-# -- parent-side helpers -----------------------------------------------------
-
-
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def http(method: str, url: str, user: str = "", body=None, timeout=5.0,
-         groups=()):
-    headers = {"Accept": "application/json"}
-    if user:
-        headers["X-Remote-User"] = user
-    for g in groups:
-        headers["X-Remote-Group"] = g
-    data = None
-    if body is not None:
-        data = json.dumps(body).encode()
-        headers["Content-Type"] = "application/json"
-    req = urllib.request.Request(url, data=data, headers=headers,
-                                 method=method)
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, dict(resp.headers), resp.read()
-    except urllib.error.HTTPError as e:
-        return e.code, dict(e.headers), e.read()
-
-
-def wait_ready(base: str, deadline_s: float, want_degraded=False) -> bytes:
-    t0 = time.time()
-    last = b""
-    while time.time() - t0 < deadline_s:
-        try:
-            status, _, body = http("GET", base + "/readyz", timeout=2.0)
-            last = body
-            if status == 200 and (b"[!]" in body if want_degraded else True):
-                return body
-        except OSError:
-            pass
-        time.sleep(0.1)
-    raise AssertionError(
-        f"{base}/readyz not {'degraded' if want_degraded else 'ready'} "
-        f"within {deadline_s}s (last: {last!r})")
+# -- parent-side helpers: free_port/http/wait_ready now come from the
+# -- shared topology harness (utils/topology.py) ------------------------------
 
 
 def main() -> int:

@@ -70,6 +70,16 @@ def parse_args():
     p.add_argument("--window", type=float,
                    default=float(os.environ.get("SOAK_WINDOW_S", 300.0)),
                    help="reporting window seconds (default 300)")
+    p.add_argument("--open-loop", action="store_true",
+                   help="coordinated-omission-free read side: lookups "
+                        "fire on a FIXED precomputed schedule (same "
+                        "average rate as the closed-loop lookers) and "
+                        "each latency is charged from the INTENDED "
+                        "send time, so a stall's queued victims are "
+                        "measured instead of silently delayed; "
+                        "scheduler lag is exported as "
+                        "authz_loadgen_lag_seconds "
+                        "(docs/performance.md \"Fleet topology bench\")")
     p.add_argument("--assert-slo", action="store_true",
                    help="exit 1 unless every window holds p99 <= "
                         "max(2 x p50, --p99-floor-ms) and "
@@ -160,6 +170,61 @@ def main():
                 print(f"looker error: {e!r}", flush=True)
             await asyncio.sleep(look_pause)
 
+    sched_lag = {"max_ms": 0.0, "scheduled": 0}
+
+    async def open_loop_driver():
+        """--open-loop replacement for the lookers: the whole read-side
+        schedule is laid out up front (loadgen.WorkloadSpec, zipfian
+        subject fan-in) and each lookup fires at its intended time
+        whether or not earlier ones returned — latency is charged from
+        the INTENDED send, the coordinated-omission fix."""
+        from spicedb_kubeapi_proxy_tpu.utils import loadgen
+
+        # offered rate matched to the closed-loop lookers' upper bound
+        # so the two modes are comparable on the same profile
+        rate = n_lookers / max(look_pause, 0.02)
+        spec = loadgen.WorkloadSpec(
+            seed=7, duration_s=args.duration, rate_per_s=rate,
+            users=max(2, len(w.subjects)),
+            verb_mix=(("filter", 1.0),))
+        schedule = spec.schedule()
+        sched_lag["scheduled"] = len(schedule)
+        print(f"open-loop: {len(schedule)} lookups scheduled at "
+              f"{rate:.1f}/s", flush=True)
+
+        async def one(ev, intended):
+            sub = SubjectRef("user", w.subjects[
+                (int(ev["user"][1:]) - 1) % len(w.subjects)])
+            try:
+                with tracing.request_trace(op="lookup",
+                                           subject=sub.id) as tr:
+                    ids = await ep.lookup_resources("pod", "view", sub)
+                tracing.RECORDER.record(tr)
+                lookup_lat.append(time.perf_counter() - intended)
+                counters["lookups"] += 1
+                assert not any("\x00" in x for x in ids)
+            except Exception as e:
+                counters["errors"] += 1
+                print(f"looker error: {e!r}", flush=True)
+
+        t0 = time.perf_counter()
+        tasks: list = []
+        for ev in schedule:
+            if stop.is_set():
+                break
+            delay = t0 + ev["t"] - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            intended = t0 + ev["t"]
+            lag = max(0.0, time.perf_counter() - intended)
+            if lag * 1e3 > sched_lag["max_ms"]:
+                sched_lag["max_ms"] = round(lag * 1e3, 3)
+            loadgen.LAG_GAUGE.set(lag)
+            tasks.append(asyncio.ensure_future(one(ev, intended)))
+            tasks = [t for t in tasks if not t.done()]
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+
     async def checker():
         while not stop.is_set():
             try:
@@ -231,9 +296,11 @@ def main():
                 print(f"window {len(windows)}: {windows[-1]}", flush=True)
 
     async def run():
+        read_side = ([open_loop_driver()] if args.open_loop
+                     else [looker(i) for i in range(n_lookers)])
         tasks = [asyncio.ensure_future(x) for x in (
             *[writer(i) for i in range(n_writers)],
-            *[looker(i) for i in range(n_lookers)],
+            *read_side,
             checker(), watcher(), reporter())]
         await asyncio.sleep(args.duration)
         stop.set()
@@ -274,6 +341,10 @@ def main():
         "profile": "churn" if args.churn else "default",
         "graph": args.graph,
         "window_s": args.window,
+        "open_loop": args.open_loop,
+        "loadgen": ({"scheduled": sched_lag["scheduled"],
+                     "max_sched_lag_ms": sched_lag["max_ms"]}
+                    if args.open_loop else None),
         "windows": windows,
         "final_stats": {k: v for k, v in st.items()
                         if isinstance(v, (int, float))},

@@ -618,6 +618,11 @@ class ProxyServer:
                       "per-tier latency attribution, SLO burn roll-up "
                       "across --fleet-peers (docs/observability.md "
                       "\"Fleet tracing\")", self._debug_fleet),
+            "tail": ("tail explainer: p99-vs-p50 population diff of the "
+                     "merged fleet traces, ranked by which (tier, "
+                     "serving stage) component grew the most in the "
+                     "tail (docs/performance.md \"Fleet topology "
+                     "bench\")", self._debug_tail),
             "workload": ("per-(resource type, permission) cost "
                          "attribution: device time, measured sweep "
                          "depth, occupancy, cache hit rate, oracle "
@@ -717,6 +722,25 @@ class ProxyServer:
         merged["tier"] = self._tier
         return merged
     _debug_fleet._wants_request = True
+
+    async def _debug_tail(self, req: Request) -> dict:
+        from ..utils import tailexplain
+        if not tailexplain.enabled():
+            return {"enabled": False,
+                    "reason": "TailExplain feature gate disabled"}
+        merged = await self._debug_fleet(req)
+        if merged.get("enabled") is not True:
+            # no fleet peers: explain the local trace population alone
+            # (single-segment traces carry no cross-tier attribution,
+            # so the report will say how many traces were usable)
+            from ..utils import fleet
+            local = {"url": "local", "error": None,
+                     "traces": self._debug_traces()["traces"]}
+            merged = fleet.merge_fleet([local])
+        report = tailexplain.explain(merged)
+        report["tier"] = self._tier
+        return report
+    _debug_tail._wants_request = True
 
     def _debug_workload(self) -> dict:
         from ..utils import workload

@@ -545,7 +545,7 @@ class ShardRouter:
             resp.headers.set("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
             return resp
-        if req.path in ("/debug/traces", "/debug/fleet"):
+        if req.path in ("/debug/traces", "/debug/fleet", "/debug/tail"):
             return await self._serve_debug(req)
         shard = self.shard_for_request(req)
         raw_token = req.headers.get(repl.MIN_REVISION_HEADER)
@@ -687,6 +687,15 @@ class ShardRouter:
         merged = fleetmod.merge_fleet([local] + members)
         merged["enabled"] = True
         merged["tier"] = "router"
+        if req.path == "/debug/tail":
+            from ...utils import tailexplain
+            if not tailexplain.enabled():
+                return json_response(200, {
+                    "enabled": False, "tier": "router",
+                    "reason": "TailExplain feature gate disabled"})
+            report = tailexplain.explain(merged)
+            report["tier"] = "router"
+            return json_response(200, report)
         return json_response(200, merged)
 
     async def _aggregate_health(self, req):

@@ -177,6 +177,13 @@ GATES.register("MeshExecution", stage=ALPHA, default=True)
 # evaluated at endpoint construction (like a configured mesh): flipping
 # it mid-process affects endpoints built afterwards.
 GATES.register("LeopardIndex", stage=ALPHA, default=True)
+# tail explainer (utils/tailexplain.py): /debug/tail report diffing the
+# p99 trace population against the p50 population of the merged fleet
+# view into a ranked per-(tier, serving stage) "where the tail lives"
+# breakdown.  This gate is the killswitch: off, /debug/tail answers
+# enabled:false and no report is computed — trace collection itself is
+# governed by the existing Timeline/fleet plumbing, not this gate.
+GATES.register("TailExplain", stage=BETA, default=True)
 
 
 def mesh_enabled() -> bool:

@@ -215,7 +215,21 @@ def assemble_trace(segments: list) -> dict:
     # per-tier attribution: self time = segment duration minus the hop
     # spans that have a matching child segment; the residual
     # hop - child duration is that hop's network share.  Tier sums then
-    # reconcile against the root duration by construction.
+    # reconcile against the root duration by construction — PROVIDED
+    # each child segment fits inside its parent's hop span.  Under CPU
+    # starvation a child finalizes its segment after flushing the
+    # response, so its recorded duration can overrun the hop that
+    # carried it; that overrun is finalization delay, not serving work
+    # (the parent already had the response), and left unclamped it
+    # double-counts and compounds down a deep tier chain.  Cap each
+    # non-root segment at its parent hop span; genuinely parallel
+    # fan-out (several hops concurrent inside one segment) still sums
+    # past the root duration, as it physically should.
+    parent_hop_cap: dict = {}
+    for i, (_m, trd) in enumerate(segments):
+        hit = hop_index.get((trd.get("attrs") or {}).get("parent_span"))
+        if hit is not None and hit[0] != i:
+            parent_hop_cap[i] = float(hit[1].get("duration_ms") or 0.0)
     tiers: dict = {}
     stages: dict = {}
     network_ms = 0.0
@@ -223,6 +237,8 @@ def assemble_trace(segments: list) -> dict:
         attrs = trd.get("attrs") or {}
         tier = str(attrs.get("tier") or "unknown")
         dur = float(trd.get("duration_ms") or 0.0)
+        if i in parent_hop_cap:
+            dur = min(dur, parent_hop_cap[i])
         child_hops_ms = 0.0
         for sp in _hop_spans(trd):
             sid = (sp.get("attrs") or {}).get("span_id")

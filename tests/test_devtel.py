@@ -492,7 +492,8 @@ class TestDebugSurfaces:
                                      "/debug/flight", "/debug/timeline",
                                      "/debug/replication",
                                      "/debug/sharding", "/debug/fleet",
-                                     "/debug/workload", "/debug/profile"}
+                                     "/debug/tail", "/debug/workload",
+                                     "/debug/profile"}
             for desc in surfaces.values():
                 assert isinstance(desc, str) and desc
         run(go())

@@ -1,0 +1,330 @@
+"""Fleet topology harness, open-loop workload, and tail explainer
+(ISSUE 20): the deterministic-schedule contract, zipf/verb-mix shape,
+WorkerFleet lifecycle (crash-mid-boot reaps the whole fleet), and
+tailexplain's ranked report over synthetic merged fleet views."""
+
+import json
+import random
+import subprocess
+import sys
+
+import pytest
+
+from spicedb_kubeapi_proxy_tpu.utils import loadgen, tailexplain
+from spicedb_kubeapi_proxy_tpu.utils.features import GATES
+from spicedb_kubeapi_proxy_tpu.utils.loadgen import (
+    WorkloadSpec,
+    _ZipfSampler,
+    percentile,
+)
+from spicedb_kubeapi_proxy_tpu.utils.topology import (
+    FleetError,
+    WorkerFleet,
+    pin_command,
+    single_thread_env,
+)
+
+
+# -- open-loop schedule determinism -------------------------------------------
+
+
+class TestSchedule:
+    def test_same_seed_byte_identical(self):
+        spec = WorkloadSpec(seed=42, duration_s=5.0, rate_per_s=80.0,
+                            users=10_000, watch_churn_per_s=3.0,
+                            grant_burst_per_s=1.0)
+        assert spec.schedule_lines() == spec.schedule_lines()
+        again = WorkloadSpec(seed=42, duration_s=5.0, rate_per_s=80.0,
+                             users=10_000, watch_churn_per_s=3.0,
+                             grant_burst_per_s=1.0)
+        assert spec.schedule_lines() == again.schedule_lines()
+
+    def test_different_seed_differs(self):
+        a = WorkloadSpec(seed=1, duration_s=2.0, rate_per_s=50.0,
+                         users=1000)
+        b = WorkloadSpec(seed=2, duration_s=2.0, rate_per_s=50.0,
+                         users=1000)
+        assert a.schedule_lines() != b.schedule_lines()
+
+    def test_sorted_and_sequenced(self):
+        evs = WorkloadSpec(seed=7, duration_s=3.0, rate_per_s=100.0,
+                           users=1000).schedule()
+        assert evs, "empty schedule"
+        ts = [e["t"] for e in evs]
+        assert ts == sorted(ts)
+        assert sorted(e["seq"] for e in evs) == list(range(len(evs)))
+        assert all(0 <= e["t"] < 3.0 for e in evs)
+
+    def test_verb_mix_ratios(self):
+        mix = (("filter", 0.6), ("check", 0.25), ("update", 0.15))
+        evs = WorkloadSpec(seed=3, duration_s=30.0, rate_per_s=400.0,
+                           users=1000, verb_mix=mix).schedule()
+        n = len(evs)
+        assert n > 8000
+        for verb, want in mix:
+            got = sum(1 for e in evs if e["verb"] == verb) / n
+            assert abs(got - want) < 0.04, (verb, got, want)
+
+    def test_update_events_carry_unique_names(self):
+        evs = WorkloadSpec(seed=5, duration_s=10.0, rate_per_s=100.0,
+                           users=100,
+                           verb_mix=(("update", 1.0),)).schedule()
+        names = [e["name"] for e in evs]
+        assert len(names) == len(set(names))
+
+    def test_grant_bursts_schedule_their_revokes(self):
+        evs = WorkloadSpec(seed=9, duration_s=10.0, rate_per_s=5.0,
+                           users=100, grant_burst_per_s=1.0,
+                           grant_burst_n=3,
+                           grant_ttl_s=2.0).schedule()
+        grants = {e["name"]: e["t"] for e in evs
+                  if e["verb"] == "grant"}
+        revokes = {e["name"]: e["t"] for e in evs
+                   if e["verb"] == "revoke"}
+        assert grants and set(grants) == set(revokes)
+        for name, t in grants.items():
+            assert revokes[name] == pytest.approx(t + 2.0, abs=1e-5)
+
+    def test_watch_churn_rides_on_top(self):
+        base = WorkloadSpec(seed=11, duration_s=10.0, rate_per_s=20.0,
+                            users=100)
+        churn = WorkloadSpec(seed=11, duration_s=10.0, rate_per_s=20.0,
+                             users=100, watch_churn_per_s=5.0)
+        watches = [e for e in churn.schedule() if e["verb"] == "watch"]
+        assert len(watches) > 20
+        assert not [e for e in base.schedule() if e["verb"] == "watch"]
+
+
+class TestZipf:
+    def test_rank1_over_rank2_is_2_to_the_s(self):
+        s = 1.2
+        sampler = _ZipfSampler(1000, s)
+        rng = random.Random(5)
+        counts: dict = {}
+        for _ in range(40_000):
+            r = sampler.sample(rng)
+            counts[r] = counts.get(r, 0) + 1
+        ratio = counts[1] / counts[2]
+        assert ratio == pytest.approx(2 ** s, rel=0.25), ratio
+
+    def test_ranks_in_bounds_and_tail_reached(self):
+        sampler = _ZipfSampler(50, 1.1)
+        rng = random.Random(1)
+        ranks = {sampler.sample(rng) for _ in range(5000)}
+        assert min(ranks) == 1
+        assert max(ranks) <= 50
+        assert len(ranks) > 25, "long tail never sampled"
+
+    def test_cdf_cached_per_shape(self):
+        a = _ZipfSampler(777, 1.3)
+        b = _ZipfSampler(777, 1.3)
+        assert a.cdf is b.cdf
+
+
+def test_percentile_nearest_rank():
+    vals = list(range(1, 101))
+    assert percentile(vals, 0.50) == 51
+    assert percentile(vals, 0.99) == 99
+    assert percentile([], 0.99) == 0.0
+
+
+def test_loadgen_lag_gauge_exported():
+    loadgen.LAG_GAUGE.set(0.25)
+    text = loadgen.REGISTRY.render()
+    assert "authz_loadgen_lag_seconds" in text
+    assert "0.25" in text
+
+
+# -- WorkerFleet lifecycle ----------------------------------------------------
+
+_OK_WORKER = (
+    "import sys\n"
+    "print('READY', flush=True)\n"
+    "for line in sys.stdin:\n"
+    "    line = line.strip()\n"
+    "    if line == 'EXIT':\n"
+    "        break\n"
+    "    if line.startswith('RUN'):\n"
+    "        payload = line[4:] or '{}'\n"
+    "        print('DONE ' + payload, flush=True)\n")
+
+
+def _spawn_ok(fleet, label):
+    fleet.spawn([sys.executable, "-u", "-c", _OK_WORKER],
+                label=label, env=None)
+
+
+class TestWorkerFleet:
+    def test_ready_window_shutdown(self):
+        fleet = WorkerFleet(name="t", taskset="")
+        _spawn_ok(fleet, "a")
+        _spawn_ok(fleet, "b")
+        procs = [w.proc for w in fleet.workers]
+        fleet.wait_ready(timeout_s=30)
+        out = fleet.run_window(payloads=[{"i": 0}, {"i": 1}])
+        assert out == [{"i": 0}, {"i": 1}]
+        fleet.shutdown()
+        assert all(p.poll() is not None for p in procs)
+
+    def test_crash_mid_boot_reaps_whole_fleet(self):
+        fleet = WorkerFleet(name="t", taskset="")
+        _spawn_ok(fleet, "survivor")
+        fleet.spawn([sys.executable, "-c", "import sys; sys.exit(3)"],
+                    label="crasher", env=None)
+        procs = [w.proc for w in fleet.workers]
+        with pytest.raises(FleetError) as err:
+            fleet.wait_ready(timeout_s=30)
+        msg = str(err.value)
+        assert "crasher" in msg and "reaped" in msg
+        for p in procs:
+            p.wait(10)
+            assert p.poll() is not None, "fleet member survived the reap"
+
+    def test_garbage_instead_of_ready_reaps(self):
+        fleet = WorkerFleet(name="t", taskset="")
+        fleet.spawn([sys.executable, "-u", "-c",
+                     "print('BANANA', flush=True); import time; "
+                     "time.sleep(60)"],
+                    label="chatty", env=None)
+        procs = [w.proc for w in fleet.workers]
+        with pytest.raises(FleetError, match="chatty"):
+            fleet.wait_ready(timeout_s=30)
+        for p in procs:
+            p.wait(10)
+
+    def test_context_manager_reaps_on_exception(self):
+        procs = []
+        with pytest.raises(RuntimeError, match="boom"):
+            with WorkerFleet(name="t", taskset="") as fleet:
+                _spawn_ok(fleet, "a")
+                procs = [w.proc for w in fleet.workers]
+                raise RuntimeError("boom")
+        for p in procs:
+            p.wait(10)
+            assert p.poll() is not None
+
+
+class TestEnvAndPinning:
+    def test_single_thread_env(self):
+        env = single_thread_env()
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert env["OMP_NUM_THREADS"] == "1"
+        assert "intra_op_parallelism_threads=1" in env["XLA_FLAGS"]
+        assert single_thread_env({"X": "y"})["X"] == "y"
+
+    def test_pin_command_without_taskset_is_identity(self):
+        cmd = ["python", "-c", "pass"]
+        assert pin_command(cmd, 3, taskset="") == cmd
+        assert pin_command(cmd, None, taskset="/bin/taskset") == cmd
+
+    def test_pin_command_wraps_and_wraps_modulo(self):
+        got = pin_command(["x"], 1, taskset="/usr/bin/taskset")
+        assert got[:2] == ["/usr/bin/taskset", "-c"]
+        assert got[-1] == "x"
+        assert int(got[2]) >= 0
+
+
+# -- tail explainer -----------------------------------------------------------
+
+
+def _trace(tid, dur, tiers, stages, net=0.0):
+    return {"trace_id": tid, "duration_ms": dur,
+            "tiers": {t: {"self_ms": ms} for t, ms in tiers.items()},
+            "serving_stages_ms": stages, "network_ms": net,
+            "attributed_ms": dur, "tier_count": len(tiers)}
+
+
+def _merged(traces):
+    return {"traces": traces}
+
+
+class TestTailExplain:
+    def test_gate_off_disables_report(self):
+        try:
+            GATES.set("TailExplain", False)
+            out = tailexplain.explain(_merged([]))
+            assert out["enabled"] is False
+            assert "TailExplain" in out["reason"]
+        finally:
+            GATES.reset()
+
+    def test_too_few_traces_says_so(self):
+        out = tailexplain.explain(_merged(
+            [_trace("a", 5.0, {"leader": 5.0}, {})]))
+        assert out["enabled"] is True
+        assert out["ranked"] == []
+        assert "have 1" in out["reason"]
+
+    def test_ranked_finds_the_planted_tail_stage(self):
+        # body: 10ms requests, kube_upstream 2ms; tail: one 100ms
+        # request in which kube_upstream exploded to 90ms
+        traces = [
+            _trace(f"b{i}", 10.0, {"leader": 10.0},
+                   {"leader": {"kube_upstream": 2.0, "authn": 1.0}})
+            for i in range(20)
+        ]
+        traces.append(
+            _trace("slow", 100.0, {"leader": 100.0},
+                   {"leader": {"kube_upstream": 90.0, "authn": 1.0}}))
+        out = tailexplain.explain(_merged(traces))
+        assert out["enabled"] is True
+        assert out["requests"] == 21
+        top = out["ranked"][0]
+        assert (top["tier"], top["stage"]) == ("leader", "kube_upstream")
+        assert top["delta_ms"] == pytest.approx(88.0, abs=1.0)
+        assert out["gap_ms"] == pytest.approx(90.0, abs=1.0)
+        assert 0.9 < out["explained_fraction"] < 1.1
+        assert "kube_upstream" in out["stages"]
+
+    def test_deltas_are_additive_across_components(self):
+        traces = [
+            _trace(f"b{i}", 10.0, {"f": 4.0, "l": 4.0},
+                   {"f": {"authn": 1.0}, "l": {"rule_match": 1.0}},
+                   net=2.0)
+            for i in range(10)
+        ]
+        traces.append(
+            _trace("slow", 50.0, {"f": 20.0, "l": 20.0},
+                   {"f": {"authn": 11.0}, "l": {"rule_match": 11.0}},
+                   net=10.0))
+        out = tailexplain.explain(_merged(traces))
+        total_delta = sum(r["delta_ms"] for r in out["ranked"])
+        assert total_delta == pytest.approx(out["gap_ms"], rel=0.05)
+        tiers = {r["tier"] for r in out["ranked"]}
+        assert "network" in tiers
+
+    def test_zero_duration_traces_filtered(self):
+        out = tailexplain.explain(_merged(
+            [_trace("z", 0.0, {"l": 0.0}, {})] * 5))
+        assert out["ranked"] == []
+
+
+# -- schedule canonical encoding ----------------------------------------------
+
+
+def test_schedule_lines_canonical_json():
+    spec = WorkloadSpec(seed=13, duration_s=1.0, rate_per_s=40.0,
+                        users=100)
+    for line in spec.schedule_lines().split(b"\n"):
+        ev = json.loads(line)
+        assert json.dumps(ev, sort_keys=True,
+                          separators=(",", ":")).encode() == line
+
+
+def test_worker_fleet_protocol_matches_bench_workers():
+    """The RUN/DONE framing the harness speaks is exactly what a worker
+    that echoes its payload sees — one line in, one line out."""
+    p = subprocess.Popen([sys.executable, "-u", "-c", _OK_WORKER],
+                         stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                         text=True, bufsize=1)
+    try:
+        assert p.stdout.readline().strip() == "READY"
+        p.stdin.write('RUN {"x": 1}\n')
+        p.stdin.flush()
+        assert json.loads(p.stdout.readline()[5:]) == {"x": 1}
+        p.stdin.write("EXIT\n")
+        p.stdin.flush()
+        assert p.wait(10) == 0
+    finally:
+        if p.poll() is None:
+            p.kill()
